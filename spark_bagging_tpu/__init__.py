@@ -1,0 +1,34 @@
+"""spark_bagging_tpu — a TPU-native bagging (bootstrap-aggregating) framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``pierrenodet/spark-bagging`` (see SURVEY.md; the reference checkout was
+empty at survey time, so parity claims cite BASELINE.json / SURVEY.md
+sections instead of reference file:line):
+
+- ``BaggingClassifier`` / ``BaggingRegressor`` meta-estimators with a
+  pluggable base-learner contract [B:5].
+- Poisson-bootstrap row resampling as ``jax.random.poisson`` weight
+  matrices — never materialized resamples [B:5, SURVEY §7.2].
+- Random feature subspaces per replica [SURVEY §2a#2].
+- ``vmap`` over replicas, ``shard_map`` over a (data, replica) device
+  mesh, ``lax.psum`` vote/mean aggregation [B:5, SURVEY §2c].
+- sklearn-style ``fit``/``predict``/``get_params`` protocol so ensembles
+  compose with pipelines [SURVEY §3.4].
+"""
+
+from spark_bagging_tpu.bagging import BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.models import (
+    BaseLearner,
+    LinearRegression,
+    LogisticRegression,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BaggingClassifier",
+    "BaggingRegressor",
+    "BaseLearner",
+    "LogisticRegression",
+    "LinearRegression",
+]

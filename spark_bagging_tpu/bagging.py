@@ -1,0 +1,323 @@
+"""``BaggingClassifier`` / ``BaggingRegressor`` — the user-facing API (L5).
+
+The reference exposes Spark ML estimators whose params are declared in
+``BaggingParams`` [B:5, SURVEY §2a]. The TPU-native API keeps the same
+parameter vocabulary in sklearn spelling [SURVEY §5 config]:
+
+=====================  ==========================================
+reference param        this API
+=====================  ==========================================
+baseLearner            ``base_learner``  (the plugin slot [B:5])
+numBaseLearners        ``n_estimators``
+sampleRatio            ``max_samples``
+replacement            ``bootstrap``
+subspaceRatio          ``max_features``
+(features w/ repl.)    ``bootstrap_features``
+seed                   ``seed``
+parallelism            ``chunk_size`` (+ device mesh, see parallel/)
+=====================  ==========================================
+
+Estimators follow the sklearn protocol (``fit`` / ``predict`` /
+``predict_proba`` / ``score`` / ``get_params``) so they compose with
+pipelines the way the reference composes with Spark ``Pipeline``
+[SURVEY §3.4]. The fitted "model" state (the reference's
+``Bagging*Model`` [B:5]) is a pytree of stacked per-replica params plus
+the subspace index matrix — one checkpointable object [SURVEY §3.3].
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_bagging_tpu.ensemble import (
+    fit_ensemble,
+    oob_predict_scores,
+    predict_ensemble_classifier,
+    predict_ensemble_regressor,
+)
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.models.linear import LinearRegression
+from spark_bagging_tpu.models.logistic import LogisticRegression
+from spark_bagging_tpu.utils.metrics import accuracy, fit_report, r2_score
+from spark_bagging_tpu.utils.params import ParamsMixin
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_fit(learner, n_outputs, sample_ratio, bootstrap, n_subspace,
+                bootstrap_features, chunk_size):
+    """Compiled-ensemble cache: learners hash by hyperparams, so repeated
+    fits with the same config and shapes reuse the XLA executable."""
+    return jax.jit(
+        lambda X, y, key, ids: fit_ensemble(
+            learner, X, y, key, ids, n_outputs,
+            sample_ratio=sample_ratio,
+            bootstrap=bootstrap,
+            n_subspace=n_subspace,
+            bootstrap_features=bootstrap_features,
+            chunk_size=chunk_size,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_predict_clf(learner, n_classes, n_total, voting, chunk_size):
+    return jax.jit(
+        lambda params, subspaces, X: predict_ensemble_classifier(
+            learner, params, subspaces, X, n_classes, n_total,
+            voting=voting, chunk_size=chunk_size,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_predict_reg(learner, n_total, chunk_size):
+    return jax.jit(
+        lambda params, subspaces, X: predict_ensemble_regressor(
+            learner, params, subspaces, X, n_total, chunk_size=chunk_size
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_oob(learner, n_replicas, ratio, replacement, n_classes, chunk_size):
+    return jax.jit(
+        lambda params, subspaces, X, key: oob_predict_scores(
+            learner, params, subspaces, X, key,
+            jnp.arange(n_replicas, dtype=jnp.int32),
+            sample_ratio=ratio,
+            bootstrap=replacement,
+            n_classes=n_classes,
+            chunk_size=chunk_size,
+        )
+    )
+
+
+class _BaseBagging(ParamsMixin):
+    """Shared engine driver for both estimators [SURVEY §2a #4–6]."""
+
+    _default_learner: type
+    task: str
+
+    def __init__(
+        self,
+        base_learner: BaseLearner | None = None,
+        n_estimators: int = 10,
+        max_samples: float = 1.0,
+        bootstrap: bool = True,
+        max_features: float | int = 1.0,
+        bootstrap_features: bool = False,
+        oob_score: bool = False,
+        seed: int = 0,
+        chunk_size: int | None = None,
+    ):
+        self.base_learner = base_learner
+        self.n_estimators = n_estimators
+        self.max_samples = max_samples
+        self.bootstrap = bootstrap
+        self.max_features = max_features
+        self.bootstrap_features = bootstrap_features
+        self.oob_score = oob_score
+        self.seed = seed
+        self.chunk_size = chunk_size
+
+    # -- helpers -------------------------------------------------------
+
+    def _learner(self) -> BaseLearner:
+        learner = self.base_learner or self._default_learner()
+        if learner.task != self.task:
+            raise ValueError(
+                f"{type(learner).__name__} is a {learner.task} learner; "
+                f"{type(self).__name__} needs {self.task}"
+            )
+        return learner
+
+    def _n_subspace(self, n_features: int) -> int:
+        if isinstance(self.max_features, float):
+            return max(1, min(n_features, round(self.max_features * n_features)))
+        return max(1, min(n_features, int(self.max_features)))
+
+    def _validate_X(self, X, *, fitted: bool = False) -> jnp.ndarray:
+        X = jnp.asarray(X, jnp.float32)
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D, got shape {X.shape}")
+        if fitted and X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features; the ensemble was fitted on "
+                f"{self.n_features_in_}"
+            )
+        return X
+
+    def _check_fitted(self):
+        if not hasattr(self, "ensemble_"):
+            raise RuntimeError(
+                f"{type(self).__name__} is not fitted; call fit(X, y) first"
+            )
+
+    def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int):
+        if self.n_estimators < 1:
+            raise ValueError("n_estimators must be >= 1")
+        if self.oob_score and not self.bootstrap and self.max_samples >= 1.0:
+            raise ValueError(
+                "oob_score requires out-of-bag rows: use bootstrap=True or "
+                "max_samples < 1.0"
+            )
+        learner = self._learner()
+        n_subspace = self._n_subspace(X.shape[1])
+        key = jax.random.key(self.seed)
+        ids = jnp.arange(self.n_estimators, dtype=jnp.int32)
+        fit_fn = _jitted_fit(
+            learner, n_outputs, float(self.max_samples), bool(self.bootstrap),
+            n_subspace, bool(self.bootstrap_features), self.chunk_size,
+        )
+        # Compile (cached across fits with identical config+shapes).
+        t0 = time.perf_counter()
+        compiled = fit_fn.lower(X, y, key, ids).compile()
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        params, subspaces, aux = compiled(X, y, key, ids)
+        jax.block_until_ready(params)
+        t_fit = time.perf_counter() - t0
+
+        self.ensemble_ = params
+        self.subspaces_ = subspaces
+        self.n_features_in_ = int(X.shape[1])
+        # Fitted ensemble size is frozen here: set_params(n_estimators=...)
+        # after fit must not corrupt prediction normalization.
+        self.n_estimators_ = int(self.n_estimators)
+        self._fit_key = key
+        self._fitted_learner = learner
+        self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        self.fit_report_ = fit_report(
+            n_replicas=self.n_estimators,
+            fit_seconds=t_fit,
+            losses=np.asarray(aux["loss"]),
+            n_rows=int(X.shape[0]),
+            n_features=int(X.shape[1]),
+            n_subspace=n_subspace,
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            compile_seconds=t_compile,
+        )
+
+    def _oob_scores(self, X: jnp.ndarray, n_classes: int | None):
+        """OOB aggregate + vote counts (rows with zero votes excluded by
+        caller) [SURVEY §4]."""
+        ratio, replacement = self._fit_sampling
+        agg, votes = _jitted_oob(
+            self._fitted_learner, self.n_estimators_, ratio, replacement,
+            n_classes, self.chunk_size,
+        )(self.ensemble_, self.subspaces_, X, self._fit_key)
+        return np.asarray(agg), np.asarray(votes)
+
+
+class BaggingClassifier(_BaseBagging):
+    """Bagging meta-classifier: majority/soft vote over bootstrap
+    replicas of the base learner [B:5].
+
+    Defaults to a :class:`LogisticRegression` base learner (config 1 of
+    the baseline [B:7]). ``voting="hard"`` is the reference's majority
+    vote; ``"soft"`` averages probabilities.
+    """
+
+    task = "classification"
+    _default_learner = LogisticRegression
+
+    def __init__(
+        self,
+        base_learner: BaseLearner | None = None,
+        n_estimators: int = 10,
+        max_samples: float = 1.0,
+        bootstrap: bool = True,
+        max_features: float | int = 1.0,
+        bootstrap_features: bool = False,
+        voting: str = "soft",
+        oob_score: bool = False,
+        seed: int = 0,
+        chunk_size: int | None = None,
+    ):
+        super().__init__(
+            base_learner, n_estimators, max_samples, bootstrap, max_features,
+            bootstrap_features, oob_score, seed, chunk_size,
+        )
+        self.voting = voting
+
+    def fit(self, X, y) -> "BaggingClassifier":
+        X = self._validate_X(X)
+        y = np.asarray(y)
+        if y.shape[0] != X.shape[0]:
+            raise ValueError("X and y row counts differ")
+        self.classes_, y_enc = np.unique(y, return_inverse=True)
+        self.n_classes_ = int(len(self.classes_))
+        if self.n_classes_ < 2:
+            raise ValueError("y has a single class")
+        self._fit_engine(X, jnp.asarray(y_enc, jnp.int32), self.n_classes_)
+        if self.oob_score:
+            counts, votes = self._oob_scores(X, self.n_classes_)
+            has_vote = votes > 0
+            oob_pred = counts.argmax(axis=1)
+            self.oob_score_ = accuracy(y_enc[has_vote], oob_pred[has_vote])
+            self.oob_decision_function_ = np.where(
+                has_vote[:, None], counts / np.maximum(votes, 1)[:, None], np.nan
+            )
+        return self
+
+    def predict_proba(self, X) -> np.ndarray:
+        self._check_fitted()
+        X = self._validate_X(X, fitted=True)
+        proba = _jitted_predict_clf(
+            self._fitted_learner, self.n_classes_, self.n_estimators_,
+            self.voting, self.chunk_size,
+        )(self.ensemble_, self.subspaces_, X)
+        return np.asarray(proba)
+
+    def predict(self, X) -> np.ndarray:
+        proba = self.predict_proba(X)
+        return self.classes_[proba.argmax(axis=1)]
+
+    def score(self, X, y) -> float:
+        return accuracy(np.asarray(y), self.predict(X))
+
+
+class BaggingRegressor(_BaseBagging):
+    """Bagging meta-regressor: mean aggregation over bootstrap replicas
+    [B:5]; defaults to :class:`LinearRegression` (config 2 [B:8])."""
+
+    task = "regression"
+    _default_learner = LinearRegression
+
+    def fit(self, X, y) -> "BaggingRegressor":
+        X = self._validate_X(X)
+        y = jnp.asarray(y, jnp.float32)
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y[:, 0]
+        if y.ndim != 1:
+            raise ValueError(f"y must be 1-D, got shape {y.shape}")
+        if y.shape[0] != X.shape[0]:
+            raise ValueError("X and y row counts differ")
+        self._fit_engine(X, y, 1)
+        if self.oob_score:
+            sums, votes = self._oob_scores(X, None)
+            has_vote = votes > 0
+            oob_pred = sums[has_vote] / votes[has_vote]
+            self.oob_prediction_ = np.where(
+                has_vote, sums / np.maximum(votes, 1), np.nan
+            )
+            self.oob_score_ = r2_score(np.asarray(y)[has_vote], oob_pred)
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        self._check_fitted()
+        X = self._validate_X(X, fitted=True)
+        pred = _jitted_predict_reg(
+            self._fitted_learner, self.n_estimators_, self.chunk_size
+        )(self.ensemble_, self.subspaces_, X)
+        return np.asarray(pred)
+
+    def score(self, X, y) -> float:
+        return r2_score(np.asarray(y), self.predict(X))

@@ -1,0 +1,238 @@
+"""The ensemble engine: replica-vmapped fit and batched predict.
+
+This is L4 of the layer map [SURVEY §1] — the one layer the reference
+implements itself. The reference's engine is a driver-side loop of
+``numBaseLearners`` full Spark jobs [SURVEY §3.1]; here the whole
+ensemble fit is ONE compiled XLA program: per-replica bootstrap weights
+are drawn on-device from folded keys, the base learner's fit is
+``vmap``'d over replicas, and prediction is one batched forward plus a
+``psum``-style vote/mean reduction [B:5].
+
+Memory discipline [SURVEY §7 hard-part 3]: ``X`` is closed over
+(broadcast once per device); each replica materializes only its
+``(n_rows,)`` weight vector and ``(n_subspace,)`` index vector, drawn
+inside the mapped function — so ``chunk_size`` (via
+``lax.map(..., batch_size=...)``) bounds peak memory at
+``chunk_size × per-replica working set`` regardless of ensemble size.
+
+Sharding hooks: ``data_axis`` names the mesh axis rows are sharded over
+(learner row-reductions ``psum`` over it); ``replica_axis`` names the
+axis replicas are sharded over (vote/mean reductions ``psum`` over it).
+Both default to None for single-device execution; the ``parallel``
+package wires them up under ``shard_map`` [SURVEY §2c].
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.ops.aggregate import (
+    hard_vote_counts,
+    mean_aggregate,
+    soft_vote_proba,
+)
+from spark_bagging_tpu.ops.bootstrap import (
+    bootstrap_weights_one,
+    feature_subspace_one,
+    fit_key,
+    oob_mask,
+)
+
+
+def _map_replicas(fn, replica_ids: jax.Array, chunk_size: int | None):
+    """vmap when chunk_size is None, else chunked lax.map.
+
+    Chunked mapping is scan-of-vmap: full MXU utilization inside a
+    chunk, bounded peak memory across chunks.
+    """
+    if chunk_size is None:
+        return jax.vmap(fn)(replica_ids)
+    return jax.lax.map(fn, replica_ids, batch_size=chunk_size)
+
+
+def fit_ensemble(
+    learner: BaseLearner,
+    X: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+    replica_ids: jax.Array,
+    n_outputs: int,
+    *,
+    sample_ratio: float = 1.0,
+    bootstrap: bool = True,
+    n_subspace: int | None = None,
+    bootstrap_features: bool = False,
+    data_axis: str | None = None,
+    chunk_size: int | None = None,
+) -> tuple[Any, jax.Array, dict[str, jax.Array]]:
+    """Fit all replicas in ``replica_ids``; the reference's ``train()``
+    loop [SURVEY §3.1] as one XLA program.
+
+    Returns ``(stacked_params, subspaces, aux)`` where ``stacked_params``
+    has a leading replica axis on every leaf, ``subspaces`` is
+    ``(R, n_subspace)`` int32, and ``aux`` carries per-replica losses.
+
+    When rows are sharded over ``data_axis``, weight draws fold the
+    shard index into the key so shards draw independent rows; replica
+    identity (subspace, init) stays shard-invariant, so base fits see
+    replicated params with ``psum``'d row statistics — the exact
+    single-device update. Note: with ``data_axis`` set, the realized
+    bootstrap depends on the mesh layout (documented; fixed layout ⇒
+    fully reproducible).
+    """
+    n_rows, n_features = X.shape
+    if n_subspace is None:
+        n_subspace = n_features
+
+    row_key = key
+    if data_axis is not None:
+        row_key = jax.random.fold_in(key, jax.lax.axis_index(data_axis))
+
+    def fit_one(rid):
+        w = bootstrap_weights_one(
+            row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
+        )
+        idx = feature_subspace_one(
+            key, rid, n_features, n_subspace, replacement=bootstrap_features
+        )
+        params, aux = learner.fit_from_init(
+            fit_key(key, rid),
+            X[:, idx],
+            y,
+            w,
+            n_outputs,
+            axis_name=data_axis,
+        )
+        return params, idx, aux["loss"]
+
+    params, subspaces, losses = _map_replicas(fit_one, replica_ids, chunk_size)
+    return params, subspaces, {"loss": losses}
+
+
+def predict_scores_ensemble(
+    learner: BaseLearner,
+    stacked_params: Any,
+    subspaces: jax.Array,
+    X: jax.Array,
+    *,
+    chunk_size: int | None = None,
+) -> jax.Array:
+    """Per-replica scores: ``(R, n, C)`` logits or ``(R, n)`` values.
+
+    The reference's per-row × per-model UDF loop [SURVEY §3.2] as one
+    batched forward.
+    """
+
+    def score_one(args):
+        params, idx = args
+        return learner.predict_scores(params, X[:, idx])
+
+    if chunk_size is None:
+        return jax.vmap(score_one)((stacked_params, subspaces))
+    return jax.lax.map(
+        score_one, (stacked_params, subspaces), batch_size=chunk_size
+    )
+
+
+def predict_ensemble_classifier(
+    learner: BaseLearner,
+    stacked_params: Any,
+    subspaces: jax.Array,
+    X: jax.Array,
+    n_classes: int,
+    n_total: int,
+    *,
+    voting: str = "soft",
+    replica_axis: str | None = None,
+    chunk_size: int | None = None,
+) -> jax.Array:
+    """Aggregated class probabilities ``(n, C)``.
+
+    ``voting="soft"``: mean softmax probability. ``voting="hard"``:
+    majority-vote counts normalized to frequencies — the reference's
+    vote aggregation [B:5].
+    """
+    scores = predict_scores_ensemble(
+        learner, stacked_params, subspaces, X, chunk_size=chunk_size
+    )
+    if voting == "soft":
+        return soft_vote_proba(
+            jax.nn.softmax(scores, axis=-1),
+            n_total=n_total,
+            axis_name=replica_axis,
+        )
+    if voting == "hard":
+        counts = hard_vote_counts(
+            jnp.argmax(scores, axis=-1), n_classes, axis_name=replica_axis
+        )
+        return counts / n_total
+    raise ValueError(f"unknown voting {voting!r}")
+
+
+def predict_ensemble_regressor(
+    learner: BaseLearner,
+    stacked_params: Any,
+    subspaces: jax.Array,
+    X: jax.Array,
+    n_total: int,
+    *,
+    replica_axis: str | None = None,
+    chunk_size: int | None = None,
+) -> jax.Array:
+    """Mean-aggregated predictions ``(n,)`` [B:5]."""
+    scores = predict_scores_ensemble(
+        learner, stacked_params, subspaces, X, chunk_size=chunk_size
+    )
+    return mean_aggregate(scores, n_total=n_total, axis_name=replica_axis)
+
+
+def oob_predict_scores(
+    learner: BaseLearner,
+    stacked_params: Any,
+    subspaces: jax.Array,
+    X: jax.Array,
+    key: jax.Array,
+    replica_ids: jax.Array,
+    *,
+    sample_ratio: float = 1.0,
+    bootstrap: bool = True,
+    n_classes: int | None = None,
+    chunk_size: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Out-of-bag aggregation for ``oob_score`` [SURVEY §4].
+
+    Each replica votes only on rows it never sampled (its bootstrap
+    weights, regenerated from the key, are zero). Returns
+    ``(agg, n_votes)``: for classification ``agg`` is OOB vote counts
+    ``(n, C)``; for regression the OOB-masked prediction *sum* ``(n,)``
+    (divide by ``n_votes`` for the mean). ``n_votes`` is the per-row
+    count of OOB replicas; rows with ``n_votes == 0`` have no OOB
+    estimate and must be excluded by the caller.
+    """
+    n_rows = X.shape[0]
+    classification = n_classes is not None
+
+    def one(args):
+        params, idx, rid = args
+        w = bootstrap_weights_one(
+            key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
+        )
+        mask = oob_mask(w).astype(jnp.float32)
+        scores = learner.predict_scores(params, X[:, idx])
+        if classification:
+            onehot = jax.nn.one_hot(
+                jnp.argmax(scores, axis=-1), n_classes, dtype=jnp.float32
+            )
+            return onehot * mask[:, None], mask
+        return scores * mask, mask
+
+    args = (stacked_params, subspaces, replica_ids)
+    if chunk_size is None:
+        contrib, votes = jax.vmap(one)(args)
+    else:
+        contrib, votes = jax.lax.map(one, args, batch_size=chunk_size)
+    return contrib.sum(axis=0), votes.sum(axis=0)

@@ -1,0 +1,18 @@
+"""Base learners: functional, weighted, vmap-able over a replica axis.
+
+The reference's L3 is *pluggable* Spark ML Predictors (LogisticRegression,
+DecisionTree, LinearRegression, MLP) [B:7-11, SURVEY §1]. Here the plugin
+contract is `BaseLearner` (models/base.py); each learner is a pure
+function of (params, X, y, sample_weight, key) so the ensemble engine can
+`vmap` it over replicas and `shard_map` it over devices.
+"""
+
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.models.linear import LinearRegression
+from spark_bagging_tpu.models.logistic import LogisticRegression
+
+__all__ = [
+    "BaseLearner",
+    "LogisticRegression",
+    "LinearRegression",
+]

@@ -1,0 +1,96 @@
+"""The base-learner plugin contract — the TPU-native `BaggingParams` slot.
+
+The reference's plugin point is the Spark `Estimator`/`Model` contract:
+any Predictor can be set as the base learner [B:5]. The TPU-native
+contract replaces object-oriented fit/transform with three pure
+functions, each `vmap`-able over a leading replica axis [SURVEY §7.3]:
+
+- ``init_params(key, n_features, n_outputs) -> params``
+- ``fit(params, X, y, sample_weight, key, axis_name) -> (params, aux)``
+- ``predict_scores(params, X) -> scores``
+
+Rules that make a learner a valid plugin:
+
+- **Weighted fit.** ``sample_weight`` carries the Poisson bootstrap
+  counts; the learner must treat them as exact per-row multiplicities or
+  accuracy parity fails silently [SURVEY §7 hard-part 2].
+- **Static shapes, no data-dependent Python control flow** — the fit is
+  traced once and compiled; iteration counts are hyperparameters.
+- **Row reductions go through ``maybe_psum(_, axis_name)``** so the same
+  code runs single-device or data-parallel under ``shard_map`` with rows
+  sharded over a mesh axis [SURVEY §5 comms backend].
+- Hyperparameters live on the (hashable, static) learner object; traced
+  state lives in ``params`` (a pytree).
+
+``scores`` are logits ``(n, n_classes)`` for classification and values
+``(n,)`` for regression.
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar
+
+import jax
+
+from spark_bagging_tpu.utils.params import ParamsMixin
+
+Params = Any  # a pytree of arrays
+Aux = dict[str, jax.Array]
+
+
+class BaseLearner(ParamsMixin):
+    """Abstract base-learner contract (see module docstring)."""
+
+    task: ClassVar[str]  # "classification" | "regression"
+
+    def init_params(
+        self, key: jax.Array, n_features: int, n_outputs: int
+    ) -> Params:
+        raise NotImplementedError
+
+    def fit(
+        self,
+        params: Params,
+        X: jax.Array,
+        y: jax.Array,
+        sample_weight: jax.Array,
+        key: jax.Array,
+        *,
+        axis_name: str | None = None,
+    ) -> tuple[Params, Aux]:
+        raise NotImplementedError
+
+    def predict_scores(self, params: Params, X: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    # -- convenience used by the ensemble engine ------------------------
+
+    def fit_from_init(
+        self,
+        key: jax.Array,
+        X: jax.Array,
+        y: jax.Array,
+        sample_weight: jax.Array,
+        n_outputs: int,
+        *,
+        axis_name: str | None = None,
+    ) -> tuple[Params, Aux]:
+        """Init-then-fit with a split key; one replica's whole training."""
+        init_key, fit_key = jax.random.split(key)
+        params = self.init_params(init_key, X.shape[1], n_outputs)
+        return self.fit(
+            params, X, y, sample_weight, fit_key, axis_name=axis_name
+        )
+
+    # Learners are static (hashable) w.r.t. jit: two instances with equal
+    # hyperparams trace to the same compiled program.
+    def __hash__(self) -> int:
+        return hash((type(self),) + tuple(
+            sorted((k, repr(v)) for k, v in self.get_params(deep=False).items())
+        ))
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            type(self) is type(other)
+            and self.get_params(deep=False) == other.get_params(deep=False)  # type: ignore[union-attr]
+        )

@@ -1,0 +1,66 @@
+"""Weighted linear (ridge) regression via normal equations.
+
+The reference's regression config plugs Spark ML LinearRegression into
+``BaggingRegressor`` [B:8]. The TPU-native learner solves the weighted
+ridge normal equations ``(Xᵀ diag(w) X + l2·I) β = Xᵀ diag(w) y`` with a
+Cholesky solve — one ``(d, n) @ (n, d)`` matmul per replica, ideal MXU
+shape, closed-form (no iteration), trivially ``vmap``-able. Row
+reductions go through ``maybe_psum`` so a data-sharded fit returns the
+identical solution [SURVEY §5 comms backend].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.models.base import Aux, BaseLearner, Params
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+_BIAS_JITTER = 1e-8
+
+
+class LinearRegression(BaseLearner):
+    """Weighted least squares with L2 penalty (bias unpenalized)."""
+
+    task = "regression"
+
+    def __init__(self, l2: float = 1e-6, precision: str = "highest"):
+        self.l2 = l2
+        self.precision = precision
+
+    def init_params(self, key, n_features, n_outputs):
+        del key, n_outputs  # closed-form solver ignores the init
+        return {"beta": jnp.zeros((n_features + 1,), jnp.float32)}
+
+    def predict_scores(self, params, X):
+        beta = params["beta"]
+        return X.astype(beta.dtype) @ beta[:-1] + beta[-1]
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None):
+        del params, key
+        X = X.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        w = sample_weight.astype(jnp.float32)
+        # Normal equations need fp32 MXU precision on TPU (bf16 default
+        # ruins the Gram matrix conditioning) — see logistic.py.
+        with jax.default_matmul_precision(self.precision):
+            Xb = jnp.concatenate(
+                [X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1
+            )
+            d = Xb.shape[1]
+            Xw = Xb * w[:, None]
+            A = maybe_psum(Xw.T @ Xb, axis_name)
+            b = maybe_psum(Xw.T @ y, axis_name)
+            pen = jnp.concatenate(
+                [jnp.full(d - 1, self.l2), jnp.full(1, _BIAS_JITTER)]
+            )
+            beta = jax.scipy.linalg.solve(
+                A + jnp.diag(pen) * maybe_psum(jnp.sum(w), axis_name),
+                b,
+                assume_a="pos",
+            )
+            resid = Xb @ beta - y
+            w_sum = maybe_psum(jnp.sum(w), axis_name)
+            mse = maybe_psum(jnp.sum(w * resid**2), axis_name) / w_sum
+        return {"beta": beta}, {"loss": mse, "loss_curve": mse[None]}

@@ -1,0 +1,187 @@
+"""Weighted multinomial logistic regression — the headline base learner.
+
+The reference plugs Spark ML's LogisticRegression (netlib/OpenBLAS
+L-BFGS on the JVM) into the bagging loop [B:7, SURVEY §2b]. The
+TPU-native learner is a damped-Newton (IRLS) solver whose per-iteration
+work is a static set of ``(d, n) @ (n, d)`` matmuls — exactly what the
+MXU wants — and whose iteration count is static so the whole fit jits
+and ``vmap``s over replicas [SURVEY §7.3].
+
+Solvers:
+
+- ``"newton"`` (default): exact multinomial Newton. The Hessian is
+  assembled block-by-block over class pairs (``C²/2`` scaled-X matmuls)
+  so peak per-replica memory stays ``O(n·d + (C·d)²)`` — no ``(n, C·d)``
+  intermediate that would blow HBM when ``vmap``'d over 1000+ replicas
+  [SURVEY §7 hard-part 3]. Right choice for feature dims up to ~10³
+  [B:7-11].
+- ``"adam"``: fixed-step first-order solver for high-dimensional
+  problems (Criteo-scale [B:11]) where a ``(C·d)²`` Hessian is off the
+  table.
+
+Both treat ``sample_weight`` as exact multiplicities and reduce over
+rows through ``maybe_psum`` so data-parallel sharding gives exactly the
+same update as a single-device fit [SURVEY §7 hard-part 2].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from spark_bagging_tpu.models.base import Aux, BaseLearner, Params
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+_BIAS_JITTER = 1e-6  # keeps the softmax gauge direction solvable
+
+
+def _augment(X: jax.Array) -> jax.Array:
+    """Append a bias column of ones."""
+    return jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
+
+
+class LogisticRegression(BaseLearner):
+    """Weighted multinomial logistic regression with L2 penalty.
+
+    Parameters mirror the reference base learner's capability [B:7]:
+    ``l2`` regularization strength, ``max_iter`` solver iterations
+    (static, for jit), ``solver`` in {"newton", "adam"}, ``lr`` the Adam
+    step size (ignored by Newton).
+    """
+
+    task = "classification"
+
+    def __init__(
+        self,
+        l2: float = 1e-3,
+        max_iter: int = 15,
+        solver: str = "newton",
+        lr: float = 0.1,
+        precision: str = "highest",
+    ):
+        self.l2 = l2
+        self.max_iter = max_iter
+        self.solver = solver
+        self.lr = lr
+        self.precision = precision
+
+    def init_params(self, key, n_features, n_outputs):
+        del key  # zero init: uniform probabilities, Newton's best start
+        return {"W": jnp.zeros((n_features + 1, n_outputs), jnp.float32)}
+
+    def predict_scores(self, params, X):
+        return _augment(X.astype(params["W"].dtype)) @ params["W"]
+
+    # ------------------------------------------------------------------
+
+    def _penalty(self, W):
+        return 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)  # bias unpenalized
+
+    def _global_loss(self, W, Xb, y, w, w_sum, axis_name):
+        """Global weighted mean NLL + penalty (for reporting/curves)."""
+        logp = jax.nn.log_softmax(Xb @ W, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        data = maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
+        return data + self._penalty(W)
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None):
+        del key  # deterministic solvers
+        Xb = _augment(X.astype(jnp.float32))
+        w = sample_weight.astype(jnp.float32)
+        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # TPU matmuls default to bfloat16 inputs; Newton's Hessian loses
+        # PSD-ness in bf16 and Cholesky NaNs. Solver math pins a higher
+        # MXU precision (trace-time context — applies to ops below).
+        with jax.default_matmul_precision(self.precision):
+            if self.solver == "newton":
+                return self._fit_newton(params, Xb, y, w, w_sum, axis_name)
+            if self.solver == "adam":
+                return self._fit_adam(params, Xb, y, w, w_sum, axis_name)
+        raise ValueError(f"unknown solver {self.solver!r}")
+
+    # -- Newton --------------------------------------------------------
+
+    def _fit_newton(self, params, Xb, y, w, w_sum, axis_name) -> tuple[Params, Aux]:
+        d = Xb.shape[1]
+        C = params["W"].shape[1]
+        Y = jax.nn.one_hot(y, C, dtype=jnp.float32)
+        # Damping diagonal in (c, i) layout: l2 on coefficients, jitter
+        # on bias entries.
+        pen_cd = jnp.tile(
+            jnp.concatenate(
+                [jnp.full(d - 1, self.l2), jnp.full(1, _BIAS_JITTER)]
+            ),
+            C,
+        )
+
+        def step(W, _):
+            logits = Xb @ W
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            loss = (
+                maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
+                + self._penalty(W)
+            )
+            P = jnp.exp(logp)
+            G = maybe_psum(Xb.T @ ((P - Y) * w[:, None]), axis_name) / w_sum
+            G = G + jnp.concatenate(
+                [self.l2 * W[:-1], jnp.zeros((1, C), W.dtype)], axis=0
+            )
+            # Hessian blocks H_cc' = X^T diag(w·p_c·(δ_cc' − p_c')) X,
+            # each a symmetric (d, d) matmul; C²/2 of them.
+            blocks: list[list[jax.Array | None]] = [
+                [None] * C for _ in range(C)
+            ]
+            for c in range(C):
+                for cp in range(c, C):
+                    s = w * P[:, c] * ((1.0 if c == cp else 0.0) - P[:, cp])
+                    Hb = maybe_psum((Xb * s[:, None]).T @ Xb, axis_name)
+                    blocks[c][cp] = Hb
+                    if cp != c:
+                        blocks[cp][c] = Hb
+            H = jnp.block(blocks) / w_sum + jnp.diag(pen_cd + 1e-8)
+            delta = jax.scipy.linalg.solve(
+                H, G.T.reshape(-1), assume_a="pos"
+            )
+            return W - delta.reshape(C, d).T, loss
+
+        W, losses = jax.lax.scan(step, params["W"], None, length=self.max_iter)
+        final = self._global_loss(W, Xb, y, w, w_sum, axis_name)
+        return {"W": W}, {"loss": final, "loss_curve": losses}
+
+    # -- Adam ----------------------------------------------------------
+
+    def _fit_adam(self, params, Xb, y, w, w_sum, axis_name) -> tuple[Params, Aux]:
+        opt = optax.adam(self.lr)
+
+        def local_data_loss(W):
+            # Local shard's weighted NLL sum over the *global* weight
+            # total; grads are psum'd explicitly below (the penalty is
+            # added once, outside the psum).
+            logp = jax.nn.log_softmax(Xb @ W, axis=-1)
+            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            return jnp.sum(w * nll) / w_sum
+
+        def penalty_grad(W):
+            return jnp.concatenate(
+                [self.l2 * W[:-1], jnp.zeros((1, W.shape[1]), W.dtype)],
+                axis=0,
+            )
+
+        def step(carry, _):
+            W, opt_state = carry
+            local_loss, g_local = jax.value_and_grad(local_data_loss)(W)
+            g = maybe_psum(g_local, axis_name) + penalty_grad(W)
+            loss = maybe_psum(local_loss, axis_name) + self._penalty(W)
+            updates, opt_state = opt.update(g, opt_state, W)
+            return (optax.apply_updates(W, updates), opt_state), loss
+
+        (W, _), losses = jax.lax.scan(
+            step,
+            (params["W"], opt.init(params["W"])),
+            None,
+            length=self.max_iter,
+        )
+        final = self._global_loss(W, Xb, y, w, w_sum, axis_name)
+        return {"W": W}, {"loss": final, "loss_curve": losses}

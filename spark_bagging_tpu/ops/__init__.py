@@ -1,0 +1,25 @@
+"""Core jit-compiled ensemble ops: bootstrap draws, aggregation, reductions."""
+
+from spark_bagging_tpu.ops.aggregate import (
+    hard_vote_counts,
+    mean_aggregate,
+    soft_vote_proba,
+)
+from spark_bagging_tpu.ops.bootstrap import (
+    bootstrap_weights,
+    feature_subspaces,
+    oob_mask,
+    replica_keys,
+)
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+__all__ = [
+    "bootstrap_weights",
+    "feature_subspaces",
+    "oob_mask",
+    "replica_keys",
+    "mean_aggregate",
+    "soft_vote_proba",
+    "hard_vote_counts",
+    "maybe_psum",
+]

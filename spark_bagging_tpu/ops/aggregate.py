@@ -1,0 +1,61 @@
+"""Prediction aggregation: majority vote / probability mean / regression mean.
+
+The reference aggregates per-row on JVM executors (loop over sub-models
+inside a UDF) [SURVEY §3.2]. Here aggregation is one batched device
+reduction over the replica axis — ``lax.psum`` across replica shards
+when the ensemble is sharded [B:5].
+
+All three aggregators take *local* per-replica predictions plus an
+optional mesh axis name and the *global* replica count, so they compose
+with ``shard_map`` over the replica axis unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+
+def mean_aggregate(
+    preds: jnp.ndarray,
+    *,
+    n_total: int,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Mean over the leading replica axis: ``(R_local, ...) -> (...)``.
+
+    Regression aggregation [B:5]; also used for soft-vote probability
+    averaging.
+    """
+    total = maybe_psum(jnp.sum(preds, axis=0), axis_name)
+    return total / n_total
+
+
+def soft_vote_proba(
+    probs: jnp.ndarray,
+    *,
+    n_total: int,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Soft vote: mean class probability, ``(R_local, n, C) -> (n, C)``."""
+    return mean_aggregate(probs, n_total=n_total, axis_name=axis_name)
+
+
+def hard_vote_counts(
+    pred_labels: jnp.ndarray,
+    n_classes: int,
+    *,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Majority-vote counts: ``(R_local, n) int -> (n, C) float`` vote tally.
+
+    Mode-over-replicas expressed as a one-hot sum so it is a single
+    reduction XLA fuses (and ``psum``s across replica shards) instead of
+    a data-dependent mode computation [SURVEY §7.4]. Argmax of the tally
+    breaks ties toward the lower class index, matching the convention of
+    ``numpy.argmax``.
+    """
+    onehot = jax.nn.one_hot(pred_labels, n_classes, dtype=jnp.float32)
+    return maybe_psum(jnp.sum(onehot, axis=0), axis_name)

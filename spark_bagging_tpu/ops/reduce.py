@@ -1,0 +1,19 @@
+"""Cross-device reduction helpers.
+
+All row-dimension reductions in learners route through ``maybe_psum`` so
+the same learner code runs unsharded (axis_name=None) or data-parallel
+under ``shard_map`` with rows sharded over a mesh axis — the TPU-native
+replacement for Spark's executor-side ``treeAggregate`` [SURVEY §5
+comms backend].
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def maybe_psum(x, axis_name: str | None):
+    """``lax.psum`` over ``axis_name`` if set, identity otherwise."""
+    if axis_name is None:
+        return x
+    return jax.lax.psum(x, axis_name)

@@ -1,0 +1,1 @@
+"""Utilities: params protocol, datasets, checkpointing, metrics, logging."""

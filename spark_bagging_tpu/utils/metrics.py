@@ -1,0 +1,81 @@
+"""Evaluation metrics and the fit report.
+
+The reference inherits metrics/observability from Spark ML
+``Instrumentation`` and evaluators [SURVEY §5 metrics]. Here: plain
+numpy metrics (host-side, not hot path) and a ``fit_report`` dict whose
+headline entry is **fits/sec** — fitted base learners per second of
+wall clock, the driver's north-star metric [B:2, BASELINE.md].
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def accuracy(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    return float(np.mean(np.asarray(y_true) == np.asarray(y_pred)))
+
+
+def rmse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    d = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return float(np.sqrt(np.mean(d**2)))
+
+
+def r2_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    y_true = np.asarray(y_true, np.float64)
+    y_pred = np.asarray(y_pred, np.float64)
+    ss_res = float(np.sum((y_true - y_pred) ** 2))
+    ss_tot = float(np.sum((y_true - y_true.mean()) ** 2))
+    return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+
+
+def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
+    """Binary ROC AUC via the rank statistic (ties get average rank)."""
+    y_true = np.asarray(y_true)
+    scores = np.asarray(scores, np.float64)
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(scores)
+    ranks[order] = np.arange(1, len(scores) + 1, dtype=np.float64)
+    # average ranks for ties
+    for v in np.unique(scores[np.isfinite(scores)]):
+        tie = scores == v
+        if tie.sum() > 1:
+            ranks[tie] = ranks[tie].mean()
+    pos = y_true == 1
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return float(
+        (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    )
+
+
+def fit_report(
+    *,
+    n_replicas: int,
+    fit_seconds: float,
+    losses: np.ndarray,
+    n_rows: int,
+    n_features: int,
+    n_subspace: int,
+    backend: str,
+    n_devices: int,
+    compile_seconds: float | None = None,
+) -> dict[str, Any]:
+    """Structured training report [SURVEY §5 metrics]."""
+    losses = np.asarray(losses, np.float64)
+    return {
+        "n_replicas": n_replicas,
+        "fit_seconds": fit_seconds,
+        "fits_per_sec": n_replicas / fit_seconds if fit_seconds > 0 else float("inf"),
+        "compile_seconds": compile_seconds,
+        "loss_mean": float(losses.mean()),
+        "loss_std": float(losses.std()),
+        "n_rows": n_rows,
+        "n_features": n_features,
+        "n_subspace": n_subspace,
+        "backend": backend,
+        "n_devices": n_devices,
+    }

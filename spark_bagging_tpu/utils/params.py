@@ -1,0 +1,77 @@
+"""sklearn-compatible params protocol — the Spark ML ``Params`` analog.
+
+The reference's config system is Spark ML ``Params``: typed params with
+defaults, validators, ``copy(ParamMap)`` [SURVEY §5 config]. The
+TPU-native equivalent is the sklearn ``get_params``/``set_params``
+protocol implemented over ``__init__`` keyword signatures, which lets
+estimators compose with sklearn pipelines, ``clone``, and grid search
+[SURVEY §3.4].
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+
+class ParamsMixin:
+    """``get_params``/``set_params``/``clone`` over the ``__init__`` signature.
+
+    Subclasses must store every ``__init__`` keyword verbatim as an
+    attribute of the same name (sklearn's convention).
+    """
+
+    @classmethod
+    def _param_names(cls) -> list[str]:
+        sig = inspect.signature(cls.__init__)
+        return [
+            name
+            for name, p in sig.parameters.items()
+            if name != "self"
+            and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+        ]
+
+    def get_params(self, deep: bool = True) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for name in self._param_names():
+            value = getattr(self, name)
+            out[name] = value
+            if deep and hasattr(value, "get_params"):
+                for sub, sub_val in value.get_params(deep=True).items():
+                    out[f"{name}__{sub}"] = sub_val
+        return out
+
+    def set_params(self, **params: Any):
+        if not params:
+            return self
+        valid = set(self._param_names())
+        nested: dict[str, dict[str, Any]] = {}
+        for key, value in params.items():
+            name, _, sub = key.partition("__")
+            if name not in valid:
+                raise ValueError(
+                    f"Invalid parameter {name!r} for {type(self).__name__}. "
+                    f"Valid parameters: {sorted(valid)}"
+                )
+            if sub:
+                nested.setdefault(name, {})[sub] = value
+            else:
+                setattr(self, name, value)
+        for name, sub_params in nested.items():
+            getattr(self, name).set_params(**sub_params)
+        return self
+
+    def clone(self):
+        """Unfitted copy with the same params (sklearn ``clone`` semantics);
+        the analog of Spark ML ``Estimator.copy`` [SURVEY §1]."""
+        params = {
+            name: (value.clone() if hasattr(value, "clone") else value)
+            for name, value in self.get_params(deep=False).items()
+        }
+        return type(self)(**params)
+
+    def __repr__(self) -> str:
+        args = ", ".join(
+            f"{name}={getattr(self, name)!r}" for name in self._param_names()
+        )
+        return f"{type(self).__name__}({args})"

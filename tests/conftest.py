@@ -1,0 +1,35 @@
+"""Test harness: CPU backend as the fake device [SURVEY §4].
+
+The analog of the reference's `local[*]` SparkSession trick: force the
+CPU backend with 8 virtual XLA devices so every `shard_map`/`psum` path
+is exercised without TPU hardware. The axon sitecustomize imports jax at
+interpreter start, so the platform must be flipped via jax.config (env
+vars are too late), and XLA_FLAGS must be appended before first backend
+init (conftest import time is early enough — no device has been queried
+yet).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_fake_device_config():
+    assert jax.default_backend() == "cpu"
+    assert jax.device_count() == 8, "tests expect 8 virtual XLA CPU devices"
+    yield

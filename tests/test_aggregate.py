@@ -1,0 +1,67 @@
+"""Unit tests for vote/mean aggregation on hand-built arrays [SURVEY §4]."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from spark_bagging_tpu.ops import hard_vote_counts, mean_aggregate, soft_vote_proba
+
+
+def test_mean_aggregate():
+    preds = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+    out = mean_aggregate(preds, n_total=2)
+    np.testing.assert_allclose(np.asarray(out), [2.0, 3.0])
+
+
+def test_soft_vote_proba():
+    probs = jnp.array(
+        [[[0.9, 0.1]], [[0.2, 0.8]], [[0.4, 0.6]]]
+    )  # (R=3, n=1, C=2)
+    out = soft_vote_proba(probs, n_total=3)
+    np.testing.assert_allclose(np.asarray(out), [[0.5, 0.5]], atol=1e-6)
+
+
+def test_hard_vote_majority():
+    labels = jnp.array([[0, 1], [0, 2], [1, 2]])  # (R=3, n=2)
+    counts = hard_vote_counts(labels, 3)
+    np.testing.assert_allclose(np.asarray(counts), [[2, 1, 0], [0, 1, 2]])
+    assert np.asarray(counts.argmax(axis=1)).tolist() == [0, 2]
+
+
+def test_hard_vote_tie_breaks_low():
+    labels = jnp.array([[1], [0]])
+    counts = hard_vote_counts(labels, 2)
+    assert int(counts.argmax(axis=1)[0]) == 0
+
+
+def test_aggregation_under_replica_sharding():
+    """psum-based aggregation over a sharded replica axis matches the
+    unsharded result — the reduction the north star names [B:5]."""
+    mesh = jax.make_mesh((8,), ("replica",))
+    preds = jnp.arange(32.0).reshape(8, 4)  # 8 replicas, 4 rows
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=P("replica"), out_specs=P()
+    )
+    def sharded_mean(p):
+        return mean_aggregate(p, n_total=8, axis_name="replica")
+
+    np.testing.assert_allclose(
+        np.asarray(sharded_mean(preds)), np.asarray(preds.mean(axis=0)), rtol=1e-6
+    )
+
+    labels = jnp.tile(jnp.array([[0, 1, 1, 2]]), (8, 1))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=P("replica"), out_specs=P()
+    )
+    def sharded_vote(l):
+        return hard_vote_counts(l, 3, axis_name="replica")
+
+    np.testing.assert_allclose(
+        np.asarray(sharded_vote(labels)),
+        np.asarray(hard_vote_counts(labels, 3)),
+    )

@@ -1,0 +1,251 @@
+"""Behavioral tests for the bagging estimators — the reference's suite
+strategy [SURVEY §4]: accuracy vs single learner, degenerate-ensemble
+equivalence, seed determinism, param round-trips, sklearn parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
+from sklearn.ensemble import BaggingClassifier as SkBagging
+from sklearn.linear_model import LogisticRegression as SkLogReg
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.models import LinearRegression, LogisticRegression
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    return StandardScaler().fit_transform(X).astype(np.float32), y
+
+
+@pytest.fixture(scope="module")
+def iris():
+    X, y = load_iris(return_X_y=True)
+    return StandardScaler().fit_transform(X).astype(np.float32), y
+
+
+@pytest.fixture(scope="module")
+def diabetes():
+    X, y = load_diabetes(return_X_y=True)
+    return (
+        StandardScaler().fit_transform(X).astype(np.float32),
+        y.astype(np.float32),
+    )
+
+
+class TestBaggingClassifier:
+    def test_accuracy_close_to_single_learner(self, breast_cancer):
+        """Bagged accuracy ≈/≥ single base learner [SURVEY §4]."""
+        X, y = breast_cancer
+        clf = BaggingClassifier(n_estimators=10, seed=7).fit(X, y)
+        lr = LogisticRegression()
+        params, _ = lr.fit_from_init(
+            jax.random.key(0), jnp.asarray(X), jnp.asarray(y, jnp.int32),
+            jnp.ones(len(y)), 2,
+        )
+        single = (np.asarray(lr.predict_scores(params, jnp.asarray(X)).argmax(1)) == y).mean()
+        assert clf.score(X, y) >= single - 0.01
+
+    def test_degenerate_ensemble_equals_base_learner(self, breast_cancer):
+        """n_estimators=1, no bootstrap, full features ⇒ exactly the base
+        learner [SURVEY §4]."""
+        X, y = breast_cancer
+        clf = BaggingClassifier(
+            n_estimators=1, bootstrap=False, max_samples=1.0
+        ).fit(X, y)
+        lr = LogisticRegression()
+        params, _ = lr.fit_from_init(
+            jax.random.key(0), jnp.asarray(X), jnp.asarray(y, jnp.int32),
+            jnp.ones(len(y)), 2,
+        )
+        direct = np.asarray(lr.predict_scores(params, jnp.asarray(X)).argmax(1))
+        np.testing.assert_array_equal(clf.predict(X), direct)
+
+    def test_seed_determinism(self, iris):
+        X, y = iris
+        a = BaggingClassifier(n_estimators=8, max_features=0.5, seed=3).fit(X, y)
+        b = BaggingClassifier(n_estimators=8, max_features=0.5, seed=3).fit(X, y)
+        np.testing.assert_array_equal(a.predict(X), b.predict(X))
+        np.testing.assert_array_equal(
+            np.asarray(a.subspaces_), np.asarray(b.subspaces_)
+        )
+
+    def test_different_seeds_differ(self, iris):
+        X, y = iris
+        a = BaggingClassifier(n_estimators=4, max_features=0.5, seed=0).fit(X, y)
+        b = BaggingClassifier(n_estimators=4, max_features=0.5, seed=1).fit(X, y)
+        assert not np.array_equal(np.asarray(a.subspaces_), np.asarray(b.subspaces_))
+
+    def test_predict_proba_normalized(self, iris):
+        X, y = iris
+        for voting in ("soft", "hard"):
+            clf = BaggingClassifier(n_estimators=5, voting=voting).fit(X, y)
+            proba = clf.predict_proba(X)
+            assert proba.shape == (len(y), 3)
+            np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_hard_vote_matches_manual_majority(self, iris):
+        X, y = iris
+        clf = BaggingClassifier(n_estimators=7, voting="hard", seed=2).fit(X, y)
+        from spark_bagging_tpu.ensemble import predict_scores_ensemble
+
+        scores = predict_scores_ensemble(
+            clf._fitted_learner, clf.ensemble_, clf.subspaces_, jnp.asarray(X)
+        )
+        manual = np.asarray(scores.argmax(-1))  # (R, n)
+        expected = np.array(
+            [np.bincount(manual[:, i], minlength=3).argmax() for i in range(len(y))]
+        )
+        np.testing.assert_array_equal(clf.predict(X), expected)
+
+    def test_oob_score(self, breast_cancer):
+        X, y = breast_cancer
+        clf = BaggingClassifier(n_estimators=20, oob_score=True, seed=5).fit(X, y)
+        assert 0.9 < clf.oob_score_ <= 1.0
+        assert clf.oob_score_ <= clf.score(X, y) + 0.02  # OOB is held-out-ish
+        assert clf.oob_decision_function_.shape == (len(y), 2)
+
+    def test_string_labels(self, iris):
+        X, y = iris
+        names = np.array(["setosa", "versicolor", "virginica"])[y]
+        clf = BaggingClassifier(n_estimators=5).fit(X, names)
+        assert set(clf.predict(X)) <= set(names)
+        assert clf.score(X, names) > 0.9
+
+    def test_chunked_equals_unchunked(self, iris):
+        X, y = iris
+        a = BaggingClassifier(n_estimators=8, seed=4).fit(X, y)
+        b = BaggingClassifier(n_estimators=8, seed=4, chunk_size=3).fit(X, y)
+        np.testing.assert_allclose(
+            a.predict_proba(X), b.predict_proba(X), atol=1e-5
+        )
+
+    def test_max_features_int_and_float(self, iris):
+        X, y = iris
+        a = BaggingClassifier(n_estimators=4, max_features=2).fit(X, y)
+        b = BaggingClassifier(n_estimators=4, max_features=0.5).fit(X, y)
+        assert a.subspaces_.shape == (4, 2)
+        assert b.subspaces_.shape == (4, 2)
+
+    def test_subsampling_without_replacement(self, iris):
+        X, y = iris
+        clf = BaggingClassifier(
+            n_estimators=6, bootstrap=False, max_samples=0.7
+        ).fit(X, y)
+        assert clf.score(X, y) > 0.85
+
+    def test_sklearn_parity(self, breast_cancer):
+        """Accuracy within tolerance of sklearn's BaggingClassifier at
+        matched hyperparameters — the CI proxy for 'ensemble acc vs
+        Spark-CPU' [B:2, SURVEY §4]."""
+        X, y = breast_cancer
+        ours = BaggingClassifier(n_estimators=10, seed=0).fit(X, y)
+        sk = SkBagging(
+            estimator=SkLogReg(max_iter=2000),
+            n_estimators=10,
+            random_state=0,
+        ).fit(X, y)
+        assert abs(ours.score(X, y) - sk.score(X, y)) < 0.02
+
+    def test_errors(self, iris):
+        X, y = iris
+        with pytest.raises(ValueError, match="n_estimators"):
+            BaggingClassifier(n_estimators=0).fit(X, y)
+        with pytest.raises(ValueError, match="classification"):
+            BaggingClassifier(base_learner=LinearRegression()).fit(X, y)
+        with pytest.raises(RuntimeError, match="not fitted"):
+            BaggingClassifier().predict(X)
+        with pytest.raises(ValueError, match="single class"):
+            BaggingClassifier().fit(X, np.zeros(len(y)))
+        with pytest.raises(ValueError, match="row counts"):
+            BaggingClassifier().fit(X, y[:-1])
+        with pytest.raises(ValueError, match="out-of-bag"):
+            BaggingClassifier(bootstrap=False, oob_score=True).fit(X, y)
+
+    def test_predict_rejects_wrong_feature_count(self, iris):
+        X, y = iris
+        clf = BaggingClassifier(n_estimators=3, max_features=0.5).fit(X, y)
+        with pytest.raises(ValueError, match="features"):
+            clf.predict(X[:, :2])
+
+    def test_set_params_after_fit_does_not_corrupt_predict(self, iris):
+        X, y = iris
+        clf = BaggingClassifier(n_estimators=6).fit(X, y)
+        before = clf.predict_proba(X)
+        clf.set_params(n_estimators=12)  # e.g. grid-search reuse
+        np.testing.assert_allclose(clf.predict_proba(X), before)
+        np.testing.assert_allclose(before.sum(axis=1), 1.0, rtol=1e-5)
+
+
+class TestBaggingRegressor:
+    def test_r2_and_oob(self, diabetes):
+        X, y = diabetes
+        reg = BaggingRegressor(n_estimators=20, oob_score=True, seed=1).fit(X, y)
+        assert reg.score(X, y) > 0.45
+        assert 0.3 < reg.oob_score_ <= reg.score(X, y) + 0.02
+        assert reg.oob_prediction_.shape == (len(y),)
+
+    def test_degenerate_equals_base(self, diabetes):
+        X, y = diabetes
+        reg = BaggingRegressor(n_estimators=1, bootstrap=False).fit(X, y)
+        lin = LinearRegression()
+        params, _ = lin.fit_from_init(
+            jax.random.key(0), jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)), 1
+        )
+        direct = np.asarray(lin.predict_scores(params, jnp.asarray(X)))
+        # float32 reduction-order noise between vmapped and direct fits
+        np.testing.assert_allclose(reg.predict(X), direct, rtol=1e-4, atol=1e-3)
+
+    def test_mean_aggregation(self, diabetes):
+        X, y = diabetes
+        reg = BaggingRegressor(n_estimators=5, seed=2).fit(X, y)
+        from spark_bagging_tpu.ensemble import predict_scores_ensemble
+
+        scores = predict_scores_ensemble(
+            reg._fitted_learner, reg.ensemble_, reg.subspaces_, jnp.asarray(X)
+        )
+        np.testing.assert_allclose(
+            reg.predict(X), np.asarray(scores).mean(axis=0), rtol=1e-5
+        )
+
+    def test_column_vector_y_is_ravelled(self, diabetes):
+        X, y = diabetes
+        a = BaggingRegressor(n_estimators=3).fit(X, y.reshape(-1, 1))
+        b = BaggingRegressor(n_estimators=3).fit(X, y)
+        assert a.predict(X).shape == (len(y),)
+        np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-5)
+        assert a.fit_report_["loss_mean"] == pytest.approx(
+            b.fit_report_["loss_mean"], rel=1e-5
+        )
+        with pytest.raises(ValueError, match="1-D"):
+            BaggingRegressor().fit(X, np.stack([y, y], axis=1))
+
+    def test_fit_report(self, diabetes):
+        X, y = diabetes
+        reg = BaggingRegressor(n_estimators=8).fit(X, y)
+        rep = reg.fit_report_
+        assert rep["n_replicas"] == 8
+        assert rep["fits_per_sec"] > 0
+        assert rep["backend"] == "cpu" and rep["n_devices"] == 8
+
+
+class TestParamsProtocol:
+    def test_roundtrip_and_nested(self):
+        clf = BaggingClassifier(
+            base_learner=LogisticRegression(l2=0.5), n_estimators=3
+        )
+        params = clf.get_params()
+        assert params["base_learner__l2"] == 0.5
+        clf.set_params(base_learner__l2=0.9, n_estimators=4)
+        assert clf.base_learner.l2 == 0.9 and clf.n_estimators == 4
+
+    def test_clone_is_unfitted(self, iris=None):
+        clf = BaggingClassifier(n_estimators=2)
+        X, y = load_iris(return_X_y=True)
+        clf.fit(X.astype(np.float32), y)
+        c = clf.clone()
+        assert not hasattr(c, "ensemble_")
+        assert c.get_params(deep=False) == clf.get_params(deep=False)

@@ -1,0 +1,100 @@
+"""Property tests for the bootstrap engine [SURVEY §4]: Poisson mean,
+OOB fraction ~ e^-1, determinism under fold_in, subspace invariants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_bagging_tpu.ops import (
+    bootstrap_weights,
+    feature_subspaces,
+    oob_mask,
+    replica_keys,
+)
+
+KEY = jax.random.key(42)
+IDS = jnp.arange(16)
+
+
+def test_poisson_weights_mean_matches_ratio():
+    w = bootstrap_weights(KEY, IDS, 4096, ratio=1.0)
+    assert w.shape == (16, 4096)
+    assert abs(float(w.mean()) - 1.0) < 0.02
+    w2 = bootstrap_weights(KEY, IDS, 4096, ratio=0.5)
+    assert abs(float(w2.mean()) - 0.5) < 0.02
+
+
+def test_oob_fraction_is_about_exp_minus_one():
+    w = bootstrap_weights(KEY, IDS, 8192, ratio=1.0)
+    frac = float(oob_mask(w).mean())
+    assert abs(frac - np.exp(-1)) < 0.01
+
+
+def test_weights_deterministic_and_shard_invariant():
+    w_all = bootstrap_weights(KEY, jnp.arange(8), 100)
+    # Generating replicas 4..7 alone must reproduce rows 4..7 exactly —
+    # the shard-local regeneration property.
+    w_back = bootstrap_weights(KEY, jnp.arange(4, 8), 100)
+    np.testing.assert_array_equal(np.asarray(w_all[4:]), np.asarray(w_back))
+
+
+def test_replicas_are_distinct():
+    w = bootstrap_weights(KEY, jnp.arange(4), 1000)
+    assert not np.array_equal(np.asarray(w[0]), np.asarray(w[1]))
+
+
+def test_without_replacement_exact_count():
+    w = bootstrap_weights(KEY, IDS, 1000, ratio=0.6, replacement=False)
+    counts = np.asarray(w.sum(axis=1))
+    np.testing.assert_array_equal(counts, np.full(16, 600.0))
+    assert set(np.unique(np.asarray(w))) <= {0.0, 1.0}
+
+
+def test_without_replacement_full_ratio_is_all_ones():
+    w = bootstrap_weights(KEY, IDS, 50, ratio=1.0, replacement=False)
+    np.testing.assert_array_equal(np.asarray(w), np.ones((16, 50)))
+
+
+def test_without_replacement_zero_rows_raises():
+    with pytest.raises(ValueError):
+        bootstrap_weights(KEY, IDS, 100, ratio=0.001, replacement=False)
+
+
+def test_subspace_without_replacement_unique_and_in_range():
+    idx = np.asarray(feature_subspaces(KEY, IDS, 20, 5))
+    assert idx.shape == (16, 5)
+    assert idx.min() >= 0 and idx.max() < 20
+    for row in idx:
+        assert len(set(row.tolist())) == 5
+
+
+def test_subspace_degenerate_is_identity():
+    idx = np.asarray(feature_subspaces(KEY, jnp.arange(3), 7, 7))
+    np.testing.assert_array_equal(idx, np.tile(np.arange(7), (3, 1)))
+
+
+def test_subspace_with_replacement_in_range():
+    idx = np.asarray(
+        feature_subspaces(KEY, IDS, 10, 30, replacement=True)
+    )
+    assert idx.shape == (16, 30)
+    assert idx.min() >= 0 and idx.max() < 10
+
+
+def test_subspace_stream_independent_of_row_stream():
+    w = bootstrap_weights(KEY, IDS, 100)
+    idx = feature_subspaces(KEY, IDS, 100, 10)
+    # Row weights and feature draws for the same replica must differ
+    # (independent fold_in streams).
+    assert not np.array_equal(
+        np.asarray(w[0, :10]), np.asarray(idx[0]).astype(np.float32)
+    )
+
+
+def test_replica_keys_fold_in():
+    ks = replica_keys(KEY, jnp.arange(4))
+    expected = jax.random.fold_in(KEY, 2)
+    np.testing.assert_array_equal(
+        jax.random.key_data(ks[2]), jax.random.key_data(expected)
+    )

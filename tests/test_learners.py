@@ -1,0 +1,166 @@
+"""Base-learner tests: weighted-fit exactness, sklearn parity, vmap-ability
+[SURVEY §4, §7 hard-part 2]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_iris
+from sklearn.linear_model import LogisticRegression as SkLogReg
+from sklearn.linear_model import Ridge
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu.models import LinearRegression, LogisticRegression
+
+KEY = jax.random.key(0)
+
+
+def _breast_cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y, jnp.int32), X, y
+
+
+def _iris():
+    X, y = load_iris(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y, jnp.int32), X, y
+
+
+class TestLogisticRegression:
+    def test_binary_matches_sklearn(self):
+        Xj, yj, X, y = _breast_cancer()
+        lr = LogisticRegression(l2=1e-3, max_iter=15)
+        params, aux = lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        acc = (np.asarray(lr.predict_scores(params, Xj).argmax(1)) == y).mean()
+        sk_acc = SkLogReg(C=1 / (1e-3 * len(y)), max_iter=2000).fit(X, y).score(X, y)
+        assert acc > 0.97
+        assert abs(acc - sk_acc) < 0.01
+
+    def test_multiclass(self):
+        Xj, yj, X, y = _iris()
+        lr = LogisticRegression()
+        params, aux = lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+        acc = (np.asarray(lr.predict_scores(params, Xj).argmax(1)) == y).mean()
+        assert acc > 0.95
+
+    def test_loss_curve_decreases(self):
+        Xj, yj, X, y = _iris()
+        lr = LogisticRegression()
+        _, aux = lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+        curve = np.asarray(aux["loss_curve"])
+        assert curve[0] == pytest.approx(np.log(3), rel=1e-3)  # zero-init NLL
+        assert np.all(np.diff(curve) <= 1e-6)
+
+    def test_poisson_weights_equal_duplicated_rows(self):
+        """The weighted-fit exactness requirement [SURVEY §7 hard-part 2]:
+        Poisson counts as weights must equal physically duplicating rows."""
+        Xj, yj, X, y = _iris()
+        rng = np.random.default_rng(3)
+        w = rng.poisson(1.0, len(y)).astype(np.float32)
+        lr = LogisticRegression(max_iter=25)
+        pw, _ = lr.fit_from_init(KEY, Xj, yj, jnp.asarray(w), 3)
+        Xd = np.repeat(X, w.astype(int), axis=0)
+        yd = np.repeat(y, w.astype(int))
+        pdup, _ = lr.fit_from_init(
+            KEY, jnp.asarray(Xd), jnp.asarray(yd, jnp.int32),
+            jnp.ones(len(yd)), 3,
+        )
+        pred_w = np.asarray(lr.predict_scores(pw, Xj).argmax(1))
+        pred_d = np.asarray(lr.predict_scores(pdup, Xj).argmax(1))
+        np.testing.assert_array_equal(pred_w, pred_d)
+
+    def test_zero_weight_rows_are_ignored(self):
+        Xj, yj, X, y = _iris()
+        w = np.ones(len(y), np.float32)
+        w[y == 2] = 0.0  # drop class 2 entirely
+        lr = LogisticRegression(max_iter=25)
+        params, _ = lr.fit_from_init(KEY, Xj, yj, jnp.asarray(w), 3)
+        pred = np.asarray(lr.predict_scores(params, Xj).argmax(1))
+        assert not np.any(pred == 2)
+
+    def test_adam_solver(self):
+        Xj, yj, X, y = _breast_cancer()
+        lr = LogisticRegression(solver="adam", max_iter=150, lr=0.3)
+        params, aux = lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        acc = (np.asarray(lr.predict_scores(params, Xj).argmax(1)) == y).mean()
+        assert acc > 0.95
+
+    def test_unknown_solver_raises(self):
+        Xj, yj, _, y = _iris()
+        lr = LogisticRegression(solver="sgd")
+        with pytest.raises(ValueError, match="solver"):
+            lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+
+    def test_vmap_over_replicas(self):
+        Xj, yj, X, y = _iris()
+        lr = LogisticRegression(max_iter=5)
+        rng = np.random.default_rng(0)
+        ws = jnp.asarray(rng.poisson(1.0, (4, len(y))).astype(np.float32))
+        keys = jax.vmap(lambda i: jax.random.fold_in(KEY, i))(jnp.arange(4))
+        params, aux = jax.vmap(
+            lambda k, w: lr.fit_from_init(k, Xj, yj, w, 3)
+        )(keys, ws)
+        assert params["W"].shape == (4, Xj.shape[1] + 1, 3)
+        assert aux["loss"].shape == (4,)
+        # replicas differ
+        assert not np.allclose(np.asarray(params["W"][0]), np.asarray(params["W"][1]))
+
+
+class TestLinearRegression:
+    def test_matches_ridge_closed_form(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 12)).astype(np.float32)
+        beta = rng.normal(size=12)
+        y = (X @ beta + 0.1 * rng.normal(size=300)).astype(np.float32)
+        lin = LinearRegression(l2=1e-6)
+        params, aux = lin.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(300), 1
+        )
+        sk = Ridge(alpha=1e-6 * 300).fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(params["beta"][:-1]), sk.coef_, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            float(params["beta"][-1]), sk.intercept_, atol=1e-3
+        )
+
+    def test_weighted_equals_duplicated(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(100, 5)).astype(np.float32)
+        y = (X.sum(1) + 0.1 * rng.normal(size=100)).astype(np.float32)
+        w = rng.poisson(1.0, 100).astype(np.float32)
+        lin = LinearRegression()
+        pw, _ = lin.fit_from_init(KEY, jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), 1)
+        Xd = np.repeat(X, w.astype(int), axis=0)
+        yd = np.repeat(y, w.astype(int))
+        pdup, _ = lin.fit_from_init(
+            KEY, jnp.asarray(Xd), jnp.asarray(yd), jnp.ones(len(yd)), 1
+        )
+        np.testing.assert_allclose(
+            np.asarray(pw["beta"]), np.asarray(pdup["beta"]), atol=1e-3
+        )
+
+    def test_predict_scores_shape(self):
+        X = jnp.ones((7, 3))
+        lin = LinearRegression()
+        params = {"beta": jnp.arange(4.0)}
+        assert lin.predict_scores(params, X).shape == (7,)
+
+
+class TestLearnerProtocol:
+    def test_hash_eq_by_hyperparams(self):
+        assert LogisticRegression(l2=0.1) == LogisticRegression(l2=0.1)
+        assert LogisticRegression(l2=0.1) != LogisticRegression(l2=0.2)
+        assert hash(LogisticRegression()) == hash(LogisticRegression())
+
+    def test_get_set_params(self):
+        lr = LogisticRegression()
+        lr.set_params(l2=0.5, max_iter=3)
+        assert lr.get_params()["l2"] == 0.5
+        clone = lr.clone()
+        assert clone == lr and clone is not lr
+
+    def test_invalid_param_raises(self):
+        with pytest.raises(ValueError, match="Invalid parameter"):
+            LogisticRegression().set_params(bogus=1)
