@@ -44,6 +44,14 @@ from spark_bagging_tpu.ensemble import (
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
+from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
+from spark_bagging_tpu.parallel.sharded import (
+    pad_rows,
+    pad_rows_X,
+    sharded_fit,
+    sharded_predict_classifier,
+    sharded_predict_regressor,
+)
 from spark_bagging_tpu.utils.metrics import accuracy, fit_report, r2_score
 from spark_bagging_tpu.utils.params import ParamsMixin
 
@@ -66,26 +74,68 @@ def _jitted_fit(learner, n_outputs, sample_ratio, bootstrap, n_subspace,
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_predict_clf(learner, n_classes, n_total, voting, chunk_size):
+def _jitted_sharded_fit(learner, mesh, n_outputs, sample_ratio, bootstrap,
+                        n_subspace, bootstrap_features, chunk_size, n_replicas):
+    return jax.jit(
+        lambda X, y, mask, key: sharded_fit(
+            learner, mesh, X, y, mask, key, n_replicas, n_outputs,
+            sample_ratio=sample_ratio,
+            bootstrap=bootstrap,
+            n_subspace=n_subspace,
+            bootstrap_features=bootstrap_features,
+            chunk_size=chunk_size,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_sharded_predict_clf(learner, mesh, n_classes, n_total, voting,
+                                chunk_size, identity_subspace):
+    return jax.jit(
+        lambda params, subspaces, X: sharded_predict_classifier(
+            learner, mesh, params, subspaces, X, n_classes, n_total,
+            voting=voting, chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_sharded_predict_reg(learner, mesh, n_total, chunk_size,
+                                identity_subspace):
+    return jax.jit(
+        lambda params, subspaces, X: sharded_predict_regressor(
+            learner, mesh, params, subspaces, X, n_total,
+            chunk_size=chunk_size, identity_subspace=identity_subspace,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_predict_clf(learner, n_classes, n_total, voting, chunk_size,
+                        identity_subspace):
     return jax.jit(
         lambda params, subspaces, X: predict_ensemble_classifier(
             learner, params, subspaces, X, n_classes, n_total,
             voting=voting, chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
         )
     )
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_predict_reg(learner, n_total, chunk_size):
+def _jitted_predict_reg(learner, n_total, chunk_size, identity_subspace):
     return jax.jit(
         lambda params, subspaces, X: predict_ensemble_regressor(
-            learner, params, subspaces, X, n_total, chunk_size=chunk_size
+            learner, params, subspaces, X, n_total, chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
         )
     )
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_oob(learner, n_replicas, ratio, replacement, n_classes, chunk_size):
+def _jitted_oob(learner, n_replicas, ratio, replacement, n_classes, chunk_size,
+                identity_subspace):
     return jax.jit(
         lambda params, subspaces, X, key: oob_predict_scores(
             learner, params, subspaces, X, key,
@@ -94,6 +144,7 @@ def _jitted_oob(learner, n_replicas, ratio, replacement, n_classes, chunk_size):
             bootstrap=replacement,
             n_classes=n_classes,
             chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
         )
     )
 
@@ -115,6 +166,7 @@ class _BaseBagging(ParamsMixin):
         oob_score: bool = False,
         seed: int = 0,
         chunk_size: int | None = None,
+        mesh=None,
     ):
         self.base_learner = base_learner
         self.n_estimators = n_estimators
@@ -125,6 +177,7 @@ class _BaseBagging(ParamsMixin):
         self.oob_score = oob_score
         self.seed = seed
         self.chunk_size = chunk_size
+        self.mesh = mesh
 
     # -- helpers -------------------------------------------------------
 
@@ -167,22 +220,55 @@ class _BaseBagging(ParamsMixin):
                 "oob_score requires out-of-bag rows: use bootstrap=True or "
                 "max_samples < 1.0"
             )
+        if (
+            self.oob_score
+            and self.mesh is not None
+            and self.mesh.shape.get(DATA_AXIS, 1) > 1
+        ):
+            # Data-sharded fits draw weights per shard (fold_in on the
+            # data-axis index); the OOB regeneration path is unsharded
+            # and would use a different stream — silently wrong masks.
+            raise ValueError(
+                "oob_score with a data-sharded mesh is not supported yet; "
+                "use a replica-only mesh or oob_score=False"
+            )
         learner = self._learner()
         n_subspace = self._n_subspace(X.shape[1])
         key = jax.random.key(self.seed)
         ids = jnp.arange(self.n_estimators, dtype=jnp.int32)
-        fit_fn = _jitted_fit(
-            learner, n_outputs, float(self.max_samples), bool(self.bootstrap),
-            n_subspace, bool(self.bootstrap_features), self.chunk_size,
-        )
-        # Compile (cached across fits with identical config+shapes).
-        t0 = time.perf_counter()
-        compiled = fit_fn.lower(X, y, key, ids).compile()
-        t_compile = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        params, subspaces, aux = compiled(X, y, key, ids)
-        jax.block_until_ready(params)
-        t_fit = time.perf_counter() - t0
+        if self.mesh is not None:
+            data_size = self.mesh.shape.get(DATA_AXIS, 1)
+            Xp, yp, mask = pad_rows(X, y, data_size)
+            fit_fn = _jitted_sharded_fit(
+                learner, self.mesh, n_outputs, float(self.max_samples),
+                bool(self.bootstrap), n_subspace,
+                bool(self.bootstrap_features), self.chunk_size,
+                self.n_estimators,
+            )
+            t0 = time.perf_counter()
+            compiled = fit_fn.lower(Xp, yp, mask, key).compile()
+            t_compile = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            params, subspaces, aux = compiled(Xp, yp, mask, key)
+            # np.asarray is a device->host barrier; block_until_ready is
+            # not reliable on relayed/remote backends. Losses depend on
+            # every fit, so this forces the whole ensemble.
+            losses_np = np.asarray(aux["loss"])
+            t_fit = time.perf_counter() - t0
+        else:
+            fit_fn = _jitted_fit(
+                learner, n_outputs, float(self.max_samples),
+                bool(self.bootstrap), n_subspace,
+                bool(self.bootstrap_features), self.chunk_size,
+            )
+            # Compile (cached across fits with identical config+shapes).
+            t0 = time.perf_counter()
+            compiled = fit_fn.lower(X, y, key, ids).compile()
+            t_compile = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            params, subspaces, aux = compiled(X, y, key, ids)
+            losses_np = np.asarray(aux["loss"])  # device->host barrier
+            t_fit = time.perf_counter() - t0
 
         self.ensemble_ = params
         self.subspaces_ = subspaces
@@ -193,10 +279,13 @@ class _BaseBagging(ParamsMixin):
         self._fit_key = key
         self._fitted_learner = learner
         self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        self._identity_subspace = (
+            n_subspace == X.shape[1] and not self.bootstrap_features
+        )
         self.fit_report_ = fit_report(
             n_replicas=self.n_estimators,
             fit_seconds=t_fit,
-            losses=np.asarray(aux["loss"]),
+            losses=losses_np,
             n_rows=int(X.shape[0]),
             n_features=int(X.shape[1]),
             n_subspace=n_subspace,
@@ -211,7 +300,7 @@ class _BaseBagging(ParamsMixin):
         ratio, replacement = self._fit_sampling
         agg, votes = _jitted_oob(
             self._fitted_learner, self.n_estimators_, ratio, replacement,
-            n_classes, self.chunk_size,
+            n_classes, self.chunk_size, self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X, self._fit_key)
         return np.asarray(agg), np.asarray(votes)
 
@@ -240,10 +329,11 @@ class BaggingClassifier(_BaseBagging):
         oob_score: bool = False,
         seed: int = 0,
         chunk_size: int | None = None,
+        mesh=None,
     ):
         super().__init__(
             base_learner, n_estimators, max_samples, bootstrap, max_features,
-            bootstrap_features, oob_score, seed, chunk_size,
+            bootstrap_features, oob_score, seed, chunk_size, mesh,
         )
         self.voting = voting
 
@@ -270,9 +360,18 @@ class BaggingClassifier(_BaseBagging):
     def predict_proba(self, X) -> np.ndarray:
         self._check_fitted()
         X = self._validate_X(X, fitted=True)
+        n = X.shape[0]
+        if self.mesh is not None:
+            X = pad_rows_X(X, self.mesh.shape.get(DATA_AXIS, 1))
+            proba = _jitted_sharded_predict_clf(
+                self._fitted_learner, self.mesh, self.n_classes_,
+                self.n_estimators_, self.voting, self.chunk_size,
+                self._identity_subspace,
+            )(self.ensemble_, self.subspaces_, X)
+            return np.asarray(proba)[:n]
         proba = _jitted_predict_clf(
             self._fitted_learner, self.n_classes_, self.n_estimators_,
-            self.voting, self.chunk_size,
+            self.voting, self.chunk_size, self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(proba)
 
@@ -314,8 +413,17 @@ class BaggingRegressor(_BaseBagging):
     def predict(self, X) -> np.ndarray:
         self._check_fitted()
         X = self._validate_X(X, fitted=True)
+        n = X.shape[0]
+        if self.mesh is not None:
+            X = pad_rows_X(X, self.mesh.shape.get(DATA_AXIS, 1))
+            pred = _jitted_sharded_predict_reg(
+                self._fitted_learner, self.mesh, self.n_estimators_,
+                self.chunk_size, self._identity_subspace,
+            )(self.ensemble_, self.subspaces_, X)
+            return np.asarray(pred)[:n]
         pred = _jitted_predict_reg(
-            self._fitted_learner, self.n_estimators_, self.chunk_size
+            self._fitted_learner, self.n_estimators_, self.chunk_size,
+            self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(pred)
 

@@ -68,9 +68,13 @@ def fit_ensemble(
     bootstrap_features: bool = False,
     data_axis: str | None = None,
     chunk_size: int | None = None,
+    row_mask: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, dict[str, jax.Array]]:
     """Fit all replicas in ``replica_ids``; the reference's ``train()``
     loop [SURVEY §3.1] as one XLA program.
+
+    ``row_mask`` (0/1 per row) multiplies into every replica's sample
+    weights — used to neutralize padding rows added for even sharding.
 
     Returns ``(stacked_params, subspaces, aux)`` where ``stacked_params``
     has a leading replica axis on every leaf, ``subspaces`` is
@@ -87,6 +91,10 @@ def fit_ensemble(
     n_rows, n_features = X.shape
     if n_subspace is None:
         n_subspace = n_features
+    # Identity subspace ⇒ no per-replica gather: X stays a vmap constant
+    # (one HBM copy broadcast to all replicas) instead of materializing a
+    # (chunk, n, d) gathered copy per replica [SURVEY §7 hard-part 3].
+    identity_subspace = n_subspace == n_features and not bootstrap_features
 
     row_key = key
     if data_axis is not None:
@@ -96,12 +104,15 @@ def fit_ensemble(
         w = bootstrap_weights_one(
             row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
         )
+        if row_mask is not None:
+            w = w * row_mask
         idx = feature_subspace_one(
             key, rid, n_features, n_subspace, replacement=bootstrap_features
         )
+        Xs = X if identity_subspace else X[:, idx]
         params, aux = learner.fit_from_init(
             fit_key(key, rid),
-            X[:, idx],
+            Xs,
             y,
             w,
             n_outputs,
@@ -120,16 +131,19 @@ def predict_scores_ensemble(
     X: jax.Array,
     *,
     chunk_size: int | None = None,
+    identity_subspace: bool = False,
 ) -> jax.Array:
     """Per-replica scores: ``(R, n, C)`` logits or ``(R, n)`` values.
 
     The reference's per-row × per-model UDF loop [SURVEY §3.2] as one
-    batched forward.
+    batched forward. ``identity_subspace=True`` (full feature set, no
+    resampling) skips the per-replica gather so X is broadcast, not
+    copied per replica.
     """
 
     def score_one(args):
         params, idx = args
-        return learner.predict_scores(params, X[:, idx])
+        return learner.predict_scores(params, X if identity_subspace else X[:, idx])
 
     if chunk_size is None:
         return jax.vmap(score_one)((stacked_params, subspaces))
@@ -149,6 +163,7 @@ def predict_ensemble_classifier(
     voting: str = "soft",
     replica_axis: str | None = None,
     chunk_size: int | None = None,
+    identity_subspace: bool = False,
 ) -> jax.Array:
     """Aggregated class probabilities ``(n, C)``.
 
@@ -157,7 +172,8 @@ def predict_ensemble_classifier(
     vote aggregation [B:5].
     """
     scores = predict_scores_ensemble(
-        learner, stacked_params, subspaces, X, chunk_size=chunk_size
+        learner, stacked_params, subspaces, X,
+        chunk_size=chunk_size, identity_subspace=identity_subspace,
     )
     if voting == "soft":
         return soft_vote_proba(
@@ -182,10 +198,12 @@ def predict_ensemble_regressor(
     *,
     replica_axis: str | None = None,
     chunk_size: int | None = None,
+    identity_subspace: bool = False,
 ) -> jax.Array:
     """Mean-aggregated predictions ``(n,)`` [B:5]."""
     scores = predict_scores_ensemble(
-        learner, stacked_params, subspaces, X, chunk_size=chunk_size
+        learner, stacked_params, subspaces, X,
+        chunk_size=chunk_size, identity_subspace=identity_subspace,
     )
     return mean_aggregate(scores, n_total=n_total, axis_name=replica_axis)
 
@@ -202,6 +220,7 @@ def oob_predict_scores(
     bootstrap: bool = True,
     n_classes: int | None = None,
     chunk_size: int | None = None,
+    identity_subspace: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Out-of-bag aggregation for ``oob_score`` [SURVEY §4].
 
@@ -222,7 +241,9 @@ def oob_predict_scores(
             key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
         )
         mask = oob_mask(w).astype(jnp.float32)
-        scores = learner.predict_scores(params, X[:, idx])
+        scores = learner.predict_scores(
+            params, X if identity_subspace else X[:, idx]
+        )
         if classification:
             onehot = jax.nn.one_hot(
                 jnp.argmax(scores, axis=-1), n_classes, dtype=jnp.float32

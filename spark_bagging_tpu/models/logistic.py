@@ -34,6 +34,12 @@ from spark_bagging_tpu.models.base import Aux, BaseLearner, Params
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _BIAS_JITTER = 1e-6  # keeps the softmax gauge direction solvable
+# Levenberg-style damping added to the Hessian diagonal AT SOLVE TIME
+# only (the gradient stays exact, so the optimum is unchanged — steps
+# are mildly damped). Without it the unpenalized-bias gauge direction
+# leaves eigmin(H) ≈ 1e-6; float32 matmul noise can push it negative
+# and NaN the Cholesky — observed on TPU with small, separable bags.
+_SOLVER_DAMPING = 1e-3
 
 
 def _augment(X: jax.Array) -> jax.Array:
@@ -140,7 +146,9 @@ class LogisticRegression(BaseLearner):
                     blocks[c][cp] = Hb
                     if cp != c:
                         blocks[cp][c] = Hb
-            H = jnp.block(blocks) / w_sum + jnp.diag(pen_cd + 1e-8)
+            H = jnp.block(blocks) / w_sum + jnp.diag(
+                pen_cd + _SOLVER_DAMPING
+            )
             delta = jax.scipy.linalg.solve(
                 H, G.T.reshape(-1), assume_a="pos"
             )

@@ -1,0 +1,33 @@
+"""Device-mesh parallelism: the TPU-native replacement for the
+reference's two scaling mechanisms [SURVEY §2c] —
+
+- driver-side concurrent futures over replicas  → replica-axis sharding
+  (``shard_map`` over the ``replica`` mesh axis, ``vmap`` within),
+- Spark row-partition data parallelism          → data-axis sharding
+  (rows over the ``data`` mesh axis, learner stats ``psum``'d).
+
+Collectives ride ICI within a slice and DCN across hosts, reached only
+through JAX (``shard_map`` + ``lax.psum``) [SURVEY §5 comms backend].
+"""
+
+from spark_bagging_tpu.parallel.mesh import (
+    DATA_AXIS,
+    REPLICA_AXIS,
+    make_mesh,
+)
+from spark_bagging_tpu.parallel.sharded import (
+    sharded_fit,
+    sharded_predict_classifier,
+    sharded_predict_regressor,
+)
+from spark_bagging_tpu.parallel.distributed import initialize_distributed
+
+__all__ = [
+    "DATA_AXIS",
+    "REPLICA_AXIS",
+    "make_mesh",
+    "sharded_fit",
+    "sharded_predict_classifier",
+    "sharded_predict_regressor",
+    "initialize_distributed",
+]

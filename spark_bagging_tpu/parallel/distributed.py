@@ -1,0 +1,47 @@
+"""Multi-host initialization — the Spark cluster-manager analog.
+
+The reference scales across a cluster via Spark's driver/executor
+runtime [SURVEY §1 L1]; multi-host TPU pods are instead joined with
+``jax.distributed.initialize`` (one process per host, XLA collectives
+over ICI/DCN after that) [SURVEY §5 comms backend, B:11]. This wrapper
+exists so applications have a single entry point that is safe to call
+in single-process runs.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join a multi-host run if configured; return global device count.
+
+    With no arguments and no TPU-pod environment this is a no-op (single
+    process). On a pod slice, JAX auto-detects everything from the TPU
+    runtime environment.
+    """
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        elif jax.process_count() > 1:
+            pass  # already initialized by the runtime
+    except RuntimeError as e:  # already initialized
+        log.debug("jax.distributed.initialize skipped: %s", e)
+    log.info(
+        "distributed: %d process(es), %d global device(s)",
+        jax.process_count(),
+        jax.device_count(),
+    )
+    return jax.device_count()

@@ -1,0 +1,47 @@
+"""Mesh construction for the 2-D ``(data, replica)`` layout [SURVEY §2c].
+
+The design point from the survey: on small-data/many-replica configs the
+mesh is all ``replica`` (e.g. v5e-8 → ``(1, 8)``, 128 replicas per core
+``vmap``'d [B:9-10]); on Criteo-scale data it is all ``data`` (v5p-64 →
+``(64, 1)``, all replicas resident per core [B:11]); anything between is
+a rectangle of the two.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+REPLICA_AXIS = "replica"
+
+
+def make_mesh(
+    data: int = 1,
+    replica: int | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``(data, replica)`` mesh over ``devices``.
+
+    ``replica=None`` uses all remaining devices on the replica axis —
+    the right default for the fits/sec north star [B:2], where replicas
+    are the abundant parallel axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if replica is None:
+        if n % data != 0:
+            raise ValueError(f"{n} devices not divisible by data={data}")
+        replica = n // data
+    if data * replica != n:
+        raise ValueError(
+            f"mesh {data}x{replica} needs {data * replica} devices, "
+            f"got {n}"
+        )
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(data, replica)
+    return Mesh(dev_array, (DATA_AXIS, REPLICA_AXIS))

@@ -1,0 +1,214 @@
+"""``shard_map`` wrappers: ensemble fit/predict over a (data, replica) mesh.
+
+The sharding plan [SURVEY §2c, B:5]:
+
+- ``X``    → ``P(data, None)``: rows sharded over the data axis,
+  replicated over the replica axis (bagging broadcasts the dataset to
+  every replica group — no shuffle exists or is needed).
+- ``y``    → ``P(data)``.
+- replica ids → ``P(replica)``: each replica-group fits its slice of
+  the ensemble with plain ``vmap`` locally.
+- fitted params / subspaces / losses → ``P(replica)`` on the leading
+  (replica) axis.
+- predictions → ``P(data)``: vote/mean reductions ``psum`` over the
+  replica axis, row shards stay put.
+
+Inside the shards the single-device engine runs unchanged — learners
+``psum`` their row statistics over ``data`` (so every replica's fit is
+exactly the global-data fit), aggregation ``psum``s over ``replica``.
+
+Divisibility: callers pad rows (with ``row_weight=0`` via the padding
+mask) and must choose ``n_estimators`` divisible by the replica-axis
+size; both are validated here with explicit errors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_bagging_tpu.ensemble import (
+    fit_ensemble,
+    predict_ensemble_classifier,
+    predict_ensemble_regressor,
+)
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
+
+
+def _axis_sizes(mesh: Mesh) -> tuple[int, int]:
+    data = mesh.shape.get(DATA_AXIS, 1)
+    replica = mesh.shape.get(REPLICA_AXIS, 1)
+    return data, replica
+
+
+def _check_divisible(n_rows: int, n_replicas: int, mesh: Mesh) -> None:
+    data, replica = _axis_sizes(mesh)
+    if n_rows % data != 0:
+        raise ValueError(
+            f"{n_rows} rows not divisible by data-axis size {data}; pad "
+            f"rows first (pad_rows)"
+        )
+    if n_replicas % replica != 0:
+        raise ValueError(
+            f"n_estimators={n_replicas} not divisible by replica-axis "
+            f"size {replica}"
+        )
+
+
+def pad_rows_X(X, multiple: int) -> jnp.ndarray:
+    """Pad only X's rows to a multiple (predict path — no y/mask needed;
+    padded predictions are sliced off by the caller)."""
+    rem = (-X.shape[0]) % multiple
+    if rem == 0:
+        return X
+    return jnp.concatenate([X, jnp.zeros((rem, X.shape[1]), X.dtype)])
+
+
+def pad_rows(
+    X, y, multiple: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pad rows to a multiple; returns (X, y, row_mask) with mask 0 on
+    padding so padded rows carry zero sample weight everywhere."""
+    n = X.shape[0]
+    rem = (-n) % multiple
+    mask = jnp.ones((n,), jnp.float32)
+    if rem == 0:
+        return X, y, mask
+    Xp = jnp.concatenate([X, jnp.zeros((rem, X.shape[1]), X.dtype)])
+    yp = jnp.concatenate([y, jnp.zeros((rem,), y.dtype)])
+    maskp = jnp.concatenate([mask, jnp.zeros((rem,), jnp.float32)])
+    return Xp, yp, maskp
+
+
+def sharded_fit(
+    learner: BaseLearner,
+    mesh: Mesh,
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    row_mask: jnp.ndarray,
+    key: jax.Array,
+    n_replicas: int,
+    n_outputs: int,
+    *,
+    sample_ratio: float = 1.0,
+    bootstrap: bool = True,
+    n_subspace: int | None = None,
+    bootstrap_features: bool = False,
+    chunk_size: int | None = None,
+) -> tuple[Any, jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Ensemble fit over the mesh; same contract as
+    :func:`spark_bagging_tpu.ensemble.fit_ensemble`.
+
+    The returned params/subspaces keep their global replica axis
+    (sharded ``P(replica)`` on device); losses likewise.
+    """
+    _check_divisible(X.shape[0], n_replicas, mesh)
+    data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),   # X rows
+            P(DATA_AXIS),         # y
+            P(DATA_AXIS),         # row mask
+            P(),                  # key (replicated)
+            P(REPLICA_AXIS),      # replica ids
+        ),
+        out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
+        # jax.random.poisson's internal while_loop mixes replica-varying
+        # keys with unvarying carry inits and fails the VMA type check;
+        # disable it (costs only the replication-tracking optimization).
+        check_vma=False,
+    )
+    def _fit(Xs, ys, mask, k, ids):
+        params, subspaces, aux = fit_ensemble(
+            learner, Xs, ys, k, ids, n_outputs,
+            sample_ratio=sample_ratio,
+            bootstrap=bootstrap,
+            n_subspace=n_subspace,
+            bootstrap_features=bootstrap_features,
+            data_axis=data_axis,
+            chunk_size=chunk_size,
+            row_mask=mask,
+        )
+        return params, subspaces, aux["loss"]
+
+    ids = jnp.arange(n_replicas, dtype=jnp.int32)
+    params, subspaces, losses = _fit(X, y, row_mask, key, ids)
+    return params, subspaces, {"loss": losses}
+
+
+def sharded_predict_classifier(
+    learner: BaseLearner,
+    mesh: Mesh,
+    stacked_params: Any,
+    subspaces: jnp.ndarray,
+    X: jnp.ndarray,
+    n_classes: int,
+    n_total: int,
+    *,
+    voting: str = "soft",
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+) -> jnp.ndarray:
+    """Aggregated probabilities ``(n, C)`` with replica-axis ``psum``
+    [B:5]; rows stay sharded over the data axis."""
+    _check_divisible(X.shape[0], n_total, mesh)
+    replica_axis = REPLICA_AXIS if mesh.shape.get(REPLICA_AXIS, 1) > 1 else None
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS, None),
+        check_vma=False,
+    )
+    def _predict(params, subs, Xs):
+        return predict_ensemble_classifier(
+            learner, params, subs, Xs, n_classes, n_total,
+            voting=voting,
+            replica_axis=replica_axis,
+            chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
+        )
+
+    return _predict(stacked_params, subspaces, X)
+
+
+def sharded_predict_regressor(
+    learner: BaseLearner,
+    mesh: Mesh,
+    stacked_params: Any,
+    subspaces: jnp.ndarray,
+    X: jnp.ndarray,
+    n_total: int,
+    *,
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+) -> jnp.ndarray:
+    """Mean-aggregated predictions ``(n,)`` over the mesh [B:5]."""
+    _check_divisible(X.shape[0], n_total, mesh)
+    replica_axis = REPLICA_AXIS if mesh.shape.get(REPLICA_AXIS, 1) > 1 else None
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    def _predict(params, subs, Xs):
+        return predict_ensemble_regressor(
+            learner, params, subs, Xs, n_total,
+            replica_axis=replica_axis,
+            chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
+        )
+
+    return _predict(stacked_params, subspaces, X)

@@ -1,0 +1,197 @@
+"""Dataset registry + host-side ingestion (the L2 DataFrame analog).
+
+The reference reads libsvm/CSV via Spark DataFrames [SURVEY §4]; here
+ingestion is host numpy/Arrow → ``jax.device_put`` [B:5, SURVEY §1 L2].
+This module provides:
+
+- parsers for libsvm and CSV files (the reference's test-fixture
+  formats [SURVEY §4]),
+- deterministic synthetic generators shaped like the five baseline
+  configs [B:7-11] — the build environment has **zero network egress**,
+  so covtype/HIGGS/Criteo/California-housing cannot be downloaded; the
+  synthetics match their (rows, features, classes) signatures and are
+  documented as stand-ins in BASELINE.md,
+- a ``load_dataset(name)`` registry over bundled sklearn data, local
+  files, and the synthetics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import numpy as np
+
+# ---------------------------------------------------------------------
+# File parsers
+# ---------------------------------------------------------------------
+
+
+def parse_libsvm(
+    path: str, n_features: int | None = None, zero_based: bool = False
+) -> tuple[np.ndarray, np.ndarray]:
+    """Parse a (dense-ified) libsvm file: ``label idx:val idx:val ...``.
+
+    The reference's CPU anchor config reads libsvm breast-cancer [B:7].
+    """
+    labels: list[float] = []
+    rows: list[dict[int, float]] = []
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            entries: dict[int, float] = {}
+            for item in parts[1:]:
+                idx_s, val_s = item.split(":")
+                idx = int(idx_s) - (0 if zero_based else 1)
+                entries[idx] = float(val_s)
+                max_idx = max(max_idx, idx)
+            rows.append(entries)
+    d = n_features if n_features is not None else max_idx + 1
+    X = np.zeros((len(rows), d), np.float32)
+    for i, entries in enumerate(rows):
+        for j, v in entries.items():
+            if j < d:
+                X[i, j] = v
+    return X, np.asarray(labels, np.float32)
+
+
+def load_csv(
+    path: str, *, label_col: int = -1, skip_header: bool = False
+) -> tuple[np.ndarray, np.ndarray]:
+    """Load a numeric CSV into (X, y)."""
+    data = np.genfromtxt(
+        path, delimiter=",", skip_header=1 if skip_header else 0,
+        dtype=np.float32,
+    )
+    if data.ndim == 1:
+        data = data[None, :]
+    y = data[:, label_col]
+    X = np.delete(data, label_col % data.shape[1], axis=1)
+    return np.ascontiguousarray(X), y
+
+
+# ---------------------------------------------------------------------
+# Synthetic generators (deterministic in seed)
+# ---------------------------------------------------------------------
+
+
+def make_classification(
+    n_rows: int,
+    n_features: int,
+    n_classes: int,
+    *,
+    seed: int = 0,
+    class_sep: float = 1.2,
+    class_imbalance: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Gaussian-mixture classification data: one random center per class,
+    unit-variance clouds. ``class_sep`` controls difficulty."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0.0, class_sep, (n_classes, n_features)).astype(
+        np.float32
+    )
+    if class_imbalance:
+        p = rng.dirichlet(np.full(n_classes, 2.0))
+    else:
+        p = np.full(n_classes, 1.0 / n_classes)
+    y = rng.choice(n_classes, size=n_rows, p=p).astype(np.int32)
+    X = rng.standard_normal((n_rows, n_features), np.float32)
+    X += centers[y]
+    return X, y
+
+
+def make_regression(
+    n_rows: int,
+    n_features: int,
+    *,
+    seed: int = 0,
+    noise: float = 0.5,
+) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    beta = rng.normal(0.0, 1.0, n_features).astype(np.float32)
+    X = rng.standard_normal((n_rows, n_features), np.float32)
+    y = X @ beta + noise * rng.standard_normal(n_rows).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def synthetic_covtype(n_rows: int = 581_012, seed: int = 7) -> tuple[np.ndarray, np.ndarray]:
+    """covtype-581k signature: 54 features, 7 classes, imbalanced [B:9].
+
+    ``class_sep=0.3`` calibrated so single LogisticRegression accuracy
+    ≈ 0.78 — matching the difficulty of real covtype for linear models
+    (≈0.72), so benchmark fits do realistic solver work.
+    """
+    return make_classification(
+        n_rows, 54, 7, seed=seed, class_sep=0.3, class_imbalance=True
+    )
+
+
+def synthetic_higgs(n_rows: int = 11_000_000, seed: int = 11) -> tuple[np.ndarray, np.ndarray]:
+    """HIGGS-11M signature: 28 features, binary [B:10]."""
+    return make_classification(n_rows, 28, 2, seed=seed, class_sep=0.6)
+
+
+def synthetic_criteo(
+    n_rows: int = 1_000_000, n_features: int = 1024, seed: int = 13
+) -> tuple[np.ndarray, np.ndarray]:
+    """Criteo-shaped signature: wide hashed-categorical-style features,
+    binary CTR labels [B:11]. Dense stand-in at configurable width."""
+    return make_classification(
+        n_rows, n_features, 2, seed=seed, class_sep=0.25, class_imbalance=True
+    )
+
+
+def synthetic_california(n_rows: int = 20_640, seed: int = 5) -> tuple[np.ndarray, np.ndarray]:
+    """California-housing signature: 8 features, regression [B:8]."""
+    return make_regression(n_rows, 8, seed=seed, noise=0.7)
+
+
+# ---------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------
+
+
+def _sklearn_loader(name: str) -> Callable[[], tuple[np.ndarray, np.ndarray]]:
+    def load():
+        from sklearn import datasets as skd
+
+        X, y = getattr(skd, f"load_{name}")(return_X_y=True)
+        return X.astype(np.float32), y
+
+    return load
+
+
+_REGISTRY: dict[str, Callable[..., tuple[np.ndarray, np.ndarray]]] = {
+    # bundled with sklearn — always available offline
+    "breast_cancer": _sklearn_loader("breast_cancer"),
+    "iris": _sklearn_loader("iris"),
+    "diabetes": _sklearn_loader("diabetes"),
+    "wine": _sklearn_loader("wine"),
+    "digits": _sklearn_loader("digits"),
+    # baseline-config synthetics (stand-ins; see module docstring)
+    "covtype_synth": synthetic_covtype,
+    "higgs_synth": synthetic_higgs,
+    "criteo_synth": synthetic_criteo,
+    "california_synth": synthetic_california,
+}
+
+
+def load_dataset(name: str, **kwargs) -> tuple[np.ndarray, np.ndarray]:
+    """Load a dataset by registry name, or from a local ``.svm``/``.csv``
+    path. Raises KeyError with the available names otherwise."""
+    if name in _REGISTRY:
+        return _REGISTRY[name](**kwargs)
+    if os.path.exists(name):
+        if name.endswith((".svm", ".libsvm", ".txt")):
+            return parse_libsvm(name, **kwargs)
+        if name.endswith(".csv"):
+            return load_csv(name, **kwargs)
+        raise ValueError(f"unknown file format: {name}")
+    raise KeyError(
+        f"unknown dataset {name!r}; available: {sorted(_REGISTRY)}"
+    )

@@ -1,0 +1,160 @@
+"""Sharding tests on the 8-virtual-device CPU mesh — the `local[*]`
+analog [SURVEY §4]: replica sharding, data sharding, and the combined
+2-D mesh must reproduce (or statistically match) single-device results."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.parallel import make_mesh
+from spark_bagging_tpu.parallel.sharded import pad_rows
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    return StandardScaler().fit_transform(X).astype(np.float32), y
+
+
+@pytest.fixture(scope="module")
+def diabetes():
+    X, y = load_diabetes(return_X_y=True)
+    return (
+        StandardScaler().fit_transform(X).astype(np.float32),
+        y.astype(np.float32),
+    )
+
+
+def test_make_mesh_shapes():
+    m = make_mesh()  # all-replica default
+    assert m.shape == {"data": 1, "replica": 8}
+    m2 = make_mesh(data=4)
+    assert m2.shape == {"data": 4, "replica": 2}
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh(data=3)
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(data=2, replica=2)
+
+
+def test_pad_rows():
+    X = jnp.ones((10, 3))
+    y = jnp.arange(10.0)
+    Xp, yp, mask = pad_rows(X, y, 8)
+    assert Xp.shape == (16, 3) and yp.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(mask), [1.0] * 10 + [0.0] * 6)
+    Xn, yn, mn = pad_rows(X, y, 5)
+    assert Xn.shape == (10, 3) and float(mn.sum()) == 10
+
+
+def test_replica_sharded_fit_matches_unsharded(breast_cancer):
+    """Pure replica sharding is bit-compatible with single-device vmap:
+    replica identity derives only from (seed, replica_id)."""
+    X, y = breast_cancer
+    mesh = make_mesh()  # (1, 8)
+    a = BaggingClassifier(n_estimators=16, seed=3, mesh=mesh).fit(X, y)
+    b = BaggingClassifier(n_estimators=16, seed=3).fit(X, y)
+    np.testing.assert_array_equal(
+        np.asarray(a.subspaces_), np.asarray(b.subspaces_)
+    )
+    # Compare the gauge-invariant part of W (softmax is invariant to
+    # adding a per-feature constant across classes; the bias-jitter
+    # near-null direction amplifies float32 noise in raw W).
+    Wa = np.asarray(a.ensemble_["W"])
+    Wb = np.asarray(b.ensemble_["W"])
+    np.testing.assert_allclose(
+        Wa - Wa.mean(-1, keepdims=True),
+        Wb - Wb.mean(-1, keepdims=True),
+        rtol=0, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        a.predict_proba(X), b.predict_proba(X), atol=2e-4
+    )
+
+
+def test_data_sharded_fit_exact_with_deterministic_weights(breast_cancer):
+    """With bootstrap=False + max_samples=1.0 the weights are all-ones,
+    so the psum'd data-parallel Newton must reproduce the single-device
+    fit exactly (up to float32 noise)."""
+    X, y = breast_cancer
+    n = (len(y) // 8) * 8  # avoid padding so draws are comparable
+    X, y = X[:n], y[:n]
+    kw = dict(n_estimators=8, bootstrap=False, max_samples=1.0, seed=0)
+    a = BaggingClassifier(**kw, mesh=make_mesh(data=8)).fit(X, y)
+    b = BaggingClassifier(**kw).fit(X, y)
+    assert a.fit_report_["loss_mean"] == pytest.approx(
+        b.fit_report_["loss_mean"], rel=1e-5
+    )
+    np.testing.assert_allclose(
+        a.predict_proba(X), b.predict_proba(X), atol=1e-5
+    )
+
+
+def test_data_sharded_fit_classifier(breast_cancer):
+    """Data-parallel bootstrap fit: draws differ by shard layout
+    (documented) but accuracy must match statistically."""
+    X, y = breast_cancer
+    mesh = make_mesh(data=8)  # (8, 1)
+    clf = BaggingClassifier(n_estimators=10, seed=0, mesh=mesh).fit(X, y)
+    ref = BaggingClassifier(n_estimators=10, seed=0).fit(X, y)
+    assert abs(clf.score(X, y) - ref.score(X, y)) < 0.02
+
+
+def test_2d_mesh_fit_and_predict(breast_cancer):
+    """The full (data=2, replica=4) rectangle [SURVEY §2c mesh design]."""
+    X, y = breast_cancer
+    mesh = make_mesh(data=2)
+    clf = BaggingClassifier(
+        n_estimators=8, seed=1, mesh=mesh, max_features=0.8
+    ).fit(X, y)
+    assert clf.score(X, y) > 0.95
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_2d_mesh_regressor(diabetes):
+    X, y = diabetes
+    mesh = make_mesh(data=2)
+    reg = BaggingRegressor(n_estimators=12, seed=2, mesh=mesh).fit(X, y)
+    ref = BaggingRegressor(n_estimators=12, seed=2).fit(X, y)
+    assert abs(reg.score(X, y) - ref.score(X, y)) < 0.05
+    assert reg.predict(X).shape == (len(y),)
+
+
+def test_indivisible_replicas_raises(breast_cancer):
+    X, y = breast_cancer
+    mesh = make_mesh()  # replica axis 8
+    with pytest.raises(ValueError, match="divisible"):
+        BaggingClassifier(n_estimators=10, mesh=mesh).fit(X, y)
+
+
+def test_oob_on_data_sharded_mesh_raises(breast_cancer):
+    X, y = breast_cancer
+    with pytest.raises(ValueError, match="data-sharded"):
+        BaggingClassifier(
+            n_estimators=8, oob_score=True, mesh=make_mesh(data=8)
+        ).fit(X, y)
+
+
+def test_oob_on_replica_mesh_matches_unsharded(breast_cancer):
+    """Replica-only meshes draw weights from the unfolded key over global
+    rows — identical stream to the OOB regeneration path."""
+    X, y = breast_cancer
+    a = BaggingClassifier(
+        n_estimators=16, oob_score=True, seed=5, mesh=make_mesh()
+    ).fit(X, y)
+    b = BaggingClassifier(n_estimators=16, oob_score=True, seed=5).fit(X, y)
+    assert a.oob_score_ == pytest.approx(b.oob_score_, abs=1e-6)
+
+
+def test_hard_vote_on_mesh(breast_cancer):
+    X, y = breast_cancer
+    mesh = make_mesh()
+    clf = BaggingClassifier(
+        n_estimators=16, voting="hard", seed=5, mesh=mesh
+    ).fit(X, y)
+    assert clf.score(X, y) > 0.95
