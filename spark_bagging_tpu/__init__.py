@@ -22,6 +22,8 @@ from spark_bagging_tpu.models import (
     LinearRegression,
     LogisticRegression,
 )
+from spark_bagging_tpu.parallel import make_mesh
+from spark_bagging_tpu.utils.checkpoint import load_model, save_model
 
 __version__ = "0.1.0"
 
@@ -31,4 +33,7 @@ __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "make_mesh",
+    "save_model",
+    "load_model",
 ]

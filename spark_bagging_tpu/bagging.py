@@ -28,6 +28,7 @@ the subspace index matrix — one checkpointable object [SURVEY §3.3].
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from typing import Any
 
@@ -54,6 +55,7 @@ from spark_bagging_tpu.parallel.sharded import (
 )
 from spark_bagging_tpu.utils.metrics import accuracy, fit_report, r2_score
 from spark_bagging_tpu.utils.params import ParamsMixin
+from spark_bagging_tpu.utils.profiling import log_timing
 
 
 @functools.lru_cache(maxsize=256)
@@ -206,6 +208,26 @@ class _BaseBagging(ParamsMixin):
             )
         return X
 
+    def save(self, path: str) -> None:
+        """Persist the fitted ensemble (manifest + msgpack pytree)
+        [SURVEY §3.3]."""
+        from spark_bagging_tpu.utils.checkpoint import save_model
+
+        save_model(self, path)
+
+    @classmethod
+    def load(cls, path: str, *, mesh=None):
+        """Load a fitted ensemble saved with :meth:`save`."""
+        from spark_bagging_tpu.utils.checkpoint import load_model
+
+        model = load_model(path, mesh=mesh)
+        if not isinstance(model, cls):
+            raise TypeError(
+                f"checkpoint at {path} holds {type(model).__name__}, "
+                f"not {cls.__name__}"
+            )
+        return model
+
     def _check_fitted(self):
         if not hasattr(self, "ensemble_"):
             raise RuntimeError(
@@ -246,7 +268,8 @@ class _BaseBagging(ParamsMixin):
                 self.n_estimators,
             )
             t0 = time.perf_counter()
-            compiled = fit_fn.lower(Xp, yp, mask, key).compile()
+            with log_timing("sharded ensemble compile", logging.DEBUG):
+                compiled = fit_fn.lower(Xp, yp, mask, key).compile()
             t_compile = time.perf_counter() - t0
             t0 = time.perf_counter()
             params, subspaces, aux = compiled(Xp, yp, mask, key)
@@ -263,7 +286,8 @@ class _BaseBagging(ParamsMixin):
             )
             # Compile (cached across fits with identical config+shapes).
             t0 = time.perf_counter()
-            compiled = fit_fn.lower(X, y, key, ids).compile()
+            with log_timing("ensemble compile", logging.DEBUG):
+                compiled = fit_fn.lower(X, y, key, ids).compile()
             t_compile = time.perf_counter() - t0
             t0 = time.perf_counter()
             params, subspaces, aux = compiled(X, y, key, ids)

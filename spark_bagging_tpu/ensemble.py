@@ -41,6 +41,7 @@ from spark_bagging_tpu.ops.bootstrap import (
     fit_key,
     oob_mask,
 )
+from spark_bagging_tpu.utils.profiling import named_scope
 
 
 def _map_replicas(fn, replica_ids: jax.Array, chunk_size: int | None):
@@ -101,23 +102,25 @@ def fit_ensemble(
         row_key = jax.random.fold_in(key, jax.lax.axis_index(data_axis))
 
     def fit_one(rid):
-        w = bootstrap_weights_one(
-            row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
-        )
-        if row_mask is not None:
-            w = w * row_mask
-        idx = feature_subspace_one(
-            key, rid, n_features, n_subspace, replacement=bootstrap_features
-        )
-        Xs = X if identity_subspace else X[:, idx]
-        params, aux = learner.fit_from_init(
-            fit_key(key, rid),
-            Xs,
-            y,
-            w,
-            n_outputs,
-            axis_name=data_axis,
-        )
+        with named_scope("bootstrap"):
+            w = bootstrap_weights_one(
+                row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
+            )
+            if row_mask is not None:
+                w = w * row_mask
+            idx = feature_subspace_one(
+                key, rid, n_features, n_subspace, replacement=bootstrap_features
+            )
+            Xs = X if identity_subspace else X[:, idx]
+        with named_scope("base_fit"):
+            params, aux = learner.fit_from_init(
+                fit_key(key, rid),
+                Xs,
+                y,
+                w,
+                n_outputs,
+                axis_name=data_axis,
+            )
         return params, idx, aux["loss"]
 
     params, subspaces, losses = _map_replicas(fit_one, replica_ids, chunk_size)
@@ -176,16 +179,18 @@ def predict_ensemble_classifier(
         chunk_size=chunk_size, identity_subspace=identity_subspace,
     )
     if voting == "soft":
-        return soft_vote_proba(
-            jax.nn.softmax(scores, axis=-1),
-            n_total=n_total,
-            axis_name=replica_axis,
-        )
+        with named_scope("aggregate_soft_vote"):
+            return soft_vote_proba(
+                jax.nn.softmax(scores, axis=-1),
+                n_total=n_total,
+                axis_name=replica_axis,
+            )
     if voting == "hard":
-        counts = hard_vote_counts(
-            jnp.argmax(scores, axis=-1), n_classes, axis_name=replica_axis
-        )
-        return counts / n_total
+        with named_scope("aggregate_hard_vote"):
+            counts = hard_vote_counts(
+                jnp.argmax(scores, axis=-1), n_classes, axis_name=replica_axis
+            )
+            return counts / n_total
     raise ValueError(f"unknown voting {voting!r}")
 
 

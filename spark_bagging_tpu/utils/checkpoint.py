@@ -1,0 +1,176 @@
+"""Model persistence — the ``MLWritable``/``MLReadable`` analog.
+
+The reference saves a metadata JSON plus one subdirectory per base model
+[SURVEY §3.3]. The TPU-native ensemble is ONE pytree (stacked per-replica
+params + subspace matrix), so a checkpoint is one directory with:
+
+- ``manifest.json`` — format version, estimator class, constructor
+  params (base learner serialized by import path + hyperparams), and
+  fitted metadata (classes, shapes, sampling config, fit report),
+- ``arrays.msgpack`` — the stacked parameter pytree + subspaces via
+  flax.serialization (msgpack of raw numpy leaves).
+
+``load`` reconstructs the estimator and verifies transform-equivalence
+is testable (round-trip test in tests/test_checkpoint.py [SURVEY §4]).
+The device mesh is a runtime resource and is intentionally NOT
+persisted — pass ``mesh=`` to the loaded estimator to re-shard.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def _class_path(obj: Any) -> str:
+    cls = type(obj)
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def _import_class(path: str):
+    """Import ``module:qualname`` from a manifest.
+
+    Checkpoints are TRUSTED input (like pickle): the manifest names the
+    estimator/learner classes to instantiate, so only load checkpoints
+    you produced. Custom learners just need their module importable in
+    the loading environment.
+    """
+    module, _, qualname = path.partition(":")
+    obj = importlib.import_module(module)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _serialize_value(v: Any) -> Any:
+    """JSON-encode a constructor param; learners nest as class+params."""
+    if hasattr(v, "get_params") and hasattr(v, "task"):
+        return {
+            "__learner__": _class_path(v),
+            "params": {k: _serialize_value(p) for k, p in v.get_params(deep=False).items()},
+        }
+    return v
+
+
+def _deserialize_value(v: Any) -> Any:
+    if isinstance(v, dict) and "__learner__" in v:
+        cls = _import_class(v["__learner__"])
+        return cls(**{k: _deserialize_value(p) for k, p in v["params"].items()})
+    return v
+
+
+def save_model(model: Any, path: str) -> None:
+    """Save a fitted bagging estimator to directory ``path``."""
+    from flax import serialization  # lazy: keep flax off the import path
+
+    model._check_fitted()
+    os.makedirs(path, exist_ok=True)
+    params = {
+        k: _serialize_value(v)
+        for k, v in model.get_params(deep=False).items()
+        if k != "mesh"
+    }
+    fitted: dict[str, Any] = {
+        "n_features_in_": model.n_features_in_,
+        "n_estimators_": model.n_estimators_,
+        "fit_sampling": list(model._fit_sampling),
+        "identity_subspace": model._identity_subspace,
+        "fit_report_": model.fit_report_,
+        "seed_key": np.asarray(
+            jax.random.key_data(model._fit_key)
+        ).tolist(),
+    }
+    if hasattr(model, "classes_"):
+        fitted["classes_"] = np.asarray(model.classes_).tolist()
+        fitted["classes_dtype"] = str(np.asarray(model.classes_).dtype)
+        fitted["n_classes_"] = model.n_classes_
+    if hasattr(model, "oob_score_"):
+        fitted["oob_score_"] = float(model.oob_score_)
+    manifest = {
+        "format_version": _FORMAT_VERSION,
+        "estimator": _class_path(model),
+        "learner": _class_path(model._fitted_learner),
+        "learner_params": {
+            k: _serialize_value(v)
+            for k, v in model._fitted_learner.get_params(deep=False).items()
+        },
+        "params": params,
+        "fitted": fitted,
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    tree = {
+        "ensemble": jax.tree.map(np.asarray, model.ensemble_),
+        "subspaces": np.asarray(model.subspaces_),
+    }
+    # OOB arrays ride along so a loaded model is fully OOB-fitted.
+    if hasattr(model, "oob_decision_function_"):
+        tree["oob_decision_function"] = np.asarray(
+            model.oob_decision_function_
+        )
+    if hasattr(model, "oob_prediction_"):
+        tree["oob_prediction"] = np.asarray(model.oob_prediction_)
+    with open(os.path.join(path, "arrays.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(tree))
+
+
+def load_model(path: str, *, mesh=None) -> Any:
+    """Load a fitted bagging estimator from directory ``path``.
+
+    Checkpoints are trusted input — see :func:`_import_class`.
+    """
+    from flax import serialization  # lazy: keep flax off the import path
+
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] > _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest['format_version']} is newer "
+            f"than supported ({_FORMAT_VERSION})"
+        )
+    with open(os.path.join(path, "arrays.msgpack"), "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+
+    cls = _import_class(manifest["estimator"])
+    params = {k: _deserialize_value(v) for k, v in manifest["params"].items()}
+    model = cls(**params, mesh=mesh)
+
+    learner_cls = _import_class(manifest["learner"])
+    model._fitted_learner = learner_cls(
+        **{
+            k: _deserialize_value(v)
+            for k, v in manifest["learner_params"].items()
+        }
+    )
+    fitted = manifest["fitted"]
+    model.ensemble_ = jax.tree.map(jax.numpy.asarray, tree["ensemble"])
+    model.subspaces_ = jax.numpy.asarray(tree["subspaces"])
+    model.n_features_in_ = fitted["n_features_in_"]
+    model.n_estimators_ = fitted["n_estimators_"]
+    model._fit_sampling = tuple(fitted["fit_sampling"])
+    model._identity_subspace = fitted["identity_subspace"]
+    model.fit_report_ = fitted["fit_report_"]
+    model._fit_key = jax.random.wrap_key_data(
+        jax.numpy.asarray(fitted["seed_key"], jax.numpy.uint32)
+    )
+    if "classes_" in fitted:
+        model.classes_ = np.asarray(
+            fitted["classes_"], dtype=fitted["classes_dtype"]
+        )
+        model.n_classes_ = fitted["n_classes_"]
+    if "oob_score_" in fitted:
+        model.oob_score_ = fitted["oob_score_"]
+    if "oob_decision_function" in tree:
+        model.oob_decision_function_ = np.asarray(
+            tree["oob_decision_function"]
+        )
+    if "oob_prediction" in tree:
+        model.oob_prediction_ = np.asarray(tree["oob_prediction"])
+    return model

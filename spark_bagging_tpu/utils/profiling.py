@@ -1,0 +1,47 @@
+"""Tracing/profiling hooks [SURVEY §5 tracing].
+
+The reference inherits Spark UI stages + ``Instrumentation`` logging;
+the TPU-native equivalents are ``jax.profiler`` traces (viewable in
+TensorBoard/Perfetto) and ``jax.named_scope`` annotations that the
+ensemble engine wraps around its phases (bootstrap / train / aggregate)
+so device traces segment by ensemble phase.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator
+
+import jax
+
+log = logging.getLogger("spark_bagging_tpu")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace for everything inside the block.
+
+    View with TensorBoard (``tensorboard --logdir <dir>``) or Perfetto.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def log_timing(label: str, level: int = logging.INFO) -> Iterator[None]:
+    """Host-side wall-clock logging for coarse phases (ingestion,
+    compile, fit) — the Instrumentation-log analog."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        log.log(level, "%s: %.3fs", label, time.perf_counter() - t0)
+
+
+# Re-export: engine code uses named_scope so traces segment by phase.
+named_scope = jax.named_scope

@@ -1,0 +1,107 @@
+"""Persistence round-trip tests — save → load → identical transform
+output [SURVEY §4, §3.3]."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    LogisticRegression,
+    load_model,
+    save_model,
+)
+
+
+@pytest.fixture(scope="module")
+def iris():
+    X, y = load_iris(return_X_y=True)
+    return StandardScaler().fit_transform(X).astype(np.float32), y
+
+
+def test_classifier_roundtrip(tmp_path, iris):
+    X, y = iris
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(l2=0.01, max_iter=10),
+        n_estimators=6,
+        max_features=0.5,
+        voting="hard",
+        seed=4,
+        oob_score=True,
+    ).fit(X, y)
+    clf.save(str(tmp_path / "m"))
+    loaded = BaggingClassifier.load(str(tmp_path / "m"))
+    np.testing.assert_array_equal(loaded.predict(X), clf.predict(X))
+    np.testing.assert_allclose(loaded.predict_proba(X), clf.predict_proba(X))
+    assert loaded.n_estimators_ == 6
+    assert loaded.oob_score_ == clf.oob_score_
+    np.testing.assert_allclose(
+        loaded.oob_decision_function_, clf.oob_decision_function_
+    )
+    assert loaded.base_learner.l2 == 0.01
+    assert loaded._fitted_learner == clf._fitted_learner
+    np.testing.assert_array_equal(loaded.classes_, clf.classes_)
+
+
+def test_string_label_roundtrip(tmp_path, iris):
+    X, y = iris
+    names = np.array(["a", "b", "c"])[y]
+    clf = BaggingClassifier(n_estimators=3).fit(X, names)
+    save_model(clf, str(tmp_path / "m"))
+    loaded = load_model(str(tmp_path / "m"))
+    np.testing.assert_array_equal(loaded.predict(X), clf.predict(X))
+    assert loaded.classes_.tolist() == ["a", "b", "c"]
+
+
+def test_regressor_roundtrip(tmp_path):
+    X, y = load_diabetes(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    reg = BaggingRegressor(n_estimators=5, seed=2).fit(X, y)
+    reg.save(str(tmp_path / "r"))
+    loaded = BaggingRegressor.load(str(tmp_path / "r"))
+    np.testing.assert_allclose(loaded.predict(X), reg.predict(X))
+    assert loaded.fit_report_["n_replicas"] == 5
+
+
+def test_load_wrong_class_raises(tmp_path, iris):
+    X, y = iris
+    BaggingClassifier(n_estimators=2).fit(X, y).save(str(tmp_path / "m"))
+    with pytest.raises(TypeError, match="BaggingRegressor"):
+        BaggingRegressor.load(str(tmp_path / "m"))
+
+
+def test_save_unfitted_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="not fitted"):
+        save_model(BaggingClassifier(), str(tmp_path / "m"))
+
+
+def test_future_format_version_rejected(tmp_path, iris):
+    import json
+    import os
+
+    X, y = iris
+    BaggingClassifier(n_estimators=2).fit(X, y).save(str(tmp_path / "m"))
+    mf = os.path.join(tmp_path, "m", "manifest.json")
+    with open(mf) as f:
+        manifest = json.load(f)
+    manifest["format_version"] = 99
+    with open(mf, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="newer"):
+        load_model(str(tmp_path / "m"))
+
+
+def test_loaded_model_oob_reproducible(tmp_path):
+    """The fit key is persisted, so OOB weights can be regenerated after
+    load (shard-local regeneration property)."""
+    X, y = load_breast_cancer(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    clf = BaggingClassifier(n_estimators=8, seed=3).fit(X, y)
+    clf.save(str(tmp_path / "m"))
+    loaded = BaggingClassifier.load(str(tmp_path / "m"))
+    counts_a, votes_a = clf._oob_scores(X, clf.n_classes_)
+    counts_b, votes_b = loaded._oob_scores(X, loaded.n_classes_)
+    np.testing.assert_array_equal(votes_a, votes_b)
+    np.testing.assert_allclose(counts_a, counts_b)

@@ -1,0 +1,54 @@
+"""Metric helper tests against sklearn references."""
+
+import numpy as np
+from sklearn import metrics as skm
+
+from spark_bagging_tpu.utils.metrics import (
+    accuracy,
+    fit_report,
+    r2_score,
+    rmse,
+    roc_auc,
+)
+
+
+def test_accuracy():
+    assert accuracy([1, 2, 3], [1, 2, 0]) == 2 / 3
+
+
+def test_rmse_and_r2():
+    rng = np.random.default_rng(0)
+    y = rng.normal(size=100)
+    p = y + 0.1 * rng.normal(size=100)
+    assert rmse(y, p) == np.sqrt(skm.mean_squared_error(y, p))
+    assert abs(r2_score(y, p) - skm.r2_score(y, p)) < 1e-12
+
+
+def test_r2_constant_target():
+    assert r2_score([1.0, 1.0], [1.0, 2.0]) == 0.0
+
+
+def test_roc_auc_matches_sklearn():
+    rng = np.random.default_rng(1)
+    y = (rng.random(500) < 0.3).astype(int)
+    s = rng.normal(size=500) + y
+    assert abs(roc_auc(y, s) - skm.roc_auc_score(y, s)) < 1e-9
+
+
+def test_roc_auc_with_ties():
+    y = np.array([0, 0, 1, 1, 0, 1])
+    s = np.array([0.5, 0.5, 0.5, 0.8, 0.2, 0.8])
+    assert abs(roc_auc(y, s) - skm.roc_auc_score(y, s)) < 1e-12
+
+
+def test_roc_auc_degenerate():
+    assert roc_auc(np.ones(5), np.arange(5)) == 0.5
+
+
+def test_fit_report_fields():
+    rep = fit_report(
+        n_replicas=10, fit_seconds=2.0, losses=np.ones(10), n_rows=5,
+        n_features=3, n_subspace=2, backend="cpu", n_devices=1,
+    )
+    assert rep["fits_per_sec"] == 5.0
+    assert rep["loss_mean"] == 1.0

@@ -1,0 +1,40 @@
+"""Profiling/tracing hook tests [SURVEY §5 tracing]."""
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.utils.profiling import log_timing, named_scope, trace
+
+
+def test_log_timing_emits(caplog):
+    with caplog.at_level(logging.INFO, logger="spark_bagging_tpu"):
+        with log_timing("phase-x"):
+            pass
+    assert any("phase-x" in r.message for r in caplog.records)
+
+
+def test_named_scope_traces():
+    @jax.jit
+    def f(x):
+        with named_scope("my_phase"):
+            return jnp.sin(x) * 2  # non-foldable so the op survives
+
+    assert abs(float(f(jnp.float32(3.0))) - 2 * 0.14112) < 1e-4
+    lowered = f.lower(jnp.float32(3.0)).as_text()
+    # Scope names appear in op metadata when the compiler keeps them;
+    # assert only when present to avoid over-constraining XLA versions.
+    assert "sine" in lowered or "sin" in lowered
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with trace(d):
+        jnp.sum(jnp.arange(100.0)).block_until_ready()
+    # A profile directory with at least one event file appears.
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "no trace files written"
