@@ -19,6 +19,8 @@ sections instead of reference file:line):
 from spark_bagging_tpu.bagging import BaggingClassifier, BaggingRegressor
 from spark_bagging_tpu.models import (
     BaseLearner,
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
     LinearRegression,
     LogisticRegression,
 )
@@ -33,6 +35,8 @@ __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
     "make_mesh",
     "save_model",
     "load_model",

@@ -101,6 +101,12 @@ def fit_ensemble(
     if data_axis is not None:
         row_key = jax.random.fold_in(key, jax.lax.axis_index(data_axis))
 
+    # Replica-invariant precomputation (e.g. tree bin edges + threshold
+    # indicators) runs ONCE here, outside the replica map; vmap keeps it
+    # unbatched so it is not repeated per replica [models/base.py].
+    with named_scope("prepare"):
+        prepared = learner.prepare(X, axis_name=data_axis, row_mask=row_mask)
+
     def fit_one(rid):
         with named_scope("bootstrap"):
             w = bootstrap_weights_one(
@@ -112,6 +118,10 @@ def fit_ensemble(
                 key, rid, n_features, n_subspace, replacement=bootstrap_features
             )
             Xs = X if identity_subspace else X[:, idx]
+            prep = (
+                prepared if identity_subspace
+                else learner.gather_subspace(prepared, idx)
+            )
         with named_scope("base_fit"):
             params, aux = learner.fit_from_init(
                 fit_key(key, rid),
@@ -120,6 +130,7 @@ def fit_ensemble(
                 w,
                 n_outputs,
                 axis_name=data_axis,
+                prepared=prep,
             )
         return params, idx, aux["loss"]
 

@@ -10,9 +10,15 @@ function of (params, X, y, sample_weight, key) so the ensemble engine can
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
+from spark_bagging_tpu.models.tree import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+)
 
 __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
 ]

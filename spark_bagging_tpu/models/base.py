@@ -7,6 +7,8 @@ functions, each `vmap`-able over a leading replica axis [SURVEY §7.3]:
 
 - ``init_params(key, n_features, n_outputs) -> params``
 - ``fit(params, X, y, sample_weight, key, axis_name) -> (params, aux)``
+  (learners declaring a ``prepare`` hook additionally receive their
+  precomputed state via a ``prepared=`` keyword — see below)
 - ``predict_scores(params, X) -> scores``
 
 Rules that make a learner a valid plugin:
@@ -57,11 +59,37 @@ class BaseLearner(ParamsMixin):
         key: jax.Array,
         *,
         axis_name: str | None = None,
+        prepared: Any | None = None,
     ) -> tuple[Params, Aux]:
         raise NotImplementedError
 
     def predict_scores(self, params: Params, X: jax.Array) -> jax.Array:
         raise NotImplementedError
+
+    # -- optional replica-invariant precomputation ----------------------
+    #
+    # Some learners (trees) need work that depends only on X — quantile
+    # bin edges, threshold-indicator matrices. Computing it inside `fit`
+    # would repeat it per replica chunk; the ensemble engine instead
+    # calls `prepare` ONCE outside the replica map and threads the
+    # result into every `fit` via the `prepared` kwarg. When replicas
+    # draw feature subspaces, `gather_subspace` slices the prepared
+    # state to replica k's columns (runs inside the vmap).
+
+    def prepare(
+        self,
+        X: jax.Array,
+        *,
+        axis_name: str | None = None,
+        row_mask: jax.Array | None = None,
+    ) -> Any | None:
+        """Replica-invariant precomputation; None means 'nothing'."""
+        del X, axis_name, row_mask
+        return None
+
+    def gather_subspace(self, prepared: Any, idx: jax.Array) -> Any:
+        """Restrict prepared state to the feature columns in ``idx``."""
+        return prepared
 
     # -- convenience used by the ensemble engine ------------------------
 
@@ -74,12 +102,20 @@ class BaseLearner(ParamsMixin):
         n_outputs: int,
         *,
         axis_name: str | None = None,
+        prepared: Any | None = None,
     ) -> tuple[Params, Aux]:
         """Init-then-fit with a split key; one replica's whole training."""
         init_key, fit_key = jax.random.split(key)
         params = self.init_params(init_key, X.shape[1], n_outputs)
+        kwargs = {}
+        if prepared is not None:
+            # Only learners with a prepare() hook receive the kwarg, so
+            # third-party learners written to the plain fit contract
+            # (no `prepared` parameter) keep working.
+            kwargs["prepared"] = prepared
         return self.fit(
-            params, X, y, sample_weight, fit_key, axis_name=axis_name
+            params, X, y, sample_weight, fit_key,
+            axis_name=axis_name, **kwargs,
         )
 
     # Learners are static (hashable) w.r.t. jit: two instances with equal

@@ -37,8 +37,9 @@ class LinearRegression(BaseLearner):
         beta = params["beta"]
         return X.astype(beta.dtype) @ beta[:-1] + beta[-1]
 
-    def fit(self, params, X, y, sample_weight, key, *, axis_name=None):
-        del params, key
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del params, key, prepared
         X = X.astype(jnp.float32)
         y = y.astype(jnp.float32)
         w = sample_weight.astype(jnp.float32)

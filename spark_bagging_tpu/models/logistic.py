@@ -91,8 +91,9 @@ class LogisticRegression(BaseLearner):
         data = maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
         return data + self._penalty(W)
 
-    def fit(self, params, X, y, sample_weight, key, *, axis_name=None):
-        del key  # deterministic solvers
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del key, prepared  # deterministic solvers; no precomputation
         Xb = _augment(X.astype(jnp.float32))
         w = sample_weight.astype(jnp.float32)
         w_sum = maybe_psum(jnp.sum(w), axis_name)

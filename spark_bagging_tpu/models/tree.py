@@ -1,0 +1,333 @@
+"""`vmap`-able depth-bounded decision trees — SURVEY §7 hard-part 1.
+
+The reference plugs Spark ML DecisionTree (driver-orchestrated,
+row-partitioned histogram split search on executors) into the bagging
+loop [B:9, SURVEY §2a#2]. A literal port — per-node dynamic recursion —
+cannot jit or `vmap`. The TPU-native design makes every shape static:
+
+- **Dense complete binary tree** of static depth ``d``: node arrays of
+  length ``2^d − 1`` (internal) and ``2^d`` (leaves). Growth is
+  level-synchronous: every node at a level splits simultaneously, so a
+  whole level's split search across all replicas is batched linear
+  algebra, not control flow [SURVEY §7.7].
+- **Quantile binning, shared across replicas.** ``prepare()`` computes
+  per-feature quantile bin edges and a *cumulative* threshold-indicator
+  matrix ``T[i, f, b] = (X[i, f] <= edge[f, b])`` once per ensemble
+  (replica-invariant — the engine hoists it out of the replica map).
+- **Split search = one matmul per level.** Left-of-threshold class/
+  moment sums for every (feature, threshold, node) candidate are
+  ``Tᵀ @ R`` with ``R[i, n·K + k] = onehot(node_i)[n] · S[i, k]`` —
+  a dense ``(F·B, rows) × (rows, N·K)`` contraction that tiles onto
+  the MXU, replacing the reference's executor-side histogram
+  aggregation. Because T is cumulative in the bin axis, the product
+  *is* the left-statistics table; no cumsum pass is needed.
+- **Weighted everything**: the Poisson bootstrap counts enter as exact
+  per-row weights in the split statistics and leaf values
+  [SURVEY §7 hard-part 2].
+
+Counts are accumulated in f32 on the MXU from ``hist_dtype`` operands;
+``bfloat16`` operands are exact for the 0/1 indicator matrix and the
+integer-valued bootstrap weights, so classification split counts are
+exact. Regression moment sums (w·y, w·y²) round to bf16 per element —
+split *selection* tolerates this; leaf values are computed separately
+in full precision. Set ``hist_dtype="float32"`` to make split search
+exact at 2× the memory.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.ops.reduce import maybe_pmean, maybe_psum
+
+_EPS = 1e-12
+
+
+def _quantile_edges(X, row_mask, n_bins):
+    """Per-feature bin edges ``(F, n_bins)``; last edge is +inf.
+
+    Order-statistic quantiles over valid rows (``row_mask`` zeros mark
+    padding added for even sharding — they are pushed to +inf before the
+    sort so they never land in an interior bin).
+    """
+    n, F = X.shape
+    Xt = X.T
+    if row_mask is not None:
+        Xt = jnp.where(row_mask[None, :] > 0, Xt, jnp.inf)
+        n_valid = jnp.sum(row_mask > 0).astype(jnp.int32)
+    else:
+        n_valid = n
+    Xs = jnp.sort(Xt, axis=1)  # (F, n)
+    # b-th interior edge sits at order statistic floor((b+1)/B * n_valid)
+    pos = jnp.clip(
+        (jnp.arange(1, n_bins) * n_valid) // n_bins, 0, n - 1
+    ).astype(jnp.int32)
+    interior = Xs[:, pos]  # (F, n_bins - 1)
+    return jnp.concatenate(
+        [interior, jnp.full((F, 1), jnp.inf, X.dtype)], axis=1
+    )
+
+
+class _TreeBase(BaseLearner):
+    """Shared growth engine for classifier/regressor trees."""
+
+    def __init__(
+        self,
+        max_depth: int = 5,
+        n_bins: int = 32,
+        hist_dtype: str = "bfloat16",
+        precision: str = "highest",
+    ):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if n_bins < 2:
+            raise ValueError(f"n_bins must be >= 2, got {n_bins}")
+        self.max_depth = max_depth
+        self.n_bins = n_bins
+        self.hist_dtype = hist_dtype
+        self.precision = precision
+
+    # -- prepare hook ---------------------------------------------------
+
+    def prepare(self, X, *, axis_name=None, row_mask=None):
+        """Bin edges + cumulative threshold indicators (replica-invariant).
+
+        Data-sharded fits compute per-shard quantiles and ``pmean`` them
+        into one consistent global binning (any shard-agreed monotone
+        edges are valid bins) [SURVEY §5 comms backend].
+        """
+        edges = _quantile_edges(X, row_mask, self.n_bins)
+        edges = maybe_pmean(edges, axis_name)
+        T = (X[:, :, None] <= edges[None, :, :]).astype(jnp.int8)
+        return {"edges": edges, "T": T}
+
+    def gather_subspace(self, prepared, idx):
+        return {
+            "edges": prepared["edges"][idx],
+            "T": prepared["T"][:, idx, :],
+        }
+
+    # -- growth ---------------------------------------------------------
+
+    def _grow(self, X, S, prepared, axis_name):
+        """Level-synchronous growth; returns (feature, threshold,
+        leaf_index_per_row, per-level impurity curve).
+
+        ``S`` is the per-row statistics matrix ``(n, K)`` whose left/
+        right sums drive the impurity: weighted one-hot classes for
+        classification, weighted moments ``(w, w·y, w·y²)`` for
+        regression.
+        """
+        n, F = X.shape
+        B, d = self.n_bins, self.max_depth
+        K = S.shape[1]
+        edges = prepared["edges"]
+        hdt = jnp.dtype(self.hist_dtype)
+        if hdt == jnp.bfloat16 and jax.default_backend() == "cpu":
+            # CPU XLA's dot thunk lacks BF16×BF16→F32; the fake-device
+            # test backend [SURVEY §4] silently upgrades to f32.
+            hdt = jnp.dtype(jnp.float32)
+        Tf = prepared["T"].reshape(n, F * B).astype(hdt)
+        Sh = S.astype(hdt)
+
+        node = jnp.zeros((n,), jnp.int32)  # level-relative node index
+        feats, thrs, curve = [], [], []
+        with jax.default_matmul_precision(self.precision):
+            for level in range(d):
+                N = 2**level
+                R = (
+                    jax.nn.one_hot(node, N, dtype=hdt)[:, :, None]
+                    * Sh[:, None, :]
+                ).reshape(n, N * K)
+                # (F·B, N·K) left statistics — the level's whole split
+                # search as one MXU contraction (accumulates in f32).
+                hist = maybe_psum(
+                    jnp.matmul(
+                        Tf.T, R, preferred_element_type=jnp.float32
+                    ),
+                    axis_name,
+                ).reshape(F, B, N, K)
+                total = hist[0, -1]  # edge B-1 is +inf ⇒ full-node sums
+                left = hist
+                right = total[None, None, :, :] - left
+                score = self._impurity(left) + self._impurity(right)
+                best = jnp.argmin(score.reshape(F * B, N), axis=0)
+                bf = (best // B).astype(jnp.int32)
+                bb = (best % B).astype(jnp.int32)
+                thr = edges[bf, bb]
+                feats.append(bf)
+                thrs.append(thr)
+                curve.append(
+                    jnp.sum(
+                        jnp.take_along_axis(
+                            score.reshape(F * B, N), best[None, :], axis=0
+                        )[0]
+                    )
+                )
+                f_row = bf[node]
+                t_row = thr[node]
+                x_sel = jnp.take_along_axis(X, f_row[:, None], axis=1)[:, 0]
+                node = node * 2 + (x_sel > t_row).astype(jnp.int32)
+        return (
+            jnp.concatenate(feats),
+            jnp.concatenate(thrs),
+            node,
+            jnp.stack(curve),
+        )
+
+    def _leaf_stats(self, node, S, axis_name):
+        """Per-leaf statistic sums ``(2^d, K)`` in full precision."""
+        L = 2**self.max_depth
+        with jax.default_matmul_precision("highest"):
+            onehot = jax.nn.one_hot(node, L, dtype=jnp.float32)
+            return maybe_psum(
+                jnp.matmul(
+                    onehot.T,
+                    S.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                ),
+                axis_name,
+            )
+
+    # -- routing (shared by fit-time and predict-time) ------------------
+
+    def _route(self, params, X):
+        """Leaf index per row via ``max_depth`` gather-compare steps."""
+        rel = jnp.zeros((X.shape[0],), jnp.int32)
+        off = 0
+        for level in range(self.max_depth):
+            N = 2**level
+            f_lvl = params["feature"][off : off + N]
+            t_lvl = params["threshold"][off : off + N]
+            f_row = f_lvl[rel]
+            t_row = t_lvl[rel]
+            x_sel = jnp.take_along_axis(X, f_row[:, None], axis=1)[:, 0]
+            rel = rel * 2 + (x_sel > t_row).astype(jnp.int32)
+            off += N
+        return rel
+
+    def _impurity(self, stats):
+        raise NotImplementedError
+
+
+class DecisionTreeClassifier(_TreeBase):
+    """Weighted-Gini, depth-``d`` classification tree (config 3 [B:9]).
+
+    Leaves store Laplace-smoothed log class probabilities, so
+    ``predict_scores`` feeds soft voting as ``softmax(logp) = p`` and
+    hard voting as the leaf's majority class.
+    """
+
+    task = "classification"
+
+    def __init__(
+        self,
+        max_depth: int = 5,
+        n_bins: int = 32,
+        leaf_smoothing: float = 1.0,
+        hist_dtype: str = "bfloat16",
+        precision: str = "highest",
+    ):
+        super().__init__(max_depth, n_bins, hist_dtype, precision)
+        self.leaf_smoothing = leaf_smoothing
+
+    def init_params(self, key, n_features, n_outputs):
+        del key
+        M, L = 2**self.max_depth - 1, 2**self.max_depth
+        return {
+            "feature": jnp.zeros((M,), jnp.int32),
+            "threshold": jnp.zeros((M,), jnp.float32),
+            "leaf_logp": jnp.zeros((L, n_outputs), jnp.float32),
+        }
+
+    def _impurity(self, stats):
+        """Weighted Gini mass: ``|side| · (1 − Σ_c p_c²)`` per
+        (feature, bin, node); stats is class counts ``(F, B, N, C)``."""
+        w = stats.sum(-1)
+        return w - (stats**2).sum(-1) / jnp.maximum(w, _EPS)
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del key
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        C = params["leaf_logp"].shape[1]
+        w = sample_weight.astype(jnp.float32)
+        S = w[:, None] * jax.nn.one_hot(y, C, dtype=jnp.float32)
+        feature, threshold, node, curve = self._grow(
+            X, S, prepared, axis_name
+        )
+        counts = self._leaf_stats(node, S, axis_name)  # (L, C)
+        a = self.leaf_smoothing
+        logp = jnp.log(
+            (counts + a) / (counts.sum(-1, keepdims=True) + a * C)
+        )
+        w_tot = jnp.maximum(counts.sum(), _EPS)
+        leaf_gini = jnp.sum(self._impurity(counts))
+        new = {
+            "feature": feature,
+            "threshold": threshold,
+            "leaf_logp": logp.astype(jnp.float32),
+        }
+        return new, {
+            "loss": leaf_gini / w_tot,
+            "loss_curve": curve / w_tot,
+        }
+
+    def predict_scores(self, params, X):
+        return params["leaf_logp"][self._route(params, X)]
+
+
+class DecisionTreeRegressor(_TreeBase):
+    """Weighted-variance (SSE) regression tree.
+
+    Leaves store the weighted mean target; empty leaves fall back to
+    the global weighted mean (only out-of-bag rows can reach them).
+    """
+
+    task = "regression"
+
+    def init_params(self, key, n_features, n_outputs):
+        del key, n_outputs
+        M, L = 2**self.max_depth - 1, 2**self.max_depth
+        return {
+            "feature": jnp.zeros((M,), jnp.int32),
+            "threshold": jnp.zeros((M,), jnp.float32),
+            "leaf_value": jnp.zeros((L,), jnp.float32),
+        }
+
+    def _impurity(self, stats):
+        """Weighted SSE ``Σw·y² − (Σw·y)²/Σw`` per candidate side;
+        stats is moment sums ``(F, B, N, 3)`` of (w, w·y, w·y²)."""
+        s0, s1, s2 = stats[..., 0], stats[..., 1], stats[..., 2]
+        return s2 - s1**2 / jnp.maximum(s0, _EPS)
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del params, key
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        w = sample_weight.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        S = jnp.stack([w, w * yf, w * yf**2], axis=1)
+        feature, threshold, node, curve = self._grow(
+            X, S, prepared, axis_name
+        )
+        m = self._leaf_stats(node, S, axis_name)  # (L, 3)
+        w_tot = jnp.maximum(m[:, 0].sum(), _EPS)
+        global_mean = m[:, 1].sum() / w_tot
+        value = jnp.where(
+            m[:, 0] > 0, m[:, 1] / jnp.maximum(m[:, 0], _EPS), global_mean
+        )
+        sse = jnp.sum(self._impurity(m))
+        new = {
+            "feature": feature,
+            "threshold": threshold,
+            "leaf_value": value.astype(jnp.float32),
+        }
+        return new, {"loss": sse / w_tot, "loss_curve": curve / w_tot}
+
+    def predict_scores(self, params, X):
+        return params["leaf_value"][self._route(params, X)]

@@ -1,0 +1,245 @@
+"""Decision-tree learner tests: split correctness, weighted-fit exactness,
+sklearn parity, vmap-ability, ensemble integration [SURVEY §4, §7 hard-parts
+1-2]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
+from sklearn.preprocessing import StandardScaler
+from sklearn.tree import DecisionTreeClassifier as SkTreeClf
+from sklearn.tree import DecisionTreeRegressor as SkTreeReg
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+)
+
+KEY = jax.random.key(0)
+
+
+def _iris():
+    X, y = load_iris(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y, jnp.int32), X, y
+
+
+def _breast_cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y, jnp.int32), X, y
+
+
+def _diabetes():
+    X, y = load_diabetes(return_X_y=True)
+    X = X.astype(np.float32)
+    y = ((y - y.mean()) / y.std()).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y), X, y
+
+
+class TestClassifierTree:
+    def test_axis_aligned_split_recovered(self):
+        """A single perfectly-separating feature must be found at the root."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 6)).astype(np.float32)
+        y = (X[:, 3] > 0.0).astype(np.int32)
+        tree = DecisionTreeClassifier(max_depth=1, n_bins=64)
+        params, aux = tree.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(400), 2
+        )
+        assert int(params["feature"][0]) == 3
+        acc = (
+            np.asarray(tree.predict_scores(params, jnp.asarray(X)).argmax(1))
+            == y
+        ).mean()
+        assert acc > 0.97  # binned threshold ⇒ not always exactly 0.0
+
+    def test_iris_accuracy_matches_sklearn_depth3(self):
+        Xj, yj, X, y = _iris()
+        tree = DecisionTreeClassifier(max_depth=3, n_bins=32,
+                                      hist_dtype="float32")
+        params, _ = tree.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+        acc = (np.asarray(tree.predict_scores(params, Xj).argmax(1)) == y).mean()
+        sk = SkTreeClf(max_depth=3).fit(X, y).score(X, y)
+        assert acc > 0.93
+        assert acc >= sk - 0.05
+
+    def test_breast_cancer_depth5(self):
+        Xj, yj, X, y = _breast_cancer()
+        tree = DecisionTreeClassifier(max_depth=5)
+        params, aux = tree.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        acc = (np.asarray(tree.predict_scores(params, Xj).argmax(1)) == y).mean()
+        assert acc > 0.95
+        assert np.isfinite(float(aux["loss"]))
+
+    def test_poisson_weights_equal_duplicated_rows(self):
+        """Weighted Gini over Poisson counts must equal physically
+        duplicating rows [SURVEY §7 hard-part 2]."""
+        Xj, yj, X, y = _iris()
+        rng = np.random.default_rng(3)
+        w = rng.poisson(1.0, len(y)).astype(np.float32)
+        tree = DecisionTreeClassifier(max_depth=3, hist_dtype="float32")
+        pw, _ = tree.fit(
+            tree.init_params(KEY, 4, 3), Xj, yj, jnp.asarray(w), KEY
+        )
+        Xd = np.repeat(X, w.astype(int), axis=0)
+        yd = np.repeat(y, w.astype(int))
+        # same binning for both fits: prepare on the original X
+        prepared = tree.prepare(Xj)
+        pd, _ = tree.fit(
+            tree.init_params(KEY, 4, 3),
+            jnp.asarray(Xd), jnp.asarray(yd, jnp.int32),
+            jnp.ones(len(yd)), KEY,
+            prepared={
+                "edges": prepared["edges"],
+                "T": (jnp.asarray(Xd)[:, :, None]
+                      <= prepared["edges"][None]).astype(jnp.int8),
+            },
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pw["feature"]), np.asarray(pd["feature"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(pw["threshold"]), np.asarray(pd["threshold"])
+        )
+
+    def test_zero_weight_rows_ignored(self):
+        Xj, yj, _, y = _iris()
+        w = np.ones(len(y), np.float32)
+        w[y == 2] = 0.0
+        tree = DecisionTreeClassifier(max_depth=3)
+        params, _ = tree.fit_from_init(KEY, Xj, yj, jnp.asarray(w), 3)
+        pred = np.asarray(tree.predict_scores(params, Xj).argmax(1))
+        assert not np.any(pred == 2)
+
+    def test_scores_are_log_probabilities(self):
+        Xj, yj, _, y = _iris()
+        tree = DecisionTreeClassifier(max_depth=2)
+        params, _ = tree.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+        p = np.exp(np.asarray(tree.predict_scores(params, Xj)))
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_vmap_over_replicas(self):
+        Xj, yj, _, y = _iris()
+        tree = DecisionTreeClassifier(max_depth=3)
+        rng = np.random.default_rng(0)
+        ws = jnp.asarray(rng.poisson(1.0, (4, len(y))).astype(np.float32))
+        keys = jax.vmap(lambda i: jax.random.fold_in(KEY, i))(jnp.arange(4))
+        prepared = tree.prepare(Xj)
+        params, aux = jax.vmap(
+            lambda k, w: tree.fit_from_init(
+                k, Xj, yj, w, 3, prepared=prepared
+            )
+        )(keys, ws)
+        assert params["feature"].shape == (4, 7)
+        assert params["leaf_logp"].shape == (4, 8, 3)
+        assert not np.array_equal(
+            np.asarray(params["feature"][0]), np.asarray(params["feature"][1])
+        ) or not np.allclose(
+            np.asarray(params["threshold"][0]),
+            np.asarray(params["threshold"][1]),
+        )
+
+
+class TestRegressorTree:
+    def test_step_function_recovered(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 4)).astype(np.float32)
+        y = np.where(X[:, 2] > 0, 2.0, -1.0).astype(np.float32)
+        tree = DecisionTreeRegressor(max_depth=1, n_bins=64)
+        params, _ = tree.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(500), 1
+        )
+        assert int(params["feature"][0]) == 2
+        pred = np.asarray(tree.predict_scores(params, jnp.asarray(X)))
+        assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+    def test_diabetes_r2_near_sklearn(self):
+        Xj, yj, X, y = _diabetes()
+        tree = DecisionTreeRegressor(max_depth=4, hist_dtype="float32")
+        params, _ = tree.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 1)
+        pred = np.asarray(tree.predict_scores(params, Xj))
+        r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+        sk_r2 = SkTreeReg(max_depth=4).fit(X, y).score(X, y)
+        assert r2 > 0.4
+        assert r2 >= sk_r2 - 0.1
+
+    def test_empty_leaf_fallback_is_finite(self):
+        # depth 6 on 50 rows guarantees empty leaves
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        tree = DecisionTreeRegressor(max_depth=6)
+        params, _ = tree.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(50), 1
+        )
+        assert np.isfinite(np.asarray(params["leaf_value"])).all()
+
+
+class TestTreeBagging:
+    def test_bagged_trees_beat_single_tree_iris(self):
+        Xj, yj, X, y = _iris()
+        clf = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=3),
+            n_estimators=25,
+            max_features=0.75,
+            seed=0,
+        )
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.93
+        assert clf.predict_proba(X).shape == (len(y), 3)
+
+    def test_bagged_trees_with_subspaces_breast_cancer(self):
+        Xj, yj, X, y = _breast_cancer()
+        clf = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=4),
+            n_estimators=15,
+            max_features=0.5,
+            voting="hard",
+            seed=1,
+        )
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.94
+
+    def test_bagged_regressor_oob(self):
+        Xj, yj, X, y = _diabetes()
+        reg = BaggingRegressor(
+            base_learner=DecisionTreeRegressor(max_depth=3),
+            n_estimators=30,
+            oob_score=True,
+            seed=0,
+        )
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.3
+        assert np.isfinite(reg.oob_score_)
+        assert reg.oob_score_ > 0.0
+
+    def test_chunked_fit_matches_vmap(self):
+        Xj, yj, X, y = _iris()
+        base = dict(
+            base_learner=DecisionTreeClassifier(max_depth=2),
+            n_estimators=8,
+            seed=7,
+        )
+        a = BaggingClassifier(**base).fit(X, y)
+        b = BaggingClassifier(**base, chunk_size=4).fit(X, y)
+        np.testing.assert_allclose(
+            a.predict_proba(X), b.predict_proba(X), atol=1e-5
+        )
+
+    def test_sharded_tree_fit_on_mesh(self):
+        from spark_bagging_tpu import make_mesh
+
+        Xj, yj, X, y = _breast_cancer()
+        mesh = make_mesh(data=2)
+        clf = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=3),
+            n_estimators=8,
+            seed=0,
+            mesh=mesh,
+        )
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.9
