@@ -23,6 +23,8 @@ from spark_bagging_tpu.models import (
     DecisionTreeRegressor,
     LinearRegression,
     LogisticRegression,
+    MLPClassifier,
+    MLPRegressor,
 )
 from spark_bagging_tpu.parallel import make_mesh
 from spark_bagging_tpu.utils.checkpoint import load_model, save_model
@@ -37,6 +39,8 @@ __all__ = [
     "LinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
+    "MLPClassifier",
+    "MLPRegressor",
     "make_mesh",
     "save_model",
     "load_model",

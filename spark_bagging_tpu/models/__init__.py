@@ -10,6 +10,7 @@ function of (params, X, y, sample_weight, key) so the ensemble engine can
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
+from spark_bagging_tpu.models.mlp import MLPClassifier, MLPRegressor
 from spark_bagging_tpu.models.tree import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -21,4 +22,6 @@ __all__ = [
     "LinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
+    "MLPClassifier",
+    "MLPRegressor",
 ]

@@ -1,0 +1,175 @@
+"""Two-layer MLP base learners — config 4 of the baseline [B:10].
+
+The reference's MLP base learner is Spark ML's
+MultilayerPerceptronClassifier (JVM L-BFGS over netlib BLAS)
+[SURVEY §2b]. The TPU-native learner is a one-hidden-layer network
+trained by Adam over a `lax.scan` of minibatch steps — iteration count
+and batch size are static hyperparameters so the whole fit jits and
+`vmap`s over replicas; each replica draws its own minibatch stream from
+its folded key [SURVEY §7.7].
+
+Bootstrap weighting: the per-replica Poisson counts multiply into the
+minibatch loss (weighted-sum / weight-sum normalization), so rows a
+replica never sampled (weight 0) contribute nothing — exact-multiplicity
+semantics in expectation over minibatches, exact for full-batch
+(``batch_size=None``) [SURVEY §7 hard-part 2].
+
+Data sharding: gradients are summed with ``maybe_psum`` over the data
+axis before normalization, so a sharded full-batch fit reproduces the
+single-device update exactly [SURVEY §5 comms backend].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+_EPS = 1e-8
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+}
+
+
+class _MLPBase(BaseLearner):
+    """Shared forward/training loop for classifier/regressor MLPs."""
+
+    def __init__(
+        self,
+        hidden: int = 64,
+        max_iter: int = 200,
+        batch_size: int | None = None,
+        lr: float = 1e-3,
+        l2: float = 1e-4,
+        activation: str = "relu",
+    ):
+        if activation not in _ACTIVATIONS:
+            raise ValueError(
+                f"activation must be one of {sorted(_ACTIVATIONS)}, "
+                f"got {activation!r}"
+            )
+        if hidden < 1:
+            raise ValueError(f"hidden must be >= 1, got {hidden}")
+        self.hidden = hidden
+        self.max_iter = max_iter
+        self.batch_size = batch_size
+        self.lr = lr
+        self.l2 = l2
+        self.activation = activation
+
+    def init_params(self, key, n_features, n_outputs):
+        k1, k2 = jax.random.split(key)
+        s1 = jnp.sqrt(2.0 / n_features)
+        s2 = jnp.sqrt(2.0 / self.hidden)
+        return {
+            "W1": s1 * jax.random.normal(
+                k1, (n_features, self.hidden), jnp.float32
+            ),
+            "b1": jnp.zeros((self.hidden,), jnp.float32),
+            "W2": s2 * jax.random.normal(
+                k2, (self.hidden, n_outputs), jnp.float32
+            ),
+            "b2": jnp.zeros((n_outputs,), jnp.float32),
+        }
+
+    def _forward(self, params, X):
+        h = _ACTIVATIONS[self.activation](X @ params["W1"] + params["b1"])
+        return h @ params["W2"] + params["b2"]
+
+    def _row_loss(self, params, X, y):
+        """Per-row unweighted loss ``(n,)``; task-specific."""
+        raise NotImplementedError
+
+    def _penalty(self, params):
+        return 0.5 * self.l2 * (
+            jnp.sum(params["W1"] ** 2) + jnp.sum(params["W2"] ** 2)
+        )
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del prepared
+        X = X.astype(jnp.float32)
+        w = sample_weight.astype(jnp.float32)
+        n = X.shape[0]
+        opt = optax.adam(self.lr)
+
+        def weighted_grad(p, Xb, yb, wb):
+            """(loss, grad) of the weighted mean loss + penalty; row sums
+            are psum'd so data-sharded full-batch steps are exact."""
+            loss_sum, grad = jax.value_and_grad(
+                lambda p: jnp.sum(wb * self._row_loss(p, Xb, yb))
+            )(p)
+            denom = jnp.maximum(maybe_psum(jnp.sum(wb), axis_name), _EPS)
+            grad = jax.tree.map(
+                lambda a: maybe_psum(a, axis_name) / denom, grad
+            )
+            pen, pen_grad = jax.value_and_grad(self._penalty)(p)
+            grad = jax.tree.map(jnp.add, grad, pen_grad)
+            loss = maybe_psum(loss_sum, axis_name) / denom + pen
+            return loss, grad
+
+        if self.batch_size is None:
+            def step(carry, _):
+                p, opt_state = carry
+                loss, g = weighted_grad(p, X, y, w)
+                updates, opt_state = opt.update(g, opt_state, p)
+                return (optax.apply_updates(p, updates), opt_state), loss
+            xs = None
+        else:
+            b = min(self.batch_size, n)
+
+            def step(carry, k_step):
+                p, opt_state = carry
+                idx = jax.random.randint(k_step, (b,), 0, n)
+                loss, g = weighted_grad(p, X[idx], y[idx], w[idx])
+                updates, opt_state = opt.update(g, opt_state, p)
+                return (optax.apply_updates(p, updates), opt_state), loss
+            xs = jax.random.split(key, self.max_iter)
+
+        (params, _), curve = jax.lax.scan(
+            step, (params, opt.init(params)), xs, length=self.max_iter
+        )
+        # final loss on the full (weighted) data for reporting
+        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        full = (
+            maybe_psum(jnp.sum(w * self._row_loss(params, X, y)), axis_name)
+            / jnp.maximum(w_sum, _EPS)
+            + self._penalty(params)
+        )
+        return params, {"loss": full, "loss_curve": curve}
+
+
+class MLPClassifier(_MLPBase):
+    """One-hidden-layer softmax classifier (2-layer MLP [B:10])."""
+
+    task = "classification"
+
+    def predict_scores(self, params, X):
+        return self._forward(params, X.astype(jnp.float32))
+
+    def _row_loss(self, params, X, y):
+        logp = jax.nn.log_softmax(self._forward(params, X), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+
+class MLPRegressor(_MLPBase):
+    """One-hidden-layer regression MLP (squared loss)."""
+
+    task = "regression"
+
+    def init_params(self, key, n_features, n_outputs):
+        del n_outputs  # regression heads are scalar
+        return super().init_params(key, n_features, 1)
+
+    def predict_scores(self, params, X):
+        return self._forward(params, X.astype(jnp.float32))[:, 0]
+
+    def _row_loss(self, params, X, y):
+        pred = self._forward(params, X)[:, 0]
+        return 0.5 * (pred - y) ** 2

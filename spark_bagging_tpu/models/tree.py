@@ -40,17 +40,19 @@ import jax
 import jax.numpy as jnp
 
 from spark_bagging_tpu.models.base import BaseLearner
-from spark_bagging_tpu.ops.reduce import maybe_pmean, maybe_psum
+from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _EPS = 1e-12
 
 
 def _quantile_edges(X, row_mask, n_bins):
-    """Per-feature bin edges ``(F, n_bins)``; last edge is +inf.
+    """Per-feature interior bin edges ``(F, n_bins - 1)`` + valid count.
 
     Order-statistic quantiles over valid rows (``row_mask`` zeros mark
     padding added for even sharding — they are pushed to +inf before the
-    sort so they never land in an interior bin).
+    sort so they never land in an interior bin). A shard with zero valid
+    rows returns all-inf edges; callers must mask it out of cross-shard
+    averaging (see :meth:`_TreeBase.prepare`).
     """
     n, F = X.shape
     Xt = X.T
@@ -58,16 +60,19 @@ def _quantile_edges(X, row_mask, n_bins):
         Xt = jnp.where(row_mask[None, :] > 0, Xt, jnp.inf)
         n_valid = jnp.sum(row_mask > 0).astype(jnp.int32)
     else:
-        n_valid = n
+        n_valid = jnp.asarray(n, jnp.int32)
     Xs = jnp.sort(Xt, axis=1)  # (F, n)
-    # b-th interior edge sits at order statistic floor((b+1)/B * n_valid)
+    # b-th interior edge ≈ order statistic (b+1)/B · n_valid. Computed in
+    # f32 (not `arange * n_valid // B`) so n_rows × n_bins can't overflow
+    # int32 at Criteo scale; a ≤few-row rounding error in the position is
+    # irrelevant to binning quality.
     pos = jnp.clip(
-        (jnp.arange(1, n_bins) * n_valid) // n_bins, 0, n - 1
-    ).astype(jnp.int32)
-    interior = Xs[:, pos]  # (F, n_bins - 1)
-    return jnp.concatenate(
-        [interior, jnp.full((F, 1), jnp.inf, X.dtype)], axis=1
+        (jnp.arange(1, n_bins, dtype=jnp.float32)
+         * (n_valid.astype(jnp.float32) / n_bins)).astype(jnp.int32),
+        0,
+        n - 1,
     )
+    return Xs[:, pos], n_valid  # (F, n_bins - 1)
 
 
 class _TreeBase(BaseLearner):
@@ -94,12 +99,26 @@ class _TreeBase(BaseLearner):
     def prepare(self, X, *, axis_name=None, row_mask=None):
         """Bin edges + cumulative threshold indicators (replica-invariant).
 
-        Data-sharded fits compute per-shard quantiles and ``pmean`` them
+        Data-sharded fits compute per-shard quantiles and average them
         into one consistent global binning (any shard-agreed monotone
-        edges are valid bins) [SURVEY §5 comms backend].
+        edges are valid bins) [SURVEY §5 comms backend]. The average is
+        masked over shards that hold at least one valid row, so a shard
+        of pure padding (tiny n on a wide data axis) cannot poison the
+        edges with its +inf sentinel values.
         """
-        edges = _quantile_edges(X, row_mask, self.n_bins)
-        edges = maybe_pmean(edges, axis_name)
+        interior, n_valid = _quantile_edges(X, row_mask, self.n_bins)
+        if axis_name is not None:
+            has_rows = (n_valid > 0).astype(interior.dtype)
+            num = maybe_psum(
+                jnp.where(jnp.isfinite(interior), interior, 0.0) * has_rows,
+                axis_name,
+            )
+            den = jnp.maximum(maybe_psum(has_rows, axis_name), 1.0)
+            interior = num / den
+        F = X.shape[1]
+        edges = jnp.concatenate(
+            [interior, jnp.full((F, 1), jnp.inf, X.dtype)], axis=1
+        )
         T = (X[:, :, None] <= edges[None, :, :]).astype(jnp.int8)
         return {"edges": edges, "T": T}
 

@@ -1,0 +1,161 @@
+"""MLP learner tests: convergence, weighting, minibatch determinism,
+vmap-ability, ensemble + mesh integration [SURVEY §4, B:10]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    MLPClassifier,
+    MLPRegressor,
+    make_mesh,
+)
+
+KEY = jax.random.key(0)
+
+
+def _breast_cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y, jnp.int32), X, y
+
+
+def _two_moons(n=400, seed=0):
+    """XOR-ish nonlinear problem a linear model cannot solve."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.int64)
+    return X, y
+
+
+class TestMLPClassifier:
+    def test_solves_xor(self):
+        X, y = _two_moons()
+        mlp = MLPClassifier(hidden=32, max_iter=400, lr=3e-3)
+        params, aux = mlp.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32),
+            jnp.ones(len(y)), 2,
+        )
+        acc = (
+            np.asarray(mlp.predict_scores(params, jnp.asarray(X)).argmax(1))
+            == y
+        ).mean()
+        assert acc > 0.95  # a linear model caps at ~0.5 here
+
+    def test_breast_cancer(self):
+        Xj, yj, X, y = _breast_cancer()
+        mlp = MLPClassifier(hidden=32, max_iter=300, lr=3e-3)
+        params, aux = mlp.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        acc = (np.asarray(mlp.predict_scores(params, Xj).argmax(1)) == y).mean()
+        assert acc > 0.96
+        curve = np.asarray(aux["loss_curve"])
+        assert curve[-1] < curve[0]
+
+    def test_minibatch_mode(self):
+        Xj, yj, X, y = _breast_cancer()
+        mlp = MLPClassifier(hidden=32, max_iter=400, batch_size=64, lr=3e-3)
+        params, _ = mlp.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        acc = (np.asarray(mlp.predict_scores(params, Xj).argmax(1)) == y).mean()
+        assert acc > 0.95
+
+    def test_seed_determinism(self):
+        X, y = _two_moons()
+        mlp = MLPClassifier(hidden=8, max_iter=50, batch_size=32)
+        a, _ = mlp.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32), jnp.ones(len(y)), 2
+        )
+        b, _ = mlp.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32), jnp.ones(len(y)), 2
+        )
+        np.testing.assert_allclose(np.asarray(a["W1"]), np.asarray(b["W1"]))
+
+    def test_zero_weight_rows_ignored_fullbatch(self):
+        X, y = _two_moons()
+        # class-1 rows zero-weighted: the net must not predict class 1
+        w = np.where(y == 1, 0.0, 1.0).astype(np.float32)
+        mlp = MLPClassifier(hidden=16, max_iter=200, lr=3e-3)
+        params, _ = mlp.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32), jnp.asarray(w), 2
+        )
+        pred = np.asarray(mlp.predict_scores(params, jnp.asarray(X)).argmax(1))
+        assert (pred == 1).mean() < 0.02
+
+    def test_invalid_activation_raises(self):
+        with pytest.raises(ValueError, match="activation"):
+            MLPClassifier(activation="sigmoidal")
+
+    def test_vmap_over_replicas(self):
+        X, y = _two_moons(200)
+        mlp = MLPClassifier(hidden=8, max_iter=30)
+        ws = jnp.asarray(
+            np.random.default_rng(0).poisson(1.0, (4, len(y))).astype(np.float32)
+        )
+        keys = jax.vmap(lambda i: jax.random.fold_in(KEY, i))(jnp.arange(4))
+        params, aux = jax.vmap(
+            lambda k, w: mlp.fit_from_init(
+                k, jnp.asarray(X), jnp.asarray(y, jnp.int32), w, 2
+            )
+        )(keys, ws)
+        assert params["W1"].shape == (4, 2, 8)
+        assert not np.allclose(
+            np.asarray(params["W1"][0]), np.asarray(params["W1"][1])
+        )
+
+
+class TestMLPRegressor:
+    def test_fits_nonlinear_function(self):
+        rng = np.random.default_rng(0)
+        X = rng.uniform(-2, 2, size=(500, 1)).astype(np.float32)
+        y = np.sin(2 * X[:, 0]).astype(np.float32)
+        mlp = MLPRegressor(hidden=64, max_iter=600, lr=1e-2, l2=1e-6)
+        params, _ = mlp.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(500), 1
+        )
+        pred = np.asarray(mlp.predict_scores(params, jnp.asarray(X)))
+        mse = ((pred - y) ** 2).mean()
+        assert mse < 0.05  # var(y) ≈ 0.5 ⇒ this is a real fit
+
+    def test_diabetes(self):
+        X, y = load_diabetes(return_X_y=True)
+        X = StandardScaler().fit_transform(X).astype(np.float32)
+        y = ((y - y.mean()) / y.std()).astype(np.float32)
+        mlp = MLPRegressor(hidden=16, max_iter=300, lr=3e-3)
+        params, _ = mlp.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)), 1
+        )
+        pred = np.asarray(mlp.predict_scores(params, jnp.asarray(X)))
+        r2 = 1 - ((pred - y) ** 2).sum() / (y**2).sum()
+        assert r2 > 0.4
+
+
+class TestMLPBagging:
+    def test_bagged_mlps_breast_cancer(self):
+        Xj, yj, X, y = _breast_cancer()
+        clf = BaggingClassifier(
+            base_learner=MLPClassifier(hidden=16, max_iter=150, lr=3e-3),
+            n_estimators=10,
+            seed=0,
+        )
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.95
+        proba = clf.predict_proba(X)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-4)
+
+    def test_bagged_mlp_regressor_on_mesh(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        y = (np.sin(X[:, 0]) + X[:, 1] ** 2).astype(np.float32)
+        mesh = make_mesh(data=2)
+        reg = BaggingRegressor(
+            base_learner=MLPRegressor(hidden=16, max_iter=150, lr=1e-2),
+            n_estimators=8,
+            seed=0,
+            mesh=mesh,
+        )
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.5
