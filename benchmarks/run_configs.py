@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Benchmark CLI: run the five BASELINE eval configs [B:7-11, SURVEY §7
+step 9] and emit the BASELINE.md results table.
+
+Usage::
+
+    python benchmarks/run_configs.py                 # all configs, smoke scale
+    python benchmarks/run_configs.py --scale full    # BASELINE-sized runs
+    python benchmarks/run_configs.py --configs 1,3   # subset
+
+Scales:
+
+- ``smoke``  — CI-sized (seconds on CPU); validates every config end to
+  end with the exact estimator/learner wiring of the full runs.
+- ``full``   — BASELINE.md row sizes (581k covtype, 11M HIGGS, 1M-row
+  Criteo stand-in). Needs a real accelerator and patience.
+
+Each config prints one JSON line and the run ends with a markdown table;
+results are also written to ``benchmarks/results_<scale>.json``.
+
+Dataset provenance: zero-egress environment, so covtype/HIGGS/Criteo/
+California are deterministic synthetics with matched (rows, features,
+classes) signatures [utils/datasets.py]; breast-cancer is the real
+sklearn-bundled dataset (config 1's CPU anchor [B:7]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _standardize(X: np.ndarray) -> np.ndarray:
+    mu, sigma = X.mean(0), X.std(0) + 1e-8
+    return ((X - mu) / sigma).astype(np.float32)
+
+
+def _split(X, y, test_frac=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    n_test = int(len(y) * test_frac)
+    te, tr = idx[:n_test], idx[n_test:]
+    return X[tr], y[tr], X[te], y[te]
+
+
+# ---------------------------------------------------------------------
+# Config definitions — one per BASELINE.md row [B:7-11]
+# ---------------------------------------------------------------------
+
+
+def config_1(scale: str) -> dict:
+    """BaggingClassifier(LogisticRegression, 10 bags), breast-cancer —
+    the CPU reference anchor [B:7]. Also measures the sklearn CPU proxy
+    (documented substitution, BASELINE.md notes)."""
+    from sklearn.ensemble import BaggingClassifier as SkBagging
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+    from spark_bagging_tpu.utils.datasets import load_dataset
+
+    X, y = load_dataset("breast_cancer")
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    # CPU proxy (reference stand-in): sklearn bagged logreg.
+    t0 = time.perf_counter()
+    sk = SkBagging(SkLR(max_iter=200), n_estimators=10, random_state=0)
+    sk.fit(Xtr, ytr)
+    sk_fit_s = time.perf_counter() - t0
+    sk_acc = float(sk.score(Xte, yte))
+
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=20, l2=1e-3),
+        n_estimators=10, seed=0,
+    )
+    clf.fit(Xtr, ytr)
+    acc = clf.score(Xte, yte)
+    rep = clf.fit_report_
+    return {
+        "config": 1,
+        "name": "logreg_bag10_breast_cancer",
+        "metric": "accuracy",
+        "value": round(acc, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+        "cpu_proxy": {
+            "accuracy": round(sk_acc, 4),
+            "fits_per_sec": round(10 / sk_fit_s, 2),
+            "impl": "sklearn BaggingClassifier(LogisticRegression)",
+        },
+        "accuracy_parity": bool(acc >= sk_acc - 0.02),
+    }
+
+
+def config_2(scale: str) -> dict:
+    """BaggingRegressor(LinearRegression, 100 bags), California-housing
+    signature [B:8] — RMSE + fits/sec."""
+    from spark_bagging_tpu import BaggingRegressor, LinearRegression
+    from spark_bagging_tpu.utils.datasets import synthetic_california
+    from spark_bagging_tpu.utils.metrics import rmse
+
+    n_rows = 20_640 if scale == "full" else 4_000
+    X, y = synthetic_california(n_rows)
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    reg = BaggingRegressor(
+        base_learner=LinearRegression(l2=1e-4), n_estimators=100, seed=0
+    )
+    reg.fit(Xtr, ytr)
+    err = rmse(yte, reg.predict(Xte))
+    rep = reg.fit_report_
+    return {
+        "config": 2,
+        "name": "linreg_bag100_california",
+        "metric": "rmse",
+        "value": round(err, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+    }
+
+
+def config_3(scale: str) -> dict:
+    """BaggingClassifier(DecisionTree depth=5, 256 bags), covtype-581k,
+    vmap'd [B:9] — accuracy + fits/sec."""
+    from spark_bagging_tpu import BaggingClassifier
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+    from spark_bagging_tpu.utils.datasets import synthetic_covtype
+
+    n_rows = 581_012 if scale == "full" else 20_000
+    n_estimators = 256 if scale == "full" else 32
+    chunk = 32 if scale == "full" else None
+    X, y = synthetic_covtype(n_rows)
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    clf = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=5, n_bins=32),
+        n_estimators=n_estimators, max_features=0.8, chunk_size=chunk,
+        voting="hard", seed=0,
+    )
+    clf.fit(Xtr, ytr)
+    acc = clf.score(Xte, yte)
+    rep = clf.fit_report_
+    return {
+        "config": 3,
+        "name": f"tree_d5_bag{n_estimators}_covtype{n_rows // 1000}k",
+        "metric": "accuracy",
+        "value": round(acc, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+    }
+
+
+def config_4(scale: str) -> dict:
+    """BaggingClassifier(2-layer MLP, 512 bags), HIGGS-11M signature
+    [B:10] — AUC + fits/sec. Full scale subsamples HIGGS rows to what a
+    single chip holds comfortably alongside 512 replicas; the 11M-row
+    run is the pod-scale variant (mesh over v5e-8)."""
+    from spark_bagging_tpu import BaggingClassifier
+    from spark_bagging_tpu.models import MLPClassifier
+    from spark_bagging_tpu.utils.datasets import synthetic_higgs
+    from spark_bagging_tpu.utils.metrics import roc_auc
+
+    n_rows = 2_000_000 if scale == "full" else 20_000
+    n_estimators = 512 if scale == "full" else 16
+    chunk = 64 if scale == "full" else None
+    X, y = synthetic_higgs(n_rows)
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    clf = BaggingClassifier(
+        base_learner=MLPClassifier(
+            hidden=32, max_iter=200, batch_size=1024, lr=0.01
+        ),
+        n_estimators=n_estimators, chunk_size=chunk, seed=0,
+    )
+    clf.fit(Xtr, ytr)
+    auc = roc_auc(yte, clf.predict_proba(Xte)[:, 1])
+    rep = clf.fit_report_
+    return {
+        "config": 4,
+        "name": f"mlp_bag{n_estimators}_higgs{n_rows // 1000}k",
+        "metric": "auc",
+        "value": round(auc, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+    }
+
+
+def config_5(scale: str) -> dict:
+    """1024-bag LogReg on the Criteo-shaped stand-in, shard_map
+    data-parallel [B:11] — AUC + row throughput. Uses a (data, 1) mesh
+    over all available devices (v5p-64 in the BASELINE row; whatever is
+    attached here)."""
+    import jax
+
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+    from spark_bagging_tpu.parallel.mesh import make_mesh
+    from spark_bagging_tpu.utils.datasets import synthetic_criteo
+    from spark_bagging_tpu.utils.metrics import roc_auc
+
+    if scale == "full":
+        n_rows, n_features, n_estimators, chunk = 1_000_000, 1024, 1024, 64
+    else:
+        n_rows, n_features, n_estimators, chunk = 20_000, 128, 64, None
+    X, y = synthetic_criteo(n_rows, n_features)
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    n_dev = jax.device_count()
+    mesh = make_mesh(data=n_dev, replica=1) if n_dev > 1 else None
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=8, l2=1e-4),
+        n_estimators=n_estimators, chunk_size=chunk, mesh=mesh, seed=0,
+    )
+    clf.fit(Xtr, ytr)
+    auc = roc_auc(yte, clf.predict_proba(Xte)[:, 1])
+    rep = clf.fit_report_
+    rows_per_sec = rep["n_rows"] * rep["n_replicas"] / rep["fit_seconds"]
+    return {
+        "config": 5,
+        "name": f"logreg_bag{n_estimators}_criteo{n_rows // 1000}k_dp",
+        "metric": "auc",
+        "value": round(auc, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "row_replica_per_sec": round(rows_per_sec, 0),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+    }
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--configs", default="1,2,3,4,5")
+    p.add_argument("--scale", choices=["smoke", "full"], default="smoke")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    wanted = [int(c) for c in args.configs.split(",")]
+    results = []
+    for c in wanted:
+        t0 = time.perf_counter()
+        res = CONFIGS[c](args.scale)
+        res["wall_seconds"] = round(time.perf_counter() - t0, 2)
+        res["backend"] = jax.default_backend()
+        print(json.dumps(res))
+        results.append(res)
+
+    out = args.json_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"results_{args.scale}.json",
+    )
+    with open(out, "w") as f:
+        json.dump({"scale": args.scale, "results": results}, f, indent=2)
+
+    print(f"\n| # | config | metric | value | fits/sec | wall s |")
+    print(f"|---|---|---|---|---|---|")
+    for r in results:
+        print(
+            f"| {r['config']} | {r['name']} | {r['metric']} | {r['value']} "
+            f"| {r['fits_per_sec']} | {r['wall_seconds']} |"
+        )
+
+
+if __name__ == "__main__":
+    main()

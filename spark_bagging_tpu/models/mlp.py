@@ -48,6 +48,7 @@ class _MLPBase(BaseLearner):
         lr: float = 1e-3,
         l2: float = 1e-4,
         activation: str = "relu",
+        precision: str = "high",
     ):
         if activation not in _ACTIVATIONS:
             raise ValueError(
@@ -56,12 +57,17 @@ class _MLPBase(BaseLearner):
             )
         if hidden < 1:
             raise ValueError(f"hidden must be >= 1, got {hidden}")
+        if batch_size is not None and batch_size < 1:
+            raise ValueError(
+                f"batch_size must be >= 1 or None, got {batch_size}"
+            )
         self.hidden = hidden
         self.max_iter = max_iter
         self.batch_size = batch_size
         self.lr = lr
         self.l2 = l2
         self.activation = activation
+        self.precision = precision
 
     def init_params(self, key, n_features, n_outputs):
         k1, k2 = jax.random.split(key)
@@ -93,6 +99,16 @@ class _MLPBase(BaseLearner):
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
+        # MXU precision (trace-time context): SGD tolerates lower matmul
+        # precision than the closed-form solvers, so default "high"
+        # (not the bf16 TPU default, which degrades convergence; not
+        # "highest", which the noise-tolerant optimizer doesn't need).
+        with jax.default_matmul_precision(self.precision):
+            return self._fit(params, X, y, sample_weight, key,
+                             axis_name=axis_name, prepared=prepared)
+
+    def _fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+             prepared=None):
         del prepared
         X = X.astype(jnp.float32)
         w = sample_weight.astype(jnp.float32)

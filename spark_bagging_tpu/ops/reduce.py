@@ -17,14 +17,3 @@ def maybe_psum(x, axis_name: str | None):
     if axis_name is None:
         return x
     return jax.lax.psum(x, axis_name)
-
-
-def maybe_pmean(x, axis_name: str | None):
-    """``lax.pmean`` over ``axis_name`` if set, identity otherwise.
-
-    Used where shards must agree on *approximate* shared state (e.g.
-    per-shard quantile bin edges averaged into one global binning —
-    any shard-consistent monotone edges are valid bins)."""
-    if axis_name is None:
-        return x
-    return jax.lax.pmean(x, axis_name)
