@@ -28,6 +28,13 @@ from spark_bagging_tpu.models import (
 )
 from spark_bagging_tpu.parallel import make_mesh
 from spark_bagging_tpu.utils.checkpoint import load_model, save_model
+from spark_bagging_tpu.utils.io import (
+    ArrayChunks,
+    ChunkSource,
+    CSVChunks,
+    LibsvmChunks,
+    SyntheticChunks,
+)
 
 __version__ = "0.1.0"
 
@@ -44,4 +51,9 @@ __all__ = [
     "make_mesh",
     "save_model",
     "load_model",
+    "ChunkSource",
+    "ArrayChunks",
+    "SyntheticChunks",
+    "LibsvmChunks",
+    "CSVChunks",
 ]

@@ -151,6 +151,35 @@ def _jitted_oob(learner, n_replicas, ratio, replacement, n_classes, chunk_size,
     )
 
 
+class _EncodedChunks:
+    """Label-encoding view over a ChunkSource: maps raw labels to class
+    indices chunk-by-chunk (the streaming analog of the ``np.unique``
+    encode in ``BaggingClassifier.fit``)."""
+
+    def __init__(self, inner, classes: np.ndarray):
+        self._inner = inner
+        self._classes = classes
+        self.n_features = inner.n_features
+        self.n_rows = inner.n_rows
+        self.chunk_rows = inner.chunk_rows
+
+    @property
+    def n_chunks(self) -> int:
+        return self._inner.n_chunks
+
+    def chunks(self):
+        for X, y, n_valid in self._inner.chunks():
+            idx = np.searchsorted(self._classes, y)
+            idx_c = np.minimum(idx, len(self._classes) - 1)
+            bad = self._classes[idx_c[:n_valid]] != y[:n_valid]
+            if bad.any():
+                raise ValueError(
+                    f"stream contains labels not in classes: "
+                    f"{np.unique(np.asarray(y[:n_valid])[bad])[:5]}"
+                )
+            yield X, idx_c, n_valid
+
+
 class _BaseBagging(ParamsMixin):
     """Shared engine driver for both estimators [SURVEY §2a #4–6]."""
 
@@ -318,6 +347,60 @@ class _BaseBagging(ParamsMixin):
             compile_seconds=t_compile,
         )
 
+    def _fit_stream_engine(
+        self, source, n_outputs: int, *, n_epochs: int,
+        steps_per_chunk: int, lr: float,
+    ):
+        """Out-of-core fit over a ChunkSource [SURVEY §7 step 8]."""
+        from spark_bagging_tpu.streaming import fit_ensemble_stream
+
+        if self.n_estimators < 1:
+            raise ValueError("n_estimators must be >= 1")
+        if self.oob_score:
+            raise ValueError(
+                "oob_score is not supported with fit_stream (per-chunk "
+                "weight draws have no global OOB regeneration path)"
+            )
+        learner = self._learner()
+        n_subspace = self._n_subspace(source.n_features)
+        key = jax.random.key(self.seed)
+        t0 = time.perf_counter()
+        params, subspaces, aux = fit_ensemble_stream(
+            learner, source, key, self.n_estimators, n_outputs,
+            n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
+            sample_ratio=float(self.max_samples),
+            bootstrap=bool(self.bootstrap),
+            n_subspace=n_subspace,
+            bootstrap_features=bool(self.bootstrap_features),
+            mesh=self.mesh,
+        )
+        losses_np = np.asarray(aux["loss"])  # device->host barrier
+        t_fit = time.perf_counter() - t0
+
+        self.ensemble_ = params
+        self.subspaces_ = subspaces
+        self.n_features_in_ = int(source.n_features)
+        self.n_estimators_ = int(self.n_estimators)
+        self._fit_key = key
+        self._fitted_learner = learner
+        self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        self._identity_subspace = (
+            n_subspace == source.n_features and not self.bootstrap_features
+        )
+        self.fit_report_ = fit_report(
+            n_replicas=self.n_estimators,
+            fit_seconds=t_fit,
+            losses=losses_np,
+            n_rows=int(source.n_rows),
+            n_features=int(source.n_features),
+            n_subspace=n_subspace,
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            compile_seconds=aux["first_step_seconds"],
+        )
+        self.fit_report_["n_chunks"] = aux["n_chunks"]
+        self.fit_report_["n_epochs"] = aux["n_epochs"]
+
     def _oob_scores(self, X: jnp.ndarray, n_classes: int | None):
         """OOB aggregate + vote counts (rows with zero votes excluded by
         caller) [SURVEY §4]."""
@@ -381,6 +464,47 @@ class BaggingClassifier(_BaseBagging):
             )
         return self
 
+    def fit_stream(
+        self,
+        source,
+        *,
+        classes=None,
+        n_epochs: int = 1,
+        steps_per_chunk: int = 1,
+        lr: float = 0.01,
+        chunk_rows: int | None = None,
+    ) -> "BaggingClassifier":
+        """Out-of-core fit from a ChunkSource (or an ``(X, y)`` tuple,
+        auto-chunked) [SURVEY §7 step 8, B:11].
+
+        ``classes`` lists the label values; if None, one discovery pass
+        over the source collects them (an extra full scan — pass them
+        for large streams). Requires a streamable base learner (SGD
+        path); trees need the in-memory ``fit``.
+        """
+        from spark_bagging_tpu.utils.io import as_chunk_source
+
+        source = as_chunk_source(source, chunk_rows)
+        if classes is None:
+            seen: set = set()
+            for _, y, n_valid in source.chunks():
+                seen.update(np.unique(y[:n_valid]).tolist())
+            classes = sorted(seen)
+        # np.unique sorts and dedups — _EncodedChunks encodes labels
+        # with searchsorted, which silently corrupts targets on an
+        # unsorted or duplicated classes array.
+        self.classes_ = np.unique(np.asarray(classes))
+        if self.classes_.ndim != 1 or len(self.classes_) < 2:
+            raise ValueError("classes must be 1-D with >= 2 entries")
+        if len(self.classes_) != len(np.asarray(classes).ravel()):
+            raise ValueError("classes contains duplicate values")
+        self.n_classes_ = int(len(self.classes_))
+        self._fit_stream_engine(
+            _EncodedChunks(source, self.classes_), self.n_classes_,
+            n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
+        )
+        return self
+
     def predict_proba(self, X) -> np.ndarray:
         self._check_fitted()
         X = self._validate_X(X, fitted=True)
@@ -432,6 +556,24 @@ class BaggingRegressor(_BaseBagging):
                 has_vote, sums / np.maximum(votes, 1), np.nan
             )
             self.oob_score_ = r2_score(np.asarray(y)[has_vote], oob_pred)
+        return self
+
+    def fit_stream(
+        self,
+        source,
+        *,
+        n_epochs: int = 1,
+        steps_per_chunk: int = 1,
+        lr: float = 0.01,
+        chunk_rows: int | None = None,
+    ) -> "BaggingRegressor":
+        """Out-of-core fit from a ChunkSource (or ``(X, y)`` tuple)
+        [SURVEY §7 step 8]; see ``BaggingClassifier.fit_stream``."""
+        from spark_bagging_tpu.utils.io import as_chunk_source
+
+        source = as_chunk_source(source, chunk_rows)
+        self._fit_stream_engine(source, 1, n_epochs=n_epochs,
+                                steps_per_chunk=steps_per_chunk, lr=lr)
         return self
 
     def predict(self, X) -> np.ndarray:

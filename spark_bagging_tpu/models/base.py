@@ -44,6 +44,11 @@ class BaseLearner(ParamsMixin):
     """Abstract base-learner contract (see module docstring)."""
 
     task: ClassVar[str]  # "classification" | "regression"
+    # Streamable learners additionally implement ``row_loss``/``penalty``
+    # so the out-of-core engine (streaming.py) can take minibatch
+    # gradients over data chunks. Closed-form / structure-search
+    # learners (trees) are not streamable [SURVEY §7 step 8].
+    streamable: ClassVar[bool] = False
 
     def init_params(
         self, key: jax.Array, n_features: int, n_outputs: int
@@ -65,6 +70,25 @@ class BaseLearner(ParamsMixin):
 
     def predict_scores(self, params: Params, X: jax.Array) -> jax.Array:
         raise NotImplementedError
+
+    # -- optional streaming contract ------------------------------------
+    #
+    # ``row_loss(params, X, y) -> (n,)`` per-row unweighted loss and
+    # ``penalty(params) -> scalar`` let the out-of-core engine fit the
+    # learner by stochastic gradient over data chunks with per-chunk
+    # Poisson weights [P:5]. Only meaningful when ``streamable = True``.
+
+    def row_loss(
+        self, params: Params, X: jax.Array, y: jax.Array
+    ) -> jax.Array:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming fits"
+        )
+
+    def penalty(self, params: Params) -> jax.Array:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support streaming fits"
+        )
 
     # -- optional replica-invariant precomputation ----------------------
     #

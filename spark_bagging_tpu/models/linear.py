@@ -24,6 +24,7 @@ class LinearRegression(BaseLearner):
     """Weighted least squares with L2 penalty (bias unpenalized)."""
 
     task = "regression"
+    streamable = True
 
     def __init__(self, l2: float = 1e-6, precision: str = "highest"):
         self.l2 = l2
@@ -36,6 +37,14 @@ class LinearRegression(BaseLearner):
     def predict_scores(self, params, X):
         beta = params["beta"]
         return X.astype(beta.dtype) @ beta[:-1] + beta[-1]
+
+    # -- streaming contract (out-of-core engine, streaming.py) ---------
+
+    def row_loss(self, params, X, y):
+        return 0.5 * (self.predict_scores(params, X) - y) ** 2
+
+    def penalty(self, params):
+        return 0.5 * self.l2 * jnp.sum(params["beta"][:-1] ** 2)
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):

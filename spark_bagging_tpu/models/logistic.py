@@ -57,6 +57,7 @@ class LogisticRegression(BaseLearner):
     """
 
     task = "classification"
+    streamable = True
 
     def __init__(
         self,
@@ -83,6 +84,15 @@ class LogisticRegression(BaseLearner):
 
     def _penalty(self, W):
         return 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)  # bias unpenalized
+
+    # -- streaming contract (out-of-core engine, streaming.py) ---------
+
+    def row_loss(self, params, X, y):
+        logp = jax.nn.log_softmax(self.predict_scores(params, X), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+
+    def penalty(self, params):
+        return self._penalty(params["W"])
 
     def _global_loss(self, W, Xb, y, w, w_sum, axis_name):
         """Global weighted mean NLL + penalty (for reporting/curves)."""

@@ -40,6 +40,8 @@ _ACTIVATIONS = {
 class _MLPBase(BaseLearner):
     """Shared forward/training loop for classifier/regressor MLPs."""
 
+    streamable = True
+
     def __init__(
         self,
         hidden: int = 64,
@@ -96,6 +98,14 @@ class _MLPBase(BaseLearner):
         return 0.5 * self.l2 * (
             jnp.sum(params["W1"] ** 2) + jnp.sum(params["W2"] ** 2)
         )
+
+    # -- streaming contract (out-of-core engine, streaming.py) ---------
+
+    def row_loss(self, params, X, y):
+        return self._row_loss(params, X.astype(jnp.float32), y)
+
+    def penalty(self, params):
+        return self._penalty(params)
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):

@@ -88,15 +88,27 @@ def make_classification(
     seed: int = 0,
     class_sep: float = 1.2,
     class_imbalance: bool = False,
+    structure_seed: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Gaussian-mixture classification data: one random center per class,
-    unit-variance clouds. ``class_sep`` controls difficulty."""
+    unit-variance clouds. ``class_sep`` controls difficulty.
+
+    ``structure_seed`` fixes the mixture itself (centers, class priors)
+    independently of ``seed`` (which then only varies the sampled rows)
+    — required when streaming one logical dataset chunk-by-chunk with
+    per-chunk seeds (``SyntheticChunks``): all chunks must share the
+    same distribution."""
     rng = np.random.default_rng(seed)
-    centers = rng.normal(0.0, class_sep, (n_classes, n_features)).astype(
+    # structure_seed=None: one sequential stream (seed fully determines
+    # the dataset, as before structure_seed existed)
+    srng = rng if structure_seed is None else np.random.default_rng(
+        structure_seed
+    )
+    centers = srng.normal(0.0, class_sep, (n_classes, n_features)).astype(
         np.float32
     )
     if class_imbalance:
-        p = rng.dirichlet(np.full(n_classes, 2.0))
+        p = srng.dirichlet(np.full(n_classes, 2.0))
     else:
         p = np.full(n_classes, 1.0 / n_classes)
     y = rng.choice(n_classes, size=n_rows, p=p).astype(np.int32)
@@ -111,15 +123,23 @@ def make_regression(
     *,
     seed: int = 0,
     noise: float = 0.5,
+    structure_seed: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
+    """``structure_seed`` fixes the true coefficients independently of
+    the row seed — see ``make_classification``."""
     rng = np.random.default_rng(seed)
-    beta = rng.normal(0.0, 1.0, n_features).astype(np.float32)
+    srng = rng if structure_seed is None else np.random.default_rng(
+        structure_seed
+    )
+    beta = srng.normal(0.0, 1.0, n_features).astype(np.float32)
     X = rng.standard_normal((n_rows, n_features), np.float32)
     y = X @ beta + noise * rng.standard_normal(n_rows).astype(np.float32)
     return X, y.astype(np.float32)
 
 
-def synthetic_covtype(n_rows: int = 581_012, seed: int = 7) -> tuple[np.ndarray, np.ndarray]:
+def synthetic_covtype(
+    n_rows: int = 581_012, seed: int = 7, structure_seed: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     """covtype-581k signature: 54 features, 7 classes, imbalanced [B:9].
 
     ``class_sep=0.3`` calibrated so single LogisticRegression accuracy
@@ -127,28 +147,40 @@ def synthetic_covtype(n_rows: int = 581_012, seed: int = 7) -> tuple[np.ndarray,
     (≈0.72), so benchmark fits do realistic solver work.
     """
     return make_classification(
-        n_rows, 54, 7, seed=seed, class_sep=0.3, class_imbalance=True
+        n_rows, 54, 7, seed=seed, class_sep=0.3, class_imbalance=True,
+        structure_seed=structure_seed,
     )
 
 
-def synthetic_higgs(n_rows: int = 11_000_000, seed: int = 11) -> tuple[np.ndarray, np.ndarray]:
+def synthetic_higgs(
+    n_rows: int = 11_000_000, seed: int = 11, structure_seed: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     """HIGGS-11M signature: 28 features, binary [B:10]."""
-    return make_classification(n_rows, 28, 2, seed=seed, class_sep=0.6)
+    return make_classification(
+        n_rows, 28, 2, seed=seed, class_sep=0.6,
+        structure_seed=structure_seed,
+    )
 
 
 def synthetic_criteo(
-    n_rows: int = 1_000_000, n_features: int = 1024, seed: int = 13
+    n_rows: int = 1_000_000, n_features: int = 1024, seed: int = 13,
+    structure_seed: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Criteo-shaped signature: wide hashed-categorical-style features,
     binary CTR labels [B:11]. Dense stand-in at configurable width."""
     return make_classification(
-        n_rows, n_features, 2, seed=seed, class_sep=0.25, class_imbalance=True
+        n_rows, n_features, 2, seed=seed, class_sep=0.25,
+        class_imbalance=True, structure_seed=structure_seed,
     )
 
 
-def synthetic_california(n_rows: int = 20_640, seed: int = 5) -> tuple[np.ndarray, np.ndarray]:
+def synthetic_california(
+    n_rows: int = 20_640, seed: int = 5, structure_seed: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     """California-housing signature: 8 features, regression [B:8]."""
-    return make_regression(n_rows, 8, seed=seed, noise=0.7)
+    return make_regression(
+        n_rows, 8, seed=seed, noise=0.7, structure_seed=structure_seed
+    )
 
 
 # ---------------------------------------------------------------------

@@ -1,0 +1,265 @@
+"""Chunked host-side data sources for out-of-core training.
+
+The reference hands Criteo-scale ingestion to Spark's partitioned
+DataFrame scan [SURVEY §1 L1]; the TPU-native equivalent is a *chunk
+source*: an object that yields fixed-shape host blocks which the
+streaming engine ships to HBM one at a time [SURVEY §7 step 8,
+hard-part 4]. No shuffle is needed — bagging's resampling is per-row
+Poisson weights drawn on-device from the chunk's id, so a chunk can be
+re-visited in any order on any epoch and regenerate exactly its weights
+[P:5].
+
+Every source yields ``(X, y, n_valid)`` with **constant shapes**
+``(chunk_rows, n_features)`` / ``(chunk_rows,)`` — the final partial
+chunk is zero-padded and ``n_valid`` marks the real rows — so the
+engine's jitted step compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator
+
+import numpy as np
+
+Chunk = tuple[np.ndarray, np.ndarray, int]
+
+
+def _pad_chunk(
+    X: np.ndarray, y: np.ndarray, chunk_rows: int
+) -> Chunk:
+    n = X.shape[0]
+    if n == chunk_rows:
+        return X, y, n
+    Xp = np.zeros((chunk_rows, X.shape[1]), X.dtype)
+    yp = np.zeros((chunk_rows,), y.dtype)
+    Xp[:n], yp[:n] = X, y
+    return Xp, yp, n
+
+
+class ChunkSource:
+    """Base chunk source: fixed-shape ``(X, y, n_valid)`` blocks.
+
+    Subclasses set ``n_features``/``n_rows``/``chunk_rows`` and implement
+    ``_iter_raw()`` yielding variable-length host blocks **in a
+    deterministic order** (chunk ids index that order; determinism is
+    what makes re-epoch weight regeneration exact).
+    """
+
+    n_features: int
+    n_rows: int
+    chunk_rows: int
+
+    def _iter_raw(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+    @property
+    def n_chunks(self) -> int:
+        return -(-self.n_rows // self.chunk_rows)
+
+    def chunks(self) -> Iterator[Chunk]:
+        """Yield fixed-shape padded chunks for one epoch."""
+        buf_X: list[np.ndarray] = []
+        buf_y: list[np.ndarray] = []
+        buffered = 0
+        for X, y in self._iter_raw():
+            X = np.ascontiguousarray(X, np.float32)
+            y = np.asarray(y)
+            buf_X.append(X)
+            buf_y.append(y)
+            buffered += len(y)
+            while buffered >= self.chunk_rows:
+                Xa = np.concatenate(buf_X) if len(buf_X) > 1 else buf_X[0]
+                ya = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+                yield Xa[: self.chunk_rows], ya[: self.chunk_rows], self.chunk_rows
+                buf_X, buf_y = [Xa[self.chunk_rows:]], [ya[self.chunk_rows:]]
+                buffered -= self.chunk_rows
+        if buffered > 0:
+            Xa = np.concatenate(buf_X) if len(buf_X) > 1 else buf_X[0]
+            ya = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+            yield _pad_chunk(Xa, ya, self.chunk_rows)
+
+
+class ArrayChunks(ChunkSource):
+    """Chunk view over in-memory arrays (or np.memmap for on-disk)."""
+
+    def __init__(self, X: np.ndarray, y: np.ndarray, chunk_rows: int):
+        if X.shape[0] != y.shape[0]:
+            raise ValueError("X and y row counts differ")
+        if chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+        self._X, self._y = X, y
+        self.n_rows = int(X.shape[0])
+        self.n_features = int(X.shape[1])
+        self.chunk_rows = int(chunk_rows)
+
+    def _iter_raw(self):
+        for start in range(0, self.n_rows, self.chunk_rows):
+            yield (
+                self._X[start : start + self.chunk_rows],
+                self._y[start : start + self.chunk_rows],
+            )
+
+
+class SyntheticChunks(ChunkSource):
+    """Out-of-core synthetic data: each chunk is generated on demand from
+    ``make_fn(n_rows, seed=chunk_seed)`` — nothing larger than one chunk
+    ever exists on the host. Stands in for Criteo-1TB-scale streaming in
+    the zero-egress build environment [B:11, BASELINE.md notes].
+
+    The per-chunk seed varies the *rows*; the dataset's structure
+    (mixture centers / true coefficients) must be chunk-invariant or the
+    stream is a nonstationary mixture, not one dataset. When ``make_fn``
+    accepts a ``structure_seed`` kwarg (the ``utils.datasets``
+    generators do), it is pinned to the source's ``seed`` automatically;
+    otherwise ``make_fn`` itself must guarantee chunk-invariance.
+    """
+
+    def __init__(
+        self,
+        make_fn: Callable[..., tuple[np.ndarray, np.ndarray]],
+        n_rows: int,
+        chunk_rows: int,
+        *,
+        seed: int = 0,
+    ):
+        import inspect
+
+        self._seed = seed
+        try:
+            accepts_structure = "structure_seed" in inspect.signature(
+                make_fn
+            ).parameters
+        except (TypeError, ValueError):  # builtins/partials w/o signature
+            accepts_structure = False
+        if accepts_structure:
+            self._make_fn = lambda n, seed: make_fn(
+                n, seed=seed, structure_seed=self._seed
+            )
+        else:
+            self._make_fn = make_fn
+        self.n_rows = int(n_rows)
+        self.chunk_rows = int(chunk_rows)
+        X0, _ = self._make_fn(1, seed=seed)
+        self.n_features = int(X0.shape[1])
+
+    def _iter_raw(self):
+        for c in range(self.n_chunks):
+            n = min(self.chunk_rows, self.n_rows - c * self.chunk_rows)
+            # chunk-id-keyed seed: epoch-stable, order-independent
+            yield self._make_fn(n, seed=self._seed + 1 + c)
+
+
+class LibsvmChunks(ChunkSource):
+    """Stream a libsvm file in chunks without loading it whole.
+
+    ``n_features`` must be given (a streaming reader can't know the
+    global max index up front); rows are densified per chunk.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        n_features: int,
+        chunk_rows: int,
+        *,
+        zero_based: bool = False,
+        n_rows: int | None = None,
+    ):
+        self.path = path
+        self.n_features = int(n_features)
+        self.chunk_rows = int(chunk_rows)
+        self._zero_based = zero_based
+        if n_rows is None:
+            with open(path) as f:
+                n_rows = sum(
+                    1 for line in f if line.split("#", 1)[0].strip()
+                )
+        self.n_rows = int(n_rows)
+
+    def _iter_raw(self):
+        X = np.zeros((self.chunk_rows, self.n_features), np.float32)
+        y = np.zeros((self.chunk_rows,), np.float32)
+        i = 0
+        with open(self.path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                y[i] = float(parts[0])
+                for item in parts[1:]:
+                    idx_s, val_s = item.split(":")
+                    j = int(idx_s) - (0 if self._zero_based else 1)
+                    if 0 <= j < self.n_features:
+                        X[i, j] = float(val_s)
+                i += 1
+                if i == self.chunk_rows:
+                    yield X.copy(), y.copy()
+                    X[:] = 0.0
+                    i = 0
+        if i > 0:
+            yield X[:i].copy(), y[:i].copy()
+
+
+class CSVChunks(ChunkSource):
+    """Stream a numeric CSV in chunks (label in ``label_col``)."""
+
+    def __init__(
+        self,
+        path: str,
+        chunk_rows: int,
+        *,
+        label_col: int = -1,
+        skip_header: bool = False,
+        n_rows: int | None = None,
+    ):
+        self.path = path
+        self.chunk_rows = int(chunk_rows)
+        self._label_col = label_col
+        self._skip_header = skip_header
+        with open(path) as f:
+            first = f.readline()
+            n_cols = len(first.split(","))
+            if n_rows is None:
+                n_rows = 1 + sum(1 for line in f if line.strip())
+                if skip_header:
+                    n_rows -= 1
+        self.n_features = n_cols - 1
+        self.n_rows = int(n_rows)
+
+    def _iter_raw(self):
+        rows: list[list[float]] = []
+        with open(self.path) as f:
+            if self._skip_header:
+                next(f)
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rows.append([float(v) for v in line.split(",")])
+                if len(rows) == self.chunk_rows:
+                    yield self._to_xy(rows)
+                    rows = []
+        if rows:
+            yield self._to_xy(rows)
+
+    def _to_xy(self, rows: list[list[float]]):
+        data = np.asarray(rows, np.float32)
+        y = data[:, self._label_col]
+        X = np.delete(data, self._label_col % data.shape[1], axis=1)
+        return np.ascontiguousarray(X), y
+
+
+def as_chunk_source(data, chunk_rows: int | None = None) -> ChunkSource:
+    """Coerce ``(X, y)`` tuples or an existing source to a ChunkSource."""
+    if isinstance(data, ChunkSource):
+        return data
+    if isinstance(data, tuple) and len(data) == 2:
+        X, y = data
+        if chunk_rows is None:
+            chunk_rows = min(int(X.shape[0]), 65536)
+        return ArrayChunks(np.asarray(X), np.asarray(y), chunk_rows)
+    raise TypeError(
+        f"expected a ChunkSource or an (X, y) tuple, got {type(data).__name__}"
+    )

@@ -1,0 +1,292 @@
+"""Out-of-core streaming fit tests [SURVEY §7 step 8, §4].
+
+Runs under the 8-device CPU fake topology (conftest.py)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import (
+    ArrayChunks,
+    BaggingClassifier,
+    BaggingRegressor,
+    CSVChunks,
+    LibsvmChunks,
+    LogisticRegression,
+    SyntheticChunks,
+    make_mesh,
+)
+from spark_bagging_tpu.models import (
+    DecisionTreeClassifier,
+    LinearRegression,
+    MLPClassifier,
+)
+from spark_bagging_tpu.utils.datasets import (
+    make_classification,
+    make_regression,
+)
+
+
+@pytest.fixture(scope="module")
+def cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------
+# Chunk sources
+# ---------------------------------------------------------------------
+
+
+def test_array_chunks_cover_all_rows_fixed_shape():
+    X = np.arange(23 * 3, dtype=np.float32).reshape(23, 3)
+    y = np.arange(23, dtype=np.float32)
+    src = ArrayChunks(X, y, chunk_rows=10)
+    assert src.n_chunks == 3
+    got_X, got_y = [], []
+    for Xc, yc, n_valid in src.chunks():
+        assert Xc.shape == (10, 3) and yc.shape == (10,)
+        got_X.append(Xc[:n_valid])
+        got_y.append(yc[:n_valid])
+    np.testing.assert_array_equal(np.concatenate(got_X), X)
+    np.testing.assert_array_equal(np.concatenate(got_y), y)
+
+
+def test_array_chunks_epochs_are_identical():
+    X, y = make_classification(57, 4, 2, seed=3)
+    src = ArrayChunks(X, y, chunk_rows=16)
+    first = list(src.chunks())
+    second = list(src.chunks())
+    for (Xa, ya, na), (Xb, yb, nb) in zip(first, second):
+        np.testing.assert_array_equal(Xa, Xb)
+        np.testing.assert_array_equal(ya, yb)
+        assert na == nb
+
+
+def test_synthetic_chunks_deterministic_and_out_of_core():
+    src = SyntheticChunks(
+        lambda n, seed, structure_seed=None: make_classification(
+            n, 5, 2, seed=seed, structure_seed=structure_seed
+        ),
+        n_rows=95, chunk_rows=40, seed=1,
+    )
+    assert src.n_features == 5
+    chunks = list(src.chunks())
+    assert len(chunks) == 3
+    assert chunks[-1][2] == 15  # final partial chunk padded
+    again = list(src.chunks())
+    np.testing.assert_array_equal(chunks[0][0], again[0][0])
+
+
+def test_synthetic_chunks_share_structure_across_chunks():
+    # every chunk must come from the SAME mixture (structure pinned to
+    # the source seed), or a streamed "dataset" is nonstationary
+    src = SyntheticChunks(
+        make_classification_5d, n_rows=4000, chunk_rows=1000, seed=1
+    )
+    class_means = []
+    for Xc, yc, n in src.chunks():
+        class_means.append(Xc[:n][yc[:n] == 0].mean(axis=0))
+    spread = np.ptp(np.stack(class_means), axis=0).max()
+    assert spread < 0.5, f"chunk class centers drifted: {spread}"
+
+
+def make_classification_5d(n, seed=0, structure_seed=None):
+    return make_classification(
+        n, 5, 2, seed=seed, structure_seed=structure_seed, class_sep=2.0
+    )
+
+
+def test_stream_classes_validation(cancer):
+    X, y = cancer
+    # unsorted classes are sorted internally — result matches sorted
+    a = BaggingClassifier(n_estimators=2, seed=0).fit_stream(
+        (X, y), classes=[1, 0], n_epochs=2, chunk_rows=256
+    )
+    np.testing.assert_array_equal(a.classes_, [0, 1])
+    with pytest.raises(ValueError, match="duplicate"):
+        BaggingClassifier(n_estimators=2).fit_stream(
+            (X, y), classes=[0, 1, 1], chunk_rows=256
+        )
+    with pytest.raises(ValueError, match="not in classes"):
+        BaggingClassifier(n_estimators=2).fit_stream(
+            (X, np.where(y == 0, 7, y)), classes=[0, 1], chunk_rows=256
+        )
+
+
+def test_libsvm_and_csv_chunks_match_full_parse(tmp_path):
+    from spark_bagging_tpu.utils.datasets import load_csv, parse_libsvm
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((17, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 17)
+
+    svm = tmp_path / "d.svm"
+    with open(svm, "w") as f:
+        for i in range(17):
+            feats = " ".join(f"{j+1}:{X[i, j]:.6f}" for j in range(4))
+            f.write(f"{y[i]} {feats}\n")
+    Xf, yf = parse_libsvm(str(svm))
+    src = LibsvmChunks(str(svm), n_features=4, chunk_rows=5)
+    assert src.n_rows == 17
+    parts = [(Xc[:n], yc[:n]) for Xc, yc, n in src.chunks()]
+    np.testing.assert_allclose(np.concatenate([p[0] for p in parts]), Xf)
+    np.testing.assert_allclose(np.concatenate([p[1] for p in parts]), yf)
+
+    csv = tmp_path / "d.csv"
+    with open(csv, "w") as f:
+        for i in range(17):
+            f.write(",".join(f"{v:.6f}" for v in X[i]) + f",{y[i]}\n")
+    Xc_full, yc_full = load_csv(str(csv))
+    src = CSVChunks(str(csv), chunk_rows=6)
+    assert src.n_rows == 17 and src.n_features == 4
+    parts = [(Xc[:n], yc[:n]) for Xc, yc, n in src.chunks()]
+    np.testing.assert_allclose(
+        np.concatenate([p[0] for p in parts]), Xc_full, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.concatenate([p[1] for p in parts]), yc_full, rtol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------
+# Streaming fits
+# ---------------------------------------------------------------------
+
+
+def test_stream_classifier_accuracy_close_to_inmemory(cancer):
+    X, y = cancer
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=25), n_estimators=16, seed=0
+    ).fit(X, y)
+    acc_mem = clf.score(X, y)
+
+    sclf = BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=16, seed=0
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=128), n_epochs=30, lr=0.05)
+    acc_stream = sclf.score(X, y)
+    assert acc_stream >= acc_mem - 0.03
+    # fitted attrs identical in kind to in-memory fit
+    assert sclf.n_estimators_ == 16
+    assert sclf.fit_report_["n_chunks"] == 5
+    assert np.isfinite(sclf.fit_report_["loss_mean"])
+
+
+def test_stream_classifier_discovers_classes(cancer):
+    X, y = cancer
+    sclf = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
+        ArrayChunks(X, y, chunk_rows=256), n_epochs=3, lr=0.05
+    )
+    np.testing.assert_array_equal(sclf.classes_, np.unique(y))
+    proba = sclf.predict_proba(X[:32])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_stream_accepts_xy_tuple(cancer):
+    X, y = cancer
+    sclf = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
+        (X, y), n_epochs=3, lr=0.05, chunk_rows=200
+    )
+    assert sclf.predict(X[:8]).shape == (8,)
+
+
+def test_stream_seed_determinism(cancer):
+    X, y = cancer
+    a = BaggingClassifier(n_estimators=6, seed=9).fit_stream(
+        (X, y), n_epochs=2, chunk_rows=200
+    )
+    b = BaggingClassifier(n_estimators=6, seed=9).fit_stream(
+        (X, y), n_epochs=2, chunk_rows=200
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.ensemble_["W"]), np.asarray(b.ensemble_["W"])
+    )
+
+
+def test_stream_regressor():
+    X, y = make_regression(600, 6, seed=2)
+    mu, s = X.mean(0), X.std(0) + 1e-8
+    X = ((X - mu) / s).astype(np.float32)
+    reg = BaggingRegressor(
+        base_learner=LinearRegression(), n_estimators=8, seed=0
+    ).fit_stream((X, y), n_epochs=60, lr=0.1, chunk_rows=128)
+    assert reg.score(X, y) > 0.7
+
+
+def test_stream_steps_per_chunk_speeds_convergence(cancer):
+    X, y = cancer
+    few = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
+        (X, y), n_epochs=2, lr=0.05, chunk_rows=256
+    )
+    many = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
+        (X, y), n_epochs=2, steps_per_chunk=20, lr=0.05, chunk_rows=256
+    )
+    assert many.fit_report_["loss_mean"] < few.fit_report_["loss_mean"]
+    assert many.score(X, y) > 0.9
+
+
+def test_stream_mlp(cancer):
+    X, y = cancer
+    sclf = BaggingClassifier(
+        base_learner=MLPClassifier(hidden=8, max_iter=10),
+        n_estimators=4, seed=0,
+    ).fit_stream((X, y), n_epochs=20, lr=0.02, chunk_rows=256)
+    assert sclf.score(X, y) > 0.9
+
+
+def test_stream_rejects_tree(cancer):
+    X, y = cancer
+    with pytest.raises(TypeError, match="streaming"):
+        BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=3),
+            n_estimators=2,
+        ).fit_stream((X, y), chunk_rows=128)
+
+
+def test_stream_rejects_oob(cancer):
+    X, y = cancer
+    with pytest.raises(ValueError, match="oob_score"):
+        BaggingClassifier(n_estimators=2, oob_score=True).fit_stream(
+            (X, y), chunk_rows=128
+        )
+
+
+def test_stream_subspaces(cancer):
+    X, y = cancer
+    sclf = BaggingClassifier(
+        n_estimators=8, max_features=0.5, seed=0
+    ).fit_stream((X, y), n_epochs=10, lr=0.05, chunk_rows=256)
+    assert sclf.subspaces_.shape == (8, 15)
+    assert sclf.score(X, y) > 0.85
+
+
+def test_stream_sharded_mesh_matches_unsharded(cancer):
+    X, y = cancer
+    # chunk_rows divisible by data axis; n_estimators by replica axis
+    mesh = make_mesh(data=2)
+    a = BaggingClassifier(n_estimators=8, seed=4, mesh=mesh).fit_stream(
+        (X, y), n_epochs=4, lr=0.05, chunk_rows=128
+    )
+    b = BaggingClassifier(n_estimators=8, seed=4).fit_stream(
+        (X, y), n_epochs=4, lr=0.05, chunk_rows=128
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.ensemble_["W"]), np.asarray(b.ensemble_["W"]),
+        rtol=2e-4, atol=2e-5,
+    )
+    assert a.score(X, y) == pytest.approx(b.score(X, y), abs=0.01)
+
+
+def test_stream_then_save_load_roundtrip(cancer, tmp_path):
+    X, y = cancer
+    sclf = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
+        (X, y), n_epochs=3, chunk_rows=256
+    )
+    path = str(tmp_path / "m")
+    sclf.save(path)
+    loaded = BaggingClassifier.load(path)
+    np.testing.assert_allclose(
+        loaded.predict_proba(X[:64]), sclf.predict_proba(X[:64]), rtol=1e-5
+    )
