@@ -60,8 +60,14 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--n-replicas", type=int, default=1000)
     p.add_argument("--n-rows", type=int, default=581_012)
+    # Tuned on v5e-1 (2026-07-29): chunk=200 is the HBM sweet spot (500
+    # OOMs on the (chunk, n, C) softmax temp); 3 damped-Newton iters
+    # reach accuracy parity (0.7756 vs CPU 0.7762, tolerance 0.01) —
+    # quadratic convergence makes iters 4-5 pure cost; "high"
+    # (bf16_3x) matmul precision keeps parity at ~2.7x the fp32 MXU
+    # rate. 5-iter/"highest" config: 46 fits/s; this config: ~109.
     p.add_argument("--chunk-size", type=int, default=200)
-    p.add_argument("--max-iter", type=int, default=5)
+    p.add_argument("--max-iter", type=int, default=3)
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")
     p.add_argument("--verbose", action="store_true")

@@ -490,13 +490,14 @@ class BaggingClassifier(_BaseBagging):
             for _, y, n_valid in source.chunks():
                 seen.update(np.unique(y[:n_valid]).tolist())
             classes = sorted(seen)
+        classes = np.asarray(classes)
+        if classes.ndim != 1 or len(classes) < 2:
+            raise ValueError("classes must be 1-D with >= 2 entries")
         # np.unique sorts and dedups — _EncodedChunks encodes labels
         # with searchsorted, which silently corrupts targets on an
         # unsorted or duplicated classes array.
-        self.classes_ = np.unique(np.asarray(classes))
-        if self.classes_.ndim != 1 or len(self.classes_) < 2:
-            raise ValueError("classes must be 1-D with >= 2 entries")
-        if len(self.classes_) != len(np.asarray(classes).ravel()):
+        self.classes_ = np.unique(classes)
+        if len(self.classes_) != len(classes):
             raise ValueError("classes contains duplicate values")
         self.n_classes_ = int(len(self.classes_))
         self._fit_stream_engine(

@@ -72,8 +72,15 @@ class ChunkSource:
                 Xa = np.concatenate(buf_X) if len(buf_X) > 1 else buf_X[0]
                 ya = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
                 yield Xa[: self.chunk_rows], ya[: self.chunk_rows], self.chunk_rows
-                buf_X, buf_y = [Xa[self.chunk_rows:]], [ya[self.chunk_rows:]]
                 buffered -= self.chunk_rows
+                # drop zero-length leftovers: a lingering empty view
+                # forces a full-chunk concatenate copy on every
+                # subsequent exact-boundary block
+                if buffered == 0:
+                    buf_X, buf_y = [], []
+                else:
+                    buf_X = [Xa[self.chunk_rows:]]
+                    buf_y = [ya[self.chunk_rows:]]
         if buffered > 0:
             Xa = np.concatenate(buf_X) if len(buf_X) > 1 else buf_X[0]
             ya = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
@@ -256,10 +263,10 @@ def as_chunk_source(data, chunk_rows: int | None = None) -> ChunkSource:
     if isinstance(data, ChunkSource):
         return data
     if isinstance(data, tuple) and len(data) == 2:
-        X, y = data
+        X, y = np.asarray(data[0]), np.asarray(data[1])
         if chunk_rows is None:
             chunk_rows = min(int(X.shape[0]), 65536)
-        return ArrayChunks(np.asarray(X), np.asarray(y), chunk_rows)
+        return ArrayChunks(X, y, chunk_rows)
     raise TypeError(
         f"expected a ChunkSource or an (X, y) tuple, got {type(data).__name__}"
     )
