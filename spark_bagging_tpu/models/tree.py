@@ -76,7 +76,19 @@ def _quantile_edges(X, row_mask, n_bins):
 
 
 class _TreeBase(BaseLearner):
-    """Shared growth engine for classifier/regressor trees."""
+    """Shared growth engine for classifier/regressor trees.
+
+    ``split_impl`` selects the split-search backend:
+
+    - ``"dense"``: precompute the ``(n, F·B)`` indicator matrix T once
+      per ensemble and contract ``Tᵀ @ R`` per level (XLA). Fastest
+      when T fits HBM comfortably.
+    - ``"fused"``: Pallas kernel (ops/hist.py) that builds indicator
+      tiles on-chip per level — O(n·F) memory instead of O(n·F·B),
+      the only feasible path at wide-feature scale [B:11].
+    - ``"auto"`` (default): ``"fused"`` on TPU when T would exceed
+      ~256 MB, else ``"dense"``.
+    """
 
     def __init__(
         self,
@@ -84,15 +96,31 @@ class _TreeBase(BaseLearner):
         n_bins: int = 32,
         hist_dtype: str = "bfloat16",
         precision: str = "highest",
+        split_impl: str = "auto",
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         if n_bins < 2:
             raise ValueError(f"n_bins must be >= 2, got {n_bins}")
+        if split_impl not in ("auto", "dense", "fused"):
+            raise ValueError(
+                f"split_impl must be auto|dense|fused, got {split_impl!r}"
+            )
         self.max_depth = max_depth
         self.n_bins = n_bins
         self.hist_dtype = hist_dtype
         self.precision = precision
+        self.split_impl = split_impl
+
+    def _resolved_impl(self, n_rows: int, n_features: int) -> str:
+        if self.split_impl != "auto":
+            return self.split_impl
+        if (
+            jax.default_backend() == "tpu"
+            and n_rows * n_features * self.n_bins > 256 * 1024 * 1024
+        ):
+            return "fused"
+        return "dense"
 
     # -- prepare hook ---------------------------------------------------
 
@@ -119,14 +147,17 @@ class _TreeBase(BaseLearner):
         edges = jnp.concatenate(
             [interior, jnp.full((F, 1), jnp.inf, X.dtype)], axis=1
         )
+        if self._resolved_impl(X.shape[0], F) == "fused":
+            # the fused kernel builds indicator tiles on-chip — no T
+            return {"edges": edges}
         T = (X[:, :, None] <= edges[None, :, :]).astype(jnp.int8)
         return {"edges": edges, "T": T}
 
     def gather_subspace(self, prepared, idx):
-        return {
-            "edges": prepared["edges"][idx],
-            "T": prepared["T"][:, idx, :],
-        }
+        out = {"edges": prepared["edges"][idx]}
+        if "T" in prepared:
+            out["T"] = prepared["T"][:, idx, :]
+        return out
 
     # -- growth ---------------------------------------------------------
 
@@ -143,12 +174,14 @@ class _TreeBase(BaseLearner):
         B, d = self.n_bins, self.max_depth
         K = S.shape[1]
         edges = prepared["edges"]
+        fused = "T" not in prepared
         hdt = jnp.dtype(self.hist_dtype)
         if hdt == jnp.bfloat16 and jax.default_backend() == "cpu":
             # CPU XLA's dot thunk lacks BF16×BF16→F32; the fake-device
             # test backend [SURVEY §4] silently upgrades to f32.
             hdt = jnp.dtype(jnp.float32)
-        Tf = prepared["T"].reshape(n, F * B).astype(hdt)
+        if not fused:
+            Tf = prepared["T"].reshape(n, F * B).astype(hdt)
         Sh = S.astype(hdt)
 
         node = jnp.zeros((n,), jnp.int32)  # level-relative node index
@@ -156,18 +189,33 @@ class _TreeBase(BaseLearner):
         with jax.default_matmul_precision(self.precision):
             for level in range(d):
                 N = 2**level
-                R = (
-                    jax.nn.one_hot(node, N, dtype=hdt)[:, :, None]
-                    * Sh[:, None, :]
-                ).reshape(n, N * K)
-                # (F·B, N·K) left statistics — the level's whole split
-                # search as one MXU contraction (accumulates in f32).
-                hist = maybe_psum(
-                    jnp.matmul(
-                        Tf.T, R, preferred_element_type=jnp.float32
-                    ),
-                    axis_name,
-                ).reshape(F, B, N, K)
+                if fused:
+                    from spark_bagging_tpu.ops.hist import (
+                        binned_left_stats,
+                    )
+
+                    hist = maybe_psum(
+                        binned_left_stats(
+                            X, edges, node, S,
+                            n_nodes=N,
+                            hist_dtype=str(hdt),
+                            interpret=jax.default_backend() != "tpu",
+                        ),
+                        axis_name,
+                    )
+                else:
+                    R = (
+                        jax.nn.one_hot(node, N, dtype=hdt)[:, :, None]
+                        * Sh[:, None, :]
+                    ).reshape(n, N * K)
+                    # (F·B, N·K) left statistics — the level's whole
+                    # split search as one MXU contraction (f32 accum).
+                    hist = maybe_psum(
+                        jnp.matmul(
+                            Tf.T, R, preferred_element_type=jnp.float32
+                        ),
+                        axis_name,
+                    ).reshape(F, B, N, K)
                 total = hist[0, -1]  # edge B-1 is +inf ⇒ full-node sums
                 left = hist
                 right = total[None, None, :, :] - left
@@ -248,8 +296,9 @@ class DecisionTreeClassifier(_TreeBase):
         leaf_smoothing: float = 1.0,
         hist_dtype: str = "bfloat16",
         precision: str = "highest",
+        split_impl: str = "auto",
     ):
-        super().__init__(max_depth, n_bins, hist_dtype, precision)
+        super().__init__(max_depth, n_bins, hist_dtype, precision, split_impl)
         self.leaf_smoothing = leaf_smoothing
 
     def init_params(self, key, n_features, n_outputs):

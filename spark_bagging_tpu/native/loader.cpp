@@ -1,0 +1,311 @@
+// Native data loader: libsvm / CSV -> dense float32 matrices.
+//
+// The reference's ingestion rides Spark's native-accelerated IO stack
+// (Tungsten row memory, JNI codecs) [SURVEY §2b]; this is the
+// TPU-native framework's equivalent: a small C++ parser behind a C ABI,
+// loaded from Python via ctypes (utils/native.py), feeding host numpy
+// buffers that jax.device_put ships to HBM [B:5]. Python parsers in
+// utils/datasets.py remain as the portable fallback.
+//
+// Two access patterns:
+//  - whole-file: *_dims() then *_fill() into caller-allocated buffers;
+//  - streaming:  reader_open()/reader_next()/reader_close() yields
+//    fixed-size row blocks for the out-of-core engine (utils/io.py).
+//
+// All functions return 0 on success, negative error codes otherwise.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr int kErrOpen = -1;
+constexpr int kErrParse = -2;
+constexpr int kErrArg = -3;
+
+// fast float parse: strtof handles inf/nan/exponents; we just wrap it
+inline bool parse_float(const char*& p, float* out) {
+  char* end = nullptr;
+  *out = strtof(p, &end);
+  if (end == p) return false;
+  p = end;
+  return true;
+}
+
+inline void skip_ws(const char*& p) {
+  while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+}
+
+struct LineReader {
+  FILE* f = nullptr;
+  char* buf = nullptr;
+  size_t cap = 0;
+
+  explicit LineReader(const char* path) { f = fopen(path, "rb"); }
+  ~LineReader() {
+    if (f) fclose(f);
+    free(buf);
+  }
+  bool ok() const { return f != nullptr; }
+  // returns nullptr at EOF; strips trailing newline
+  const char* next() {
+    if (!f) return nullptr;
+    ssize_t n = getline(&buf, &cap, f);
+    if (n < 0) return nullptr;
+    while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = 0;
+    return buf;
+  }
+};
+
+// does the line hold anything besides whitespace/comment?
+inline bool svm_line_nonempty(const char* line) {
+  const char* p = line;
+  skip_ws(p);
+  return *p != 0 && *p != '#';
+}
+
+// parse one libsvm line into y + (idx, val) writes on a dense row
+inline int svm_parse_line(const char* line, float* y, float* row,
+                          int64_t n_features, int zero_based) {
+  const char* p = line;
+  skip_ws(p);
+  if (!parse_float(p, y)) return kErrParse;
+  while (true) {
+    skip_ws(p);
+    if (*p == 0 || *p == '#') break;
+    char* end = nullptr;
+    long idx = strtol(p, &end, 10);
+    if (end == p || *end != ':') return kErrParse;
+    p = end + 1;
+    float val;
+    if (!parse_float(p, &val)) return kErrParse;
+    int64_t j = zero_based ? idx : idx - 1;
+    if (j >= 0 && j < n_features) row[j] = val;
+  }
+  return 0;
+}
+
+// parse one CSV line of n_cols floats into dst
+inline int csv_parse_line(const char* line, float* dst, int64_t n_cols) {
+  const char* p = line;
+  for (int64_t c = 0; c < n_cols; ++c) {
+    skip_ws(p);
+    if (!parse_float(p, &dst[c])) return kErrParse;
+    skip_ws(p);
+    if (c + 1 < n_cols) {
+      if (*p != ',') return kErrParse;
+      ++p;
+    }
+  }
+  return 0;
+}
+
+struct Reader {
+  LineReader lr;
+  int fmt;  // 0 = libsvm, 1 = csv
+  int64_t n_features = 0;
+  int64_t n_cols = 0;  // csv: total columns incl. label
+  int64_t label_col = -1;
+  int zero_based = 0;
+
+  Reader(const char* path, int fmt_) : lr(path), fmt(fmt_) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- whole-file libsvm -------------------------------------------------
+
+// rows and 1-based max feature index (0 if none)
+int svm_dims(const char* path, int zero_based, int64_t* n_rows,
+             int64_t* max_feature) {
+  LineReader lr(path);
+  if (!lr.ok()) return kErrOpen;
+  int64_t rows = 0, maxf = 0;
+  while (const char* line = lr.next()) {
+    if (!svm_line_nonempty(line)) continue;
+    ++rows;
+    const char* p = line;
+    skip_ws(p);
+    float dummy;
+    if (!parse_float(p, &dummy)) return kErrParse;
+    while (true) {
+      skip_ws(p);
+      if (*p == 0 || *p == '#') break;
+      char* end = nullptr;
+      long idx = strtol(p, &end, 10);
+      if (end == p || *end != ':') return kErrParse;
+      p = end + 1;
+      float val;
+      if (!parse_float(p, &val)) return kErrParse;
+      int64_t j = zero_based ? idx + 1 : idx;
+      if (j > maxf) maxf = j;
+    }
+  }
+  *n_rows = rows;
+  *max_feature = maxf;
+  return 0;
+}
+
+// fill pre-allocated X (n_rows * n_features, zeroed) and y (n_rows)
+int svm_fill(const char* path, int zero_based, int64_t n_rows,
+             int64_t n_features, float* X, float* y) {
+  if (!X || !y || n_features <= 0) return kErrArg;
+  LineReader lr(path);
+  if (!lr.ok()) return kErrOpen;
+  int64_t i = 0;
+  while (const char* line = lr.next()) {
+    if (!svm_line_nonempty(line)) continue;
+    if (i >= n_rows) break;
+    int rc = svm_parse_line(line, &y[i], &X[i * n_features], n_features,
+                            zero_based);
+    if (rc != 0) return rc;
+    ++i;
+  }
+  return i == n_rows ? 0 : kErrParse;
+}
+
+// ---- whole-file csv ----------------------------------------------------
+
+int csv_dims(const char* path, int skip_header, int64_t* n_rows,
+             int64_t* n_cols) {
+  LineReader lr(path);
+  if (!lr.ok()) return kErrOpen;
+  int64_t rows = 0, cols = 0;
+  bool first = true;
+  while (const char* line = lr.next()) {
+    const char* p = line;
+    skip_ws(p);
+    if (*p == 0) continue;
+    if (first) {
+      cols = 1;
+      for (const char* q = line; *q; ++q)
+        if (*q == ',') ++cols;
+      first = false;
+      if (skip_header) continue;
+    }
+    ++rows;
+  }
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+// fill X (n_rows * (n_cols-1)) and y (n_rows); label_col may be negative
+// (python-style, counted from the end)
+int csv_fill(const char* path, int skip_header, int64_t label_col,
+             int64_t n_rows, int64_t n_cols, float* X, float* y) {
+  if (!X || !y || n_cols < 2) return kErrArg;
+  int64_t lc = label_col < 0 ? label_col + n_cols : label_col;
+  if (lc < 0 || lc >= n_cols) return kErrArg;
+  LineReader lr(path);
+  if (!lr.ok()) return kErrOpen;
+  float* tmp = static_cast<float*>(malloc(sizeof(float) * n_cols));
+  if (!tmp) return kErrArg;
+  int64_t i = 0;
+  bool first = true;
+  while (const char* line = lr.next()) {
+    const char* p = line;
+    skip_ws(p);
+    if (*p == 0) continue;
+    if (first) {
+      first = false;
+      if (skip_header) continue;
+    }
+    if (i >= n_rows) break;
+    int rc = csv_parse_line(line, tmp, n_cols);
+    if (rc != 0) {
+      free(tmp);
+      return rc;
+    }
+    float* xrow = &X[i * (n_cols - 1)];
+    int64_t xj = 0;
+    for (int64_t c = 0; c < n_cols; ++c) {
+      if (c == lc)
+        y[i] = tmp[c];
+      else
+        xrow[xj++] = tmp[c];
+    }
+    ++i;
+  }
+  free(tmp);
+  return i == n_rows ? 0 : kErrParse;
+}
+
+// ---- streaming reader --------------------------------------------------
+
+void* reader_open_svm(const char* path, int64_t n_features,
+                      int zero_based) {
+  Reader* r = new Reader(path, 0);
+  if (!r->lr.ok()) {
+    delete r;
+    return nullptr;
+  }
+  r->n_features = n_features;
+  r->zero_based = zero_based;
+  return r;
+}
+
+void* reader_open_csv(const char* path, int64_t n_cols, int64_t label_col,
+                      int skip_header) {
+  Reader* r = new Reader(path, 1);
+  if (!r->lr.ok()) {
+    delete r;
+    return nullptr;
+  }
+  r->n_cols = n_cols;
+  r->n_features = n_cols - 1;
+  r->label_col = label_col < 0 ? label_col + n_cols : label_col;
+  if (skip_header) r->lr.next();
+  return r;
+}
+
+// reads up to max_rows rows into X (max_rows * n_features, caller-zeroed
+// for libsvm) and y; returns rows read (0 at EOF) or a negative error
+int64_t reader_next(void* handle, int64_t max_rows, float* X, float* y) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || !X || !y) return kErrArg;
+  float* tmp = nullptr;
+  if (r->fmt == 1) {
+    tmp = static_cast<float*>(malloc(sizeof(float) * r->n_cols));
+    if (!tmp) return kErrArg;
+  }
+  int64_t i = 0;
+  while (i < max_rows) {
+    const char* line = r->lr.next();
+    if (!line) break;
+    const char* p = line;
+    skip_ws(p);
+    if (*p == 0) continue;
+    if (r->fmt == 0) {
+      if (!svm_line_nonempty(line)) continue;
+      int rc = svm_parse_line(line, &y[i], &X[i * r->n_features],
+                              r->n_features, r->zero_based);
+      if (rc != 0) return rc;
+    } else {
+      int rc = csv_parse_line(line, tmp, r->n_cols);
+      if (rc != 0) {
+        free(tmp);
+        return rc;
+      }
+      float* xrow = &X[i * r->n_features];
+      int64_t xj = 0;
+      for (int64_t c = 0; c < r->n_cols; ++c) {
+        if (c == r->label_col)
+          y[i] = tmp[c];
+        else
+          xrow[xj++] = tmp[c];
+      }
+    }
+    ++i;
+  }
+  free(tmp);
+  return i;
+}
+
+void reader_close(void* handle) { delete static_cast<Reader*>(handle); }
+
+}  // extern "C"

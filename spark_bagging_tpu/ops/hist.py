@@ -1,0 +1,178 @@
+"""Pallas TPU kernel: fused binned left-statistics ("histogram") for
+tree split search [SURVEY §7 step 7, §2b native-equivalent table].
+
+The dense tree engine (models/tree.py) precomputes a cumulative
+threshold-indicator matrix ``T[i, f·B + b] = (X[i, f] <= edge[f, b])``
+and contracts ``Tᵀ @ R`` per level. T lives in HBM at ``n × F × B``
+bytes — 1 GB for covtype-581k and an impossible 32 GB at Criteo width
+[B:9, B:11]. This kernel removes that wall: each grid step loads a
+``(rows_tile, F_tile)`` block of X and the matching ``(F_tile, B)``
+edges into VMEM, materializes the indicator block *on chip*, forms the
+per-row node×stat block the same way, and feeds both straight to the
+MXU, accumulating ``(F_tile·B, N·K)`` left sums in f32. HBM traffic is
+X once per level instead of T once per level — a ``B×`` reduction —
+and peak memory drops from O(n·F·B) to O(n·F).
+
+The contraction is mathematically identical to the dense path: edges
+are ascending with a +inf sentinel in the last bin, so indicator
+columns are cumulative in b and the product is directly the
+left-statistics table (no cumsum pass) — see models/tree.py docstring.
+
+Single-replica signature; the ensemble engine ``vmap``s it over
+replicas (pallas_call supports vmap by grid extension). On non-TPU
+backends the kernel runs in interpreter mode (CI's fake-device config
+[SURVEY §4]).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ROW_TILE = 512
+# F_tile chosen so the on-chip indicator block (rows × F_tile·B) stays
+# ~1 MB in bf16 — far under VMEM while keeping MXU tiles full.
+_MAX_FB_TILE = 2048
+
+
+def _hist_kernel(x_ref, e_ref, node_ref, s_ref, out_ref, *, n_nodes,
+                 n_bins, op_dtype):
+    """One (f_tile, row_tile) grid step; row dim is innermost
+    (accumulation).
+
+    Mosaic has no general reshape or element-wise lane repeat, so all
+    expansions are exact data movement in *tiled* (b-major / k-major)
+    layouts: ``pltpu.repeat`` tiles a whole block along lanes, and
+    per-k lane broadcasts build the statistics block. The wrapper
+    pre-flattens edges to the matching ``[b][f]`` order and un-permutes
+    the output.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(1)
+    B = n_bins
+
+    # (rows, B·F_t) indicator block in [b][f] lane order: tile x B
+    # times (bit-exact copy), compare against [b][f]-ordered edges.
+    x = x_ref[:]  # (rows, F_t) f32
+    xrep = pltpu.repeat(x, B, axis=1)
+    T2 = (xrep <= e_ref[:]).astype(op_dtype)  # e_ref: (1, B·F_t)
+
+    # (rows, K·N) statistics block in [k][n] lane order:
+    # R2[i, k·N + n] = onehot(node_i)[n] · S[i, k].
+    node = node_ref[:]  # (rows, 1) int32
+    rows, K = s_ref.shape
+    onehot = (
+        node == jax.lax.broadcasted_iota(jnp.int32, (1, n_nodes), 1)
+    ).astype(jnp.float32)  # (rows, N)
+    oh_rep = pltpu.repeat(onehot, K, axis=1)  # tiled: [k][n]
+    s = s_ref[:]
+    s_rep = jnp.concatenate(
+        [
+            jax.lax.broadcast_in_dim(
+                s[:, k : k + 1], (rows, n_nodes), (0, 1)
+            )
+            for k in range(K)
+        ],
+        axis=1,
+    )  # [k][n]
+    R2 = (oh_rep * s_rep).astype(op_dtype)
+
+    acc = jax.lax.dot_general(
+        T2, R2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (B·F_t, K·N)
+
+    @pl.when(r == 0)
+    def _():
+        out_ref[:] = acc
+
+    @pl.when(r > 0)
+    def _():
+        out_ref[:] = out_ref[:] + acc
+
+
+def _pad_axis(a, axis: int, multiple: int, value):
+    n = a.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths, constant_values=value)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_nodes", "hist_dtype", "interpret")
+)
+def binned_left_stats(
+    X: jax.Array,
+    edges: jax.Array,
+    node: jax.Array,
+    S: jax.Array,
+    *,
+    n_nodes: int,
+    hist_dtype: str = "bfloat16",
+    interpret: bool = False,
+) -> jax.Array:
+    """Left statistics ``(F, B, n_nodes, K)`` for one tree level.
+
+    ``X (n, F)`` rows; ``edges (F, B)`` ascending per-feature thresholds
+    (last = +inf); ``node (n,)`` int32 level-relative node index per
+    row; ``S (n, K)`` per-row weighted statistics. Rows beyond a
+    caller's valid range must carry ``S == 0`` (padding rows added here
+    do, automatically).
+    """
+    n, F = X.shape
+    B = edges.shape[1]
+    K = S.shape[1]
+    op_dtype = jnp.dtype(hist_dtype)
+    if interpret and op_dtype == jnp.bfloat16:
+        # CPU interpreter path mirrors tree.py's CPU fallback: XLA:CPU
+        # lacks fast bf16 dots and the 0/1·counts operands are exact in
+        # either dtype.
+        op_dtype = jnp.dtype(jnp.float32)
+
+    f_tile = max(1, min(F, _MAX_FB_TILE // B))
+    Xp = _pad_axis(_pad_axis(X, 0, _ROW_TILE, 0.0), 1, f_tile, 0.0)
+    # padded feature columns produce out rows that are sliced away
+    # below; padded data rows carry S == 0 — both inert.
+    Ep = _pad_axis(edges, 0, f_tile, jnp.inf)
+    nodep = _pad_axis(node.astype(jnp.int32)[:, None], 0, _ROW_TILE, 0)
+    Sp = _pad_axis(S.astype(jnp.float32), 0, _ROW_TILE, 0.0)
+    n_pad, F_pad = Xp.shape
+    n_ft = F_pad // f_tile
+    NK = n_nodes * K
+    # [ftile][b][f] edge order to match the kernel's tiled x layout
+    e_flat = (
+        Ep.reshape(n_ft, f_tile, B).transpose(0, 2, 1).reshape(1, -1)
+    )
+
+    grid = (n_ft, n_pad // _ROW_TILE)
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel, n_nodes=n_nodes, n_bins=B, op_dtype=op_dtype
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, f_tile), lambda f, r: (r, f)),
+            pl.BlockSpec((1, B * f_tile), lambda f, r: (0, f)),
+            pl.BlockSpec((_ROW_TILE, 1), lambda f, r: (r, 0)),
+            pl.BlockSpec((_ROW_TILE, K), lambda f, r: (r, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (B * f_tile, NK), lambda f, r: (f, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((F_pad * B, NK), jnp.float32),
+        interpret=interpret,
+    )(Xp, e_flat, nodep, Sp)
+    # un-permute [ftile][b][f] rows and [k][n] cols -> (F, B, N, K)
+    out = (
+        out.reshape(n_ft, B, f_tile, K, n_nodes)
+        .transpose(0, 2, 1, 4, 3)
+        .reshape(F_pad, B, n_nodes, K)
+    )
+    return out[:F]

@@ -33,7 +33,14 @@ def parse_libsvm(
     """Parse a (dense-ified) libsvm file: ``label idx:val idx:val ...``.
 
     The reference's CPU anchor config reads libsvm breast-cancer [B:7].
+    Uses the native C++ parser (utils/native.py) when available; the
+    pure-Python path below is the portable fallback.
     """
+    from spark_bagging_tpu.utils.native import parse_libsvm_native
+
+    native = parse_libsvm_native(path, n_features, zero_based)
+    if native is not None:
+        return native
     labels: list[float] = []
     rows: list[dict[int, float]] = []
     max_idx = -1
@@ -48,6 +55,8 @@ def parse_libsvm(
             for item in parts[1:]:
                 idx_s, val_s = item.split(":")
                 idx = int(idx_s) - (0 if zero_based else 1)
+                if idx < 0:  # match native parser: drop invalid indices
+                    continue
                 entries[idx] = float(val_s)
                 max_idx = max(max_idx, idx)
             rows.append(entries)
@@ -63,7 +72,21 @@ def parse_libsvm(
 def load_csv(
     path: str, *, label_col: int = -1, skip_header: bool = False
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Load a numeric CSV into (X, y)."""
+    """Load a numeric CSV into (X, y); native C++ parser when
+    available, numpy fallback otherwise."""
+    from spark_bagging_tpu.utils.native import load_csv_native
+
+    try:
+        native = load_csv_native(
+            path, label_col=label_col, skip_header=skip_header
+        )
+        if native is not None:
+            return native
+    except ValueError:
+        # the native parser is strict; fall through to genfromtxt so
+        # malformed fields behave identically (NaN) with or without a
+        # toolchain
+        pass
     data = np.genfromtxt(
         path, delimiter=",", skip_header=1 if skip_header else 0,
         dtype=np.float32,

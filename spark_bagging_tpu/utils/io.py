@@ -178,13 +178,38 @@ class LibsvmChunks(ChunkSource):
         self.chunk_rows = int(chunk_rows)
         self._zero_based = zero_based
         if n_rows is None:
-            with open(path) as f:
-                n_rows = sum(
-                    1 for line in f if line.split("#", 1)[0].strip()
-                )
+            n_rows = self._count_rows()
         self.n_rows = int(n_rows)
 
+    def _count_rows(self) -> int:
+        from spark_bagging_tpu.utils.native import get_lib
+
+        lib = get_lib()
+        if lib is not None:  # native scan — the file may be huge
+            import ctypes
+
+            rows, maxf = ctypes.c_int64(), ctypes.c_int64()
+            rc = lib.svm_dims(
+                self.path.encode(), int(self._zero_based),
+                ctypes.byref(rows), ctypes.byref(maxf),
+            )
+            if rc == 0:
+                return int(rows.value)
+        with open(self.path) as f:
+            return sum(
+                1 for line in f if line.split("#", 1)[0].strip()
+            )
+
     def _iter_raw(self):
+        from spark_bagging_tpu.utils.native import NativeReader
+
+        reader = NativeReader.open_svm(
+            self.path, self.n_features, self.chunk_rows,
+            zero_based=self._zero_based,
+        )
+        if reader is not None:  # native C++ streaming parser
+            yield from reader
+            return
         X = np.zeros((self.chunk_rows, self.n_features), np.float32)
         y = np.zeros((self.chunk_rows,), np.float32)
         i = 0
@@ -225,17 +250,46 @@ class CSVChunks(ChunkSource):
         self.chunk_rows = int(chunk_rows)
         self._label_col = label_col
         self._skip_header = skip_header
-        with open(path) as f:
-            first = f.readline()
-            n_cols = len(first.split(","))
-            if n_rows is None:
-                n_rows = 1 + sum(1 for line in f if line.strip())
+        dims = self._native_dims()
+        if dims is not None:
+            counted_rows, n_cols = dims
+        else:
+            with open(path) as f:
+                first = f.readline()
+                n_cols = len(first.split(","))
+                counted_rows = 1 + sum(1 for line in f if line.strip())
                 if skip_header:
-                    n_rows -= 1
+                    counted_rows -= 1
         self.n_features = n_cols - 1
-        self.n_rows = int(n_rows)
+        self.n_rows = int(n_rows if n_rows is not None else counted_rows)
+
+    def _native_dims(self) -> tuple[int, int] | None:
+        from spark_bagging_tpu.utils.native import get_lib
+
+        lib = get_lib()
+        if lib is None:
+            return None
+        import ctypes
+
+        rows, cols = ctypes.c_int64(), ctypes.c_int64()
+        rc = lib.csv_dims(
+            self.path.encode(), int(self._skip_header),
+            ctypes.byref(rows), ctypes.byref(cols),
+        )
+        if rc != 0:
+            return None
+        return int(rows.value), int(cols.value)
 
     def _iter_raw(self):
+        from spark_bagging_tpu.utils.native import NativeReader
+
+        reader = NativeReader.open_csv(
+            self.path, self.n_features + 1, self.chunk_rows,
+            label_col=self._label_col, skip_header=self._skip_header,
+        )
+        if reader is not None:  # native C++ streaming parser
+            yield from reader
+            return
         rows: list[list[float]] = []
         with open(self.path) as f:
             if self._skip_header:

@@ -1,0 +1,232 @@
+"""ctypes bridge to the native C++ data loader (native/loader.cpp).
+
+The shared library is compiled on demand with g++ (cached next to the
+source; rebuilt when the source is newer) — no pip/pybind dependency
+[SURVEY §2b native-equivalent table]. Every entry point degrades
+gracefully: if the toolchain or the compiled library is unavailable,
+callers fall back to the pure-Python parsers in ``utils/datasets.py`` /
+``utils/io.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "loader.cpp")
+_SO = os.path.join(_NATIVE_DIR, "_libloader.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    # compile to a process-unique temp path and rename atomically so an
+    # interrupted/concurrent build can never leave a truncated .so that
+    # poisons the mtime-based staleness check
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+        if proc.returncode != 0:
+            log.info("native loader build failed:\n%s", proc.stderr)
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info("native loader build skipped: %s", e)
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.svm_dims.argtypes = [ctypes.c_char_p, ctypes.c_int, i64p, i64p]
+    lib.svm_dims.restype = ctypes.c_int
+    lib.svm_fill.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, i64, i64, f32p, f32p,
+    ]
+    lib.svm_fill.restype = ctypes.c_int
+    lib.csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_int, i64p, i64p]
+    lib.csv_dims.restype = ctypes.c_int
+    lib.csv_fill.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, i64, i64, i64, f32p, f32p,
+    ]
+    lib.csv_fill.restype = ctypes.c_int
+    lib.reader_open_svm.argtypes = [ctypes.c_char_p, i64, ctypes.c_int]
+    lib.reader_open_svm.restype = ctypes.c_void_p
+    lib.reader_open_csv.argtypes = [ctypes.c_char_p, i64, i64, ctypes.c_int]
+    lib.reader_open_csv.restype = ctypes.c_void_p
+    lib.reader_next.argtypes = [ctypes.c_void_p, i64, f32p, f32p]
+    lib.reader_next.restype = i64
+    lib.reader_close.argtypes = [ctypes.c_void_p]
+    lib.reader_close.restype = None
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it if needed; None if the
+    native path is unavailable (callers must fall back)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            stale = (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(_SO)
+            _declare(lib)
+            _lib = lib
+        except OSError as e:
+            log.info("native loader unavailable: %s", e)
+            _load_failed = True
+    return _lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def parse_libsvm_native(
+    path: str, n_features: int | None = None, zero_based: bool = False
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Native libsvm parse; None if the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows, maxf = ctypes.c_int64(), ctypes.c_int64()
+    rc = lib.svm_dims(
+        path.encode(), int(zero_based), ctypes.byref(rows),
+        ctypes.byref(maxf),
+    )
+    if rc != 0:
+        raise OSError(f"native svm_dims failed ({rc}) for {path}")
+    d = n_features if n_features is not None else int(maxf.value)
+    X = np.zeros((int(rows.value), d), np.float32)
+    y = np.zeros((int(rows.value),), np.float32)
+    rc = lib.svm_fill(
+        path.encode(), int(zero_based), rows.value, d, _fptr(X), _fptr(y)
+    )
+    if rc != 0:
+        raise ValueError(f"native svm_fill failed ({rc}) for {path}")
+    return X, y
+
+
+def load_csv_native(
+    path: str, *, label_col: int = -1, skip_header: bool = False
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Native CSV parse; None if the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows, cols = ctypes.c_int64(), ctypes.c_int64()
+    rc = lib.csv_dims(
+        path.encode(), int(skip_header), ctypes.byref(rows),
+        ctypes.byref(cols),
+    )
+    if rc != 0:
+        raise OSError(f"native csv_dims failed ({rc}) for {path}")
+    n, c = int(rows.value), int(cols.value)
+    X = np.empty((n, c - 1), np.float32)
+    y = np.empty((n,), np.float32)
+    rc = lib.csv_fill(
+        path.encode(), int(skip_header), int(label_col), n, c,
+        _fptr(X), _fptr(y),
+    )
+    if rc != 0:
+        raise ValueError(f"native csv_fill failed ({rc}) for {path}")
+    return X, y
+
+
+class NativeReader:
+    """Streaming block reader over the native library.
+
+    Yields ``(X, y)`` blocks of at most ``block_rows`` rows; used by the
+    chunk sources in ``utils/io.py`` when the library is available.
+    """
+
+    def __init__(self, handle: int, n_features: int, block_rows: int):
+        self._h = handle
+        self._n_features = n_features
+        self._block_rows = block_rows
+
+    @classmethod
+    def open_svm(
+        cls, path: str, n_features: int, block_rows: int,
+        *, zero_based: bool = False,
+    ) -> "NativeReader | None":
+        lib = get_lib()
+        if lib is None:
+            return None
+        h = lib.reader_open_svm(path.encode(), n_features, int(zero_based))
+        if not h:
+            raise OSError(f"cannot open {path}")
+        return cls(h, n_features, block_rows)
+
+    @classmethod
+    def open_csv(
+        cls, path: str, n_cols: int, block_rows: int,
+        *, label_col: int = -1, skip_header: bool = False,
+    ) -> "NativeReader | None":
+        lib = get_lib()
+        if lib is None:
+            return None
+        h = lib.reader_open_csv(
+            path.encode(), n_cols, label_col, int(skip_header)
+        )
+        if not h:
+            raise OSError(f"cannot open {path}")
+        return cls(h, n_cols - 1, block_rows)
+
+    def __iter__(self):
+        lib = get_lib()
+        try:
+            while True:
+                X = np.zeros(
+                    (self._block_rows, self._n_features), np.float32
+                )
+                y = np.zeros((self._block_rows,), np.float32)
+                got = lib.reader_next(
+                    self._h, self._block_rows, _fptr(X), _fptr(y)
+                )
+                if got < 0:
+                    raise ValueError(f"native reader_next failed ({got})")
+                if got == 0:
+                    return
+                yield X[:got], y[:got]
+        finally:
+            self.close()
+
+    def close(self):
+        if self._h:
+            get_lib().reader_close(self._h)
+            self._h = None

@@ -1,0 +1,146 @@
+"""Native C++ loader vs the pure-Python parsers [SURVEY §2b]."""
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu.utils import native
+from spark_bagging_tpu.utils.datasets import load_csv, parse_libsvm
+from spark_bagging_tpu.utils.io import CSVChunks, LibsvmChunks
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native loader unavailable (no g++?)")
+    return lib
+
+
+@pytest.fixture(scope="module")
+def svm_file(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    path = tmp_path_factory.mktemp("d") / "data.svm"
+    X = rng.standard_normal((53, 7)).astype(np.float32)
+    y = rng.integers(0, 2, 53)
+    with open(path, "w") as f:
+        f.write("# leading comment\n\n")
+        for i in range(53):
+            # sparse-ify: drop ~half the entries
+            feats = " ".join(
+                f"{j + 1}:{X[i, j]:.6g}"
+                for j in range(7)
+                if (i + j) % 2 == 0
+            )
+            f.write(f"{y[i]} {feats}  # trailing comment\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    rng = np.random.default_rng(1)
+    path = tmp_path_factory.mktemp("d") / "data.csv"
+    data = rng.standard_normal((41, 5)).astype(np.float32)
+    with open(path, "w") as f:
+        f.write("a,b,c,d,label\n")
+        for row in data:
+            f.write(",".join(f"{v:.6g}" for v in row) + "\n")
+    return str(path)
+
+
+def _py_parse_libsvm(path, n_features=None, zero_based=False):
+    """The pure-Python fallback body, bypassing the native fast path."""
+    labels, rows, max_idx = [], [], -1
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            entries = {}
+            for item in parts[1:]:
+                idx_s, val_s = item.split(":")
+                idx = int(idx_s) - (0 if zero_based else 1)
+                entries[idx] = float(val_s)
+                max_idx = max(max_idx, idx)
+            rows.append(entries)
+    d = n_features if n_features is not None else max_idx + 1
+    X = np.zeros((len(rows), d), np.float32)
+    for i, entries in enumerate(rows):
+        for j, v in entries.items():
+            if j < d:
+                X[i, j] = v
+    return X, np.asarray(labels, np.float32)
+
+
+def test_native_libsvm_matches_python(lib, svm_file):
+    Xn, yn = native.parse_libsvm_native(svm_file)
+    Xp, yp = _py_parse_libsvm(svm_file)
+    np.testing.assert_array_equal(Xn, Xp)
+    np.testing.assert_array_equal(yn, yp)
+
+
+def test_native_libsvm_n_features_override(lib, svm_file):
+    Xn, _ = native.parse_libsvm_native(svm_file, n_features=3)
+    Xp, _ = _py_parse_libsvm(svm_file, n_features=3)
+    np.testing.assert_array_equal(Xn, Xp)
+
+
+def test_native_csv_matches_numpy(lib, csv_file):
+    Xn, yn = native.load_csv_native(csv_file, skip_header=True)
+    data = np.genfromtxt(
+        csv_file, delimiter=",", skip_header=1, dtype=np.float32
+    )
+    np.testing.assert_allclose(Xn, data[:, :-1], rtol=1e-6)
+    np.testing.assert_allclose(yn, data[:, -1], rtol=1e-6)
+
+
+def test_native_csv_label_col(lib, csv_file):
+    Xn, yn = native.load_csv_native(
+        csv_file, label_col=1, skip_header=True
+    )
+    data = np.genfromtxt(
+        csv_file, delimiter=",", skip_header=1, dtype=np.float32
+    )
+    np.testing.assert_allclose(yn, data[:, 1], rtol=1e-6)
+    np.testing.assert_allclose(
+        Xn, np.delete(data, 1, axis=1), rtol=1e-6
+    )
+
+
+def test_public_parsers_use_native_transparently(svm_file, csv_file):
+    # public API must give identical results whether or not the native
+    # path kicked in
+    X1, y1 = parse_libsvm(svm_file)
+    X2, y2 = _py_parse_libsvm(svm_file)
+    np.testing.assert_array_equal(X1, X2)
+    np.testing.assert_array_equal(y1, y2)
+    Xc, yc = load_csv(csv_file, skip_header=True)
+    assert Xc.shape == (41, 4) and yc.shape == (41,)
+
+
+def test_streaming_reader_matches_whole_file(lib, svm_file, csv_file):
+    Xf, yf = parse_libsvm(svm_file, n_features=7)
+    src = LibsvmChunks(svm_file, n_features=7, chunk_rows=10)
+    parts = [(X[:n], y[:n]) for X, y, n in src.chunks()]
+    np.testing.assert_array_equal(
+        np.concatenate([p[0] for p in parts]), Xf
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([p[1] for p in parts]), yf
+    )
+
+    Xc, yc = load_csv(csv_file, skip_header=True)
+    srcc = CSVChunks(csv_file, chunk_rows=7, skip_header=True)
+    partsc = [(X[:n], y[:n]) for X, y, n in srcc.chunks()]
+    np.testing.assert_allclose(
+        np.concatenate([p[0] for p in partsc]), Xc, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.concatenate([p[1] for p in partsc]), yc, rtol=1e-6
+    )
+
+
+def test_missing_file_raises_or_falls_back(lib):
+    with pytest.raises(OSError):
+        native.parse_libsvm_native("/nonexistent/file.svm")
