@@ -210,6 +210,30 @@ class _BaseBagging(ParamsMixin):
         self.chunk_size = chunk_size
         self.mesh = mesh
 
+    # -- sklearn ecosystem interop -------------------------------------
+
+    def __sklearn_tags__(self):
+        """Estimator tags for sklearn >= 1.6 (Pipeline/GridSearchCV
+        query these). sklearn stays an optional dependency — this is
+        only reached when sklearn itself calls it [SURVEY §3.4]."""
+        from sklearn.utils import (
+            ClassifierTags,
+            RegressorTags,
+            Tags,
+            TargetTags,
+        )
+
+        classifier = self.task == "classification"
+        return Tags(
+            estimator_type="classifier" if classifier else "regressor",
+            target_tags=TargetTags(required=True),
+            classifier_tags=ClassifierTags() if classifier else None,
+            regressor_tags=None if classifier else RegressorTags(),
+        )
+
+    def __sklearn_is_fitted__(self) -> bool:
+        return hasattr(self, "ensemble_")
+
     # -- helpers -------------------------------------------------------
 
     def _learner(self) -> BaseLearner:

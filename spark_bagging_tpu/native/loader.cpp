@@ -87,7 +87,9 @@ inline int svm_parse_line(const char* line, float* y, float* row,
   return 0;
 }
 
-// parse one CSV line of n_cols floats into dst
+// parse one CSV line of exactly n_cols floats into dst; trailing
+// content (extra columns, trailing commas) is a parse error so ragged
+// files fail loudly, matching the numpy fallback
 inline int csv_parse_line(const char* line, float* dst, int64_t n_cols) {
   const char* p = line;
   for (int64_t c = 0; c < n_cols; ++c) {
@@ -99,6 +101,8 @@ inline int csv_parse_line(const char* line, float* dst, int64_t n_cols) {
       ++p;
     }
   }
+  skip_ws(p);
+  if (*p != 0) return kErrParse;
   return 0;
 }
 

@@ -1,0 +1,91 @@
+"""sklearn ecosystem integration [SURVEY §3.4]: the reference's promise
+is that bagging is a drop-in Spark ML ``Estimator`` composing with
+``Pipeline``; the TPU build keeps the analogous promise for the sklearn
+protocol — Pipeline stages, ``clone``, grid search, nested params."""
+
+import numpy as np
+import pytest
+from sklearn.base import clone as sk_clone
+from sklearn.datasets import load_breast_cancer, load_diabetes
+from sklearn.model_selection import GridSearchCV
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    LogisticRegression,
+)
+
+
+@pytest.fixture(scope="module")
+def cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    return X.astype(np.float32), y
+
+
+def test_pipeline_stage(cancer):
+    X, y = cancer
+    pipe = Pipeline(
+        [
+            ("scale", StandardScaler()),
+            ("bag", BaggingClassifier(n_estimators=8, seed=0)),
+        ]
+    )
+    pipe.fit(X, y)
+    assert pipe.score(X, y) > 0.95
+    proba = pipe.predict_proba(X[:16])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_pipeline_regressor():
+    X, y = load_diabetes(return_X_y=True)
+    pipe = Pipeline(
+        [
+            ("scale", StandardScaler()),
+            ("bag", BaggingRegressor(n_estimators=16, seed=0)),
+        ]
+    )
+    pipe.fit(X.astype(np.float32), y.astype(np.float32))
+    assert pipe.score(X.astype(np.float32), y) > 0.4
+
+
+def test_sklearn_clone_compat(cancer):
+    est = BaggingClassifier(
+        base_learner=LogisticRegression(l2=0.01, max_iter=7),
+        n_estimators=5, max_samples=0.8, seed=3,
+    )
+    c = sk_clone(est)
+    assert c is not est
+    assert c.n_estimators == 5
+    assert c.max_samples == 0.8
+    assert c.base_learner.l2 == 0.01
+    assert not hasattr(c, "ensemble_")
+
+
+def test_nested_param_get_set():
+    est = BaggingClassifier(
+        base_learner=LogisticRegression(l2=0.01), n_estimators=4
+    )
+    params = est.get_params()
+    assert params["base_learner__l2"] == 0.01
+    est.set_params(base_learner__l2=0.5, n_estimators=9)
+    assert est.base_learner.l2 == 0.5
+    assert est.n_estimators == 9
+    with pytest.raises(ValueError, match="Invalid parameter"):
+        est.set_params(no_such_param=1)
+
+
+def test_grid_search(cancer):
+    X, y = cancer
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    grid = GridSearchCV(
+        BaggingClassifier(
+            base_learner=LogisticRegression(max_iter=8), seed=0
+        ),
+        {"n_estimators": [2, 4], "base_learner__l2": [1e-3, 1e-1]},
+        cv=2,
+    )
+    grid.fit(X[:200], y[:200])
+    assert grid.best_score_ > 0.9
+    assert set(grid.best_params_) == {"n_estimators", "base_learner__l2"}
