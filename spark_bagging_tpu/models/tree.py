@@ -115,9 +115,14 @@ class _TreeBase(BaseLearner):
     def _resolved_impl(self, n_rows: int, n_features: int) -> str:
         if self.split_impl != "auto":
             return self.split_impl
+        # Dense peak HBM per (row, feature, bin) element: the int8 T
+        # indicator plus the hist_dtype Tf = T.reshape(...).astype(...)
+        # copy materialized inside _grow — budget both, not just T.
+        bytes_per = 1 + jnp.dtype(self.hist_dtype).itemsize
         if (
             jax.default_backend() == "tpu"
-            and n_rows * n_features * self.n_bins > 256 * 1024 * 1024
+            and n_rows * n_features * self.n_bins * bytes_per
+            > 256 * 1024 * 1024
         ):
             return "fused"
         return "dense"

@@ -263,7 +263,23 @@ void* reader_open_csv(const char* path, int64_t n_cols, int64_t label_col,
   r->n_cols = n_cols;
   r->n_features = n_cols - 1;
   r->label_col = label_col < 0 ? label_col + n_cols : label_col;
-  if (skip_header) r->lr.next();
+  // An out-of-range label column would make the per-row column split in
+  // reader_next write n_cols floats into an (n_cols-1)-wide X row —
+  // refuse at open time instead (csv_fill applies the same check).
+  if (n_cols < 2 || r->label_col < 0 || r->label_col >= n_cols) {
+    delete r;
+    return nullptr;
+  }
+  if (skip_header) {
+    // discard the first NON-BLANK line, mirroring csv_dims: a leading
+    // blank line must not absorb the skip and leave the header in the
+    // data stream
+    while (const char* line = r->lr.next()) {
+      const char* p = line;
+      skip_ws(p);
+      if (*p != 0) break;
+    }
+  }
   return r;
 }
 

@@ -33,8 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _ROW_TILE = 512
-# F_tile chosen so the on-chip indicator block (rows × F_tile·B) stays
-# ~1 MB in bf16 — far under VMEM while keeping MXU tiles full.
+# F_tile chosen so the on-chip indicator block (_ROW_TILE × F_tile·B)
+# stays ~2 MB in bf16 — far under VMEM while keeping MXU tiles full.
 _MAX_FB_TILE = 2048
 
 

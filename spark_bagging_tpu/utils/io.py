@@ -254,12 +254,23 @@ class CSVChunks(ChunkSource):
         if dims is not None:
             counted_rows, n_cols = dims
         else:
+            # mirror the native csv_dims exactly: blank lines never
+            # count, and n_cols comes from the first NON-blank line
+            n_cols = counted_rows = 0
             with open(path) as f:
-                first = f.readline()
-                n_cols = len(first.split(","))
-                counted_rows = 1 + sum(1 for line in f if line.strip())
-                if skip_header:
-                    counted_rows -= 1
+                for line in f:
+                    if not line.strip():
+                        continue
+                    if n_cols == 0:
+                        n_cols = len(line.split(","))
+                    counted_rows += 1
+            if skip_header and counted_rows > 0:
+                counted_rows -= 1
+        lc = label_col + n_cols if label_col < 0 else label_col
+        if n_cols < 2 or lc < 0 or lc >= n_cols:
+            raise ValueError(
+                f"label_col {label_col} out of range for {n_cols} columns"
+            )
         self.n_features = n_cols - 1
         self.n_rows = int(n_rows if n_rows is not None else counted_rows)
 
@@ -293,7 +304,11 @@ class CSVChunks(ChunkSource):
         rows: list[list[float]] = []
         with open(self.path) as f:
             if self._skip_header:
-                next(f)
+                # discard the first non-blank line (the header), as the
+                # native reader and csv_dims do
+                for line in f:
+                    if line.strip():
+                        break
             for line in f:
                 line = line.strip()
                 if not line:

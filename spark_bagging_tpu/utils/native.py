@@ -197,6 +197,11 @@ class NativeReader:
         cls, path: str, n_cols: int, block_rows: int,
         *, label_col: int = -1, skip_header: bool = False,
     ) -> "NativeReader | None":
+        lc = label_col + n_cols if label_col < 0 else label_col
+        if n_cols < 2 or lc < 0 or lc >= n_cols:
+            raise ValueError(
+                f"label_col {label_col} out of range for {n_cols} columns"
+            )
         lib = get_lib()
         if lib is None:
             return None

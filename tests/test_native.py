@@ -144,3 +144,52 @@ def test_streaming_reader_matches_whole_file(lib, svm_file, csv_file):
 def test_missing_file_raises_or_falls_back(lib):
     with pytest.raises(OSError):
         native.parse_libsvm_native("/nonexistent/file.svm")
+
+
+@pytest.fixture(params=["native", "fallback"])
+def maybe_native(request, monkeypatch):
+    """Run a test twice: with the native lib and with the pure-Python
+    fallback (native.get_lib forced to None)."""
+    if request.param == "native":
+        if native.get_lib() is None:
+            pytest.skip("native loader unavailable (no g++?)")
+    else:
+        monkeypatch.setattr(native, "get_lib", lambda: None)
+    return request.param
+
+
+def test_out_of_range_label_col_rejected(csv_file, maybe_native):
+    # native reader must refuse (heap-overflow guard), and the chunk
+    # source must fail at construction for both native and fallback
+    with pytest.raises(ValueError):
+        native.NativeReader.open_csv(csv_file, 5, 10, label_col=5)
+    with pytest.raises(ValueError):
+        native.NativeReader.open_csv(csv_file, 5, 10, label_col=-6)
+    for bad in (5, -6):
+        with pytest.raises(ValueError):
+            CSVChunks(csv_file, chunk_rows=7, label_col=bad,
+                      skip_header=True)
+
+
+def test_leading_blank_line_with_header(tmp_path, maybe_native):
+    # a blank line before the header must not absorb skip_header:
+    # dims and the streaming path must agree on row count in both the
+    # native and the pure-Python implementation
+    path = tmp_path / "blank.csv"
+    with open(path, "w") as f:
+        f.write("\na,b,label\n1,2,3\n4,5,6\n")
+    src = CSVChunks(str(path), chunk_rows=10, skip_header=True)
+    assert src.n_rows == 2
+    chunks = [(X[:n], y[:n]) for X, y, n in src.chunks()]
+    X = np.concatenate([c[0] for c in chunks])
+    y = np.concatenate([c[1] for c in chunks])
+    np.testing.assert_allclose(X, [[1, 2], [4, 5]])
+    np.testing.assert_allclose(y, [3, 6])
+
+
+def test_one_column_csv_rejected(tmp_path, maybe_native):
+    path = tmp_path / "one.csv"
+    with open(path, "w") as f:
+        f.write("1\n2\n3\n")
+    with pytest.raises(ValueError):
+        CSVChunks(str(path), chunk_rows=2)
