@@ -4,17 +4,26 @@ covtype-shaped data — base-learner fits/sec vs the CPU baseline
 [B:2, B:5, BASELINE.md row ★].
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "fits/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "fits/sec", "vs_baseline": N,
+   "parity": true, ...}
+
+The result is only valid at accuracy parity: if the TPU ensemble's
+accuracy falls below the CPU single-model accuracy minus tolerance,
+``value`` is null and ``parity`` false — a speed "win" from a broken
+solver must not parse as a win [VERDICT r1 weak#2].
+
+Backend protocol: the ambient TPU plugin can block indefinitely in
+client init when the chip is unreachable, so the backend is probed in a
+subprocess with a bounded timeout (twice) before anything imports jax
+here; on failure the script prints a one-line JSON error and exits 1
+instead of hanging to rc=124 [VERDICT r1 weak#1].
 
 Baseline protocol (BASELINE.md measurement notes): no Spark/JVM exists
 in this environment, so the documented CPU proxy is sklearn
 LogisticRegression fits on the same data, single process. The CPU
-number is measured once and cached in ``bench_baseline_cache.json``
-(keyed by config) so driver runs don't re-pay it; delete the file to
-re-measure. Accuracy parity is checked at matched hyperparameters —
-the benchmark result is only valid if the TPU ensemble's accuracy is
-within tolerance of the CPU single-model accuracy (bagging of linear
-models matches, not beats, the single linear model).
+number is measured once (5 bootstrap fits) and cached in
+``bench_baseline_cache.json`` keyed by config; delete the file to
+re-measure.
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ import argparse
 import hashlib
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -32,14 +42,63 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 CACHE_PATH = os.path.join(REPO, "bench_baseline_cache.json")
 
+def _probe_code(platform: str | None) -> str:
+    force = (
+        f"jax.config.update('jax_platforms', {platform!r}); "
+        if platform else ""
+    )
+    return f"import jax; {force}print('BACKEND=' + jax.default_backend())"
 
-def measure_cpu_baseline(X, y, l2: float, n_fits: int = 2) -> dict:
-    """sklearn CPU proxy: seconds per base-learner fit."""
+
+def probe_backend(timeout_s: float = 120.0, retries: int = 1,
+                  platform: str | None = None) -> tuple[str | None, str]:
+    """Initialize the JAX backend in a subprocess with a hard timeout.
+
+    Returns ``(backend_name, "")`` on success or ``(None, reason)`` when
+    init hangs or crashes — the parent process never touches jax until
+    the probe succeeds, so an unreachable TPU cannot wedge the
+    benchmark itself. ``reason`` distinguishes a timeout from a crash
+    and carries the subprocess's stderr tail.
+    """
+    reason = "no probe attempt ran"
+    for attempt in range(retries + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _probe_code(platform)],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            for line in proc.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1], ""
+            reason = (
+                f"probe exited rc={proc.returncode}: "
+                + proc.stderr.strip()[-300:]
+            )
+        except subprocess.TimeoutExpired:
+            reason = f"probe timed out at {timeout_s:.0f}s (backend init hang)"
+        if attempt < retries:
+            time.sleep(5.0)
+    return None, reason
+
+
+def fail(metric: str, error: str) -> None:
+    print(json.dumps({
+        "metric": metric, "value": None, "unit": "fits/sec",
+        "vs_baseline": None, "parity": None, "error": error,
+    }))
+    sys.exit(1)
+
+
+def measure_cpu_baseline(X, y, l2: float, n_fits: int = 5,
+                         budget_s: float = 180.0) -> dict:
+    """sklearn CPU proxy: seconds per base-learner fit (mean over up to
+    n_fits bootstrap fits, stopping early past the time budget)."""
     from sklearn.linear_model import LogisticRegression as SkLR
 
     rng = np.random.default_rng(0)
     times, accs = [], []
-    for i in range(n_fits):
+    t_start = time.perf_counter()
+    for _ in range(n_fits):
         # bootstrap resample, as the reference's loop would
         w = rng.poisson(1.0, len(y))
         idx = np.repeat(np.arange(len(y)), w)
@@ -47,11 +106,13 @@ def measure_cpu_baseline(X, y, l2: float, n_fits: int = 2) -> dict:
         lr = SkLR(max_iter=100, C=1.0 / (l2 * len(idx))).fit(X[idx], y[idx])
         times.append(time.perf_counter() - t0)
         accs.append(lr.score(X, y))
+        if time.perf_counter() - t_start > budget_s and len(times) >= 2:
+            break
     return {
         "seconds_per_fit": float(np.mean(times)),
         "fits_per_sec": 1.0 / float(np.mean(times)),
         "accuracy": float(np.mean(accs)),
-        "n_fits_measured": n_fits,
+        "n_fits_measured": len(times),
         "proxy": "sklearn LogisticRegression (no Spark/JVM available)",
     }
 
@@ -60,20 +121,36 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--n-replicas", type=int, default=1000)
     p.add_argument("--n-rows", type=int, default=581_012)
-    # Tuned on v5e-1 (2026-07-29): chunk=200 is the HBM sweet spot (500
-    # OOMs on the (chunk, n, C) softmax temp); 3 damped-Newton iters
-    # reach accuracy parity (0.7756 vs CPU 0.7762, tolerance 0.01) —
-    # quadratic convergence makes iters 4-5 pure cost; "high"
-    # (bf16_3x) matmul precision keeps parity at ~2.7x the fp32 MXU
-    # rate. 5-iter/"highest" config: 46 fits/s; this config: ~109.
+    # Tuned on v5e-1 (2026-07-29): chunk=200 is the HBM sweet spot
+    # without row tiling (500 OOMs on the (chunk, n, C) softmax temp);
+    # 3 damped-Newton iters reach accuracy parity (0.7756 vs CPU
+    # 0.7762, tolerance 0.01); "high" (bf16_3x) matmul precision keeps
+    # parity at ~2.7x the fp32 MXU rate. --row-tile bounds the softmax
+    # temps at (chunk, tile, C), lifting the chunk ceiling.
     p.add_argument("--chunk-size", type=int, default=200)
+    p.add_argument("--row-tile", type=int, default=None)
     p.add_argument("--max-iter", type=int, default=3)
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")
+    p.add_argument("--parity-tol", type=float, default=0.01)
+    p.add_argument("--probe-timeout", type=float, default=120.0)
+    p.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu' to debug off-TPU)",
+    )
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
+    metric = "fits_per_sec_logreg_bag1000_covtype581k"
+
+    backend, reason = probe_backend(args.probe_timeout, platform=args.platform)
+    if backend is None:
+        fail(metric, f"jax backend unavailable after 2 attempts — {reason}")
+
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
     from spark_bagging_tpu.utils.datasets import synthetic_covtype
@@ -84,7 +161,7 @@ def main() -> None:
 
     config_key = hashlib.sha1(
         json.dumps(
-            ["covtype_synth_v1", args.n_rows, args.l2], sort_keys=True
+            ["covtype_synth_v2", args.n_rows, args.l2], sort_keys=True
         ).encode()
     ).hexdigest()[:12]
     cache = {}
@@ -98,7 +175,8 @@ def main() -> None:
     baseline = cache[config_key]
 
     learner = LogisticRegression(
-        l2=args.l2, max_iter=args.max_iter, precision=args.precision
+        l2=args.l2, max_iter=args.max_iter, precision=args.precision,
+        row_tile=args.row_tile,
     )
     clf = BaggingClassifier(
         base_learner=learner,
@@ -106,33 +184,41 @@ def main() -> None:
         chunk_size=args.chunk_size,
         seed=0,
     )
-    clf.fit(X, y)  # includes compile; fit_report_ separates the two
+    try:
+        clf.fit(X, y)  # includes compile; fit_report_ separates the two
+    except Exception as e:  # noqa: BLE001 — surface OOM/compile errors as JSON
+        fail(metric, f"fit failed: {type(e).__name__}: {e}"[:400])
     report = clf.fit_report_
-    acc = clf.score(X[: 100_000], y[: 100_000])
+    acc = clf.score(X[:100_000], y[:100_000])
+    parity = bool(acc >= baseline["accuracy"] - args.parity_tol)
 
     fps = report["fits_per_sec"]
     result = {
-        "metric": "fits_per_sec_logreg_bag1000_covtype581k",
-        "value": round(fps, 2),
+        "metric": metric,
+        "value": round(fps, 2) if parity else None,
         "unit": "fits/sec",
-        "vs_baseline": round(fps / baseline["fits_per_sec"], 1),
+        "vs_baseline": (
+            round(fps / baseline["fits_per_sec"], 1) if parity else None
+        ),
+        "parity": parity,
+        "ensemble_accuracy": round(acc, 4),
+        "cpu_baseline_accuracy": round(baseline["accuracy"], 4),
+        "backend": report["backend"],
+        "fit_seconds": round(report["fit_seconds"], 2),
+        "compile_seconds": round(report["compile_seconds"], 2),
+        "h2d_seconds": round(report["h2d_seconds"], 3),
+        "fits_per_sec_e2e": round(report["fits_per_sec_e2e"], 2),
     }
+    if report.get("mfu") is not None:
+        result["achieved_tflops"] = round(report["achieved_tflops"], 1)
+        result["mfu"] = round(report["mfu"], 3)
     if args.verbose:
-        detail = {
-            "backend": report["backend"],
-            "fit_seconds": round(report["fit_seconds"], 2),
-            "compile_seconds": round(report["compile_seconds"], 2),
-            "ensemble_accuracy": round(acc, 4),
-            "cpu_baseline_accuracy": round(baseline["accuracy"], 4),
-            "cpu_baseline_fits_per_sec": round(
-                baseline["fits_per_sec"], 3
-            ),
-            "accuracy_parity": bool(
-                acc >= baseline["accuracy"] - 0.01
-            ),
-        }
-        print(json.dumps(detail), file=sys.stderr)
+        detail = dict(report)
+        detail["cpu_baseline"] = baseline
+        print(json.dumps(detail, default=str), file=sys.stderr)
     print(json.dumps(result))
+    if not parity:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -251,7 +251,16 @@ class _BaseBagging(ParamsMixin):
         return max(1, min(n_features, int(self.max_features)))
 
     def _validate_X(self, X, *, fitted: bool = False) -> jnp.ndarray:
-        X = jnp.asarray(X, jnp.float32)
+        if fitted:
+            # predict path: stay async so the transfer overlaps with
+            # dispatch of the prediction computation
+            X = jnp.asarray(X, jnp.float32)
+        else:
+            # host→device transfer cost, reported in fit_report_ so the
+            # BASELINE.md end-to-end protocol is measurable [VERDICT r1]
+            t0 = time.perf_counter()
+            X = jax.block_until_ready(jnp.asarray(X, jnp.float32))
+            self._h2d_seconds = time.perf_counter() - t0
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D, got shape {X.shape}")
         if fitted and X.shape[1] != self.n_features_in_:
@@ -369,6 +378,10 @@ class _BaseBagging(ParamsMixin):
             backend=jax.default_backend(),
             n_devices=jax.device_count(),
             compile_seconds=t_compile,
+            h2d_seconds=getattr(self, "_h2d_seconds", None),
+            flops_per_fit=learner.flops_per_fit(
+                int(X.shape[0]), n_subspace, n_outputs
+            ),
         )
 
     def _fit_stream_engine(

@@ -115,6 +115,21 @@ class BaseLearner(ParamsMixin):
         """Restrict prepared state to the feature columns in ``idx``."""
         return prepared
 
+    # -- optional analytic cost model -----------------------------------
+
+    def flops_per_fit(
+        self, n_rows: int, n_features: int, n_outputs: int
+    ) -> float | None:
+        """Analytic floating-point ops for ONE base-learner fit.
+
+        Used by ``fit_report`` to derive achieved TFLOP/s and MFU so
+        performance is judged against the chip, not only a CPU proxy
+        [VERDICT r1]. Counts f32-equivalent multiply+add as 2 ops.
+        None means "no cost model" (the report omits MFU).
+        """
+        del n_rows, n_features, n_outputs
+        return None
+
     # -- convenience used by the ensemble engine ------------------------
 
     def fit_from_init(

@@ -38,6 +38,12 @@ class LinearRegression(BaseLearner):
         beta = params["beta"]
         return X.astype(beta.dtype) @ beta[:-1] + beta[-1]
 
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        del n_outputs
+        n, d = n_rows, n_features + 1
+        # Gram matmul + rhs + Cholesky solve + residual pass
+        return float(2 * n * d * d + 4 * n * d + d**3 / 3)
+
     # -- streaming contract (out-of-core engine, streaming.py) ---------
 
     def row_loss(self, params, X, y):

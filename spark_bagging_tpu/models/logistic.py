@@ -66,16 +66,36 @@ class LogisticRegression(BaseLearner):
         solver: str = "newton",
         lr: float = 0.1,
         precision: str = "highest",
+        row_tile: int | None = None,
     ):
         self.l2 = l2
         self.max_iter = max_iter
         self.solver = solver
         self.lr = lr
         self.precision = precision
+        # Newton's per-iteration temporaries are (n, C)-shaped; vmapped
+        # over a replica chunk they peak at (chunk, n, C) — the HBM
+        # ceiling that capped chunk_size at 200 in round 1. row_tile=t
+        # accumulates gradient/Hessian/loss over (t,)-row tiles with a
+        # lax.scan, bounding the temps at (chunk, t, C) while the carry
+        # (G, H, loss) stays tiny. None = single-pass (small n).
+        self.row_tile = row_tile
 
     def init_params(self, key, n_features, n_outputs):
         del key  # zero init: uniform probabilities, Newton's best start
         return {"W": jnp.zeros((n_features + 1, n_outputs), jnp.float32)}
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        n, d, C = n_rows, n_features + 1, n_outputs
+        if self.solver == "newton":
+            # per iter: logits + gradient matmuls (2ndC each), C(C+1)/2
+            # symmetric (d, d) Hessian blocks at 2nd² each, one (Cd)³/3
+            # Cholesky solve
+            per_iter = 4 * n * d * C + C * (C + 1) * n * d * d \
+                + (C * d) ** 3 / 3
+        else:  # adam: forward + backward ≈ 3 forward matmuls
+            per_iter = 6 * n * d * C
+        return float(self.max_iter * per_iter)
 
     def predict_scores(self, params, X):
         return _augment(X.astype(params["W"].dtype)) @ params["W"]
@@ -94,11 +114,21 @@ class LogisticRegression(BaseLearner):
     def penalty(self, params):
         return self._penalty(params["W"])
 
-    def _global_loss(self, W, Xb, y, w, w_sum, axis_name):
+    def _global_loss(self, W, Xb, y, w, w_sum, axis_name, tiles=None):
         """Global weighted mean NLL + penalty (for reporting/curves)."""
-        logp = jax.nn.log_softmax(Xb @ W, axis=-1)
-        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
-        data = maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
+        if tiles is None:
+            logp = jax.nn.log_softmax(Xb @ W, axis=-1)
+            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            local = jnp.sum(w * nll)
+        else:
+            def acc(s, tup):
+                Xt, yt, wt = tup
+                logp = jax.nn.log_softmax(Xt @ W, axis=-1)
+                nll = -jnp.take_along_axis(logp, yt[:, None], axis=1)[:, 0]
+                return s + jnp.sum(wt * nll), None
+
+            local, _ = jax.lax.scan(acc, jnp.float32(0.0), tiles)
+        data = maybe_psum(local, axis_name) / w_sum
         return data + self._penalty(W)
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
@@ -119,10 +149,52 @@ class LogisticRegression(BaseLearner):
 
     # -- Newton --------------------------------------------------------
 
+    def _newton_stats(self, W, Xt, yt, wt, C):
+        """Un-normalized (Σw·nll, data gradient, data Hessian) for one
+        row block — the per-tile body shared by the single-pass and
+        row-tiled paths."""
+        logp = jax.nn.log_softmax(Xt @ W, axis=-1)
+        nll = -jnp.take_along_axis(logp, yt[:, None], axis=1)[:, 0]
+        loss_sum = jnp.sum(wt * nll)
+        P = jnp.exp(logp)
+        Y = jax.nn.one_hot(yt, C, dtype=jnp.float32)
+        G = Xt.T @ ((P - Y) * wt[:, None])
+        # Hessian blocks H_cc' = X^T diag(w·p_c·(δ_cc' − p_c')) X,
+        # each a symmetric (d, d) matmul; C²/2 of them (the blocked form
+        # keeps peak memory O(n·d + (C·d)²) — see module docstring).
+        blocks: list[list[jax.Array | None]] = [[None] * C for _ in range(C)]
+        for c in range(C):
+            for cp in range(c, C):
+                s = wt * P[:, c] * ((1.0 if c == cp else 0.0) - P[:, cp])
+                Hb = (Xt * s[:, None]).T @ Xt
+                blocks[c][cp] = Hb
+                if cp != c:
+                    blocks[cp][c] = Hb
+        return loss_sum, G, jnp.block(blocks)
+
+    def _row_tiles(self, Xb, y, w):
+        """Reshape rows into (n_tiles, tile, ·), zero-padding the tail
+        (w=0 rows contribute nothing to any weighted statistic)."""
+        tile = self.row_tile
+        n, d = Xb.shape
+        if tile is None or n <= tile:
+            return None
+        pad = (-n) % tile
+        if pad:
+            Xb = jnp.concatenate([Xb, jnp.zeros((pad, d), Xb.dtype)])
+            y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+            w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+        k = (n + pad) // tile
+        return (
+            Xb.reshape(k, tile, d),
+            y.reshape(k, tile),
+            w.reshape(k, tile),
+        )
+
     def _fit_newton(self, params, Xb, y, w, w_sum, axis_name) -> tuple[Params, Aux]:
         d = Xb.shape[1]
         C = params["W"].shape[1]
-        Y = jax.nn.one_hot(y, C, dtype=jnp.float32)
+        tiles = self._row_tiles(Xb, y, w)
         # Damping diagonal in (c, i) layout: l2 on coefficients, jitter
         # on bias entries.
         pen_cd = jnp.tile(
@@ -133,31 +205,25 @@ class LogisticRegression(BaseLearner):
         )
 
         def step(W, _):
-            logits = Xb @ W
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
-            loss = (
-                maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
-                + self._penalty(W)
-            )
-            P = jnp.exp(logp)
-            G = maybe_psum(Xb.T @ ((P - Y) * w[:, None]), axis_name) / w_sum
-            G = G + jnp.concatenate(
+            if tiles is None:
+                loss_sum, G, H = self._newton_stats(W, Xb, y, w, C)
+            else:
+                def acc(carry, tup):
+                    ls, Ga, Ha = carry
+                    dl, dG, dH = self._newton_stats(W, *tup, C)
+                    return (ls + dl, Ga + dG, Ha + dH), None
+
+                zero = (
+                    jnp.float32(0.0),
+                    jnp.zeros((d, C), jnp.float32),
+                    jnp.zeros((C * d, C * d), jnp.float32),
+                )
+                (loss_sum, G, H), _ = jax.lax.scan(acc, zero, tiles)
+            loss = maybe_psum(loss_sum, axis_name) / w_sum + self._penalty(W)
+            G = maybe_psum(G, axis_name) / w_sum + jnp.concatenate(
                 [self.l2 * W[:-1], jnp.zeros((1, C), W.dtype)], axis=0
             )
-            # Hessian blocks H_cc' = X^T diag(w·p_c·(δ_cc' − p_c')) X,
-            # each a symmetric (d, d) matmul; C²/2 of them.
-            blocks: list[list[jax.Array | None]] = [
-                [None] * C for _ in range(C)
-            ]
-            for c in range(C):
-                for cp in range(c, C):
-                    s = w * P[:, c] * ((1.0 if c == cp else 0.0) - P[:, cp])
-                    Hb = maybe_psum((Xb * s[:, None]).T @ Xb, axis_name)
-                    blocks[c][cp] = Hb
-                    if cp != c:
-                        blocks[cp][c] = Hb
-            H = jnp.block(blocks) / w_sum + jnp.diag(
+            H = maybe_psum(H, axis_name) / w_sum + jnp.diag(
                 pen_cd + _SOLVER_DAMPING
             )
             delta = jax.scipy.linalg.solve(
@@ -166,7 +232,7 @@ class LogisticRegression(BaseLearner):
             return W - delta.reshape(C, d).T, loss
 
         W, losses = jax.lax.scan(step, params["W"], None, length=self.max_iter)
-        final = self._global_loss(W, Xb, y, w, w_sum, axis_name)
+        final = self._global_loss(W, Xb, y, w, w_sum, axis_name, tiles)
         return {"W": W}, {"loss": final, "loss_curve": losses}
 
     # -- Adam ----------------------------------------------------------

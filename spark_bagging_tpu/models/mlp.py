@@ -90,6 +90,13 @@ class _MLPBase(BaseLearner):
         h = _ACTIVATIONS[self.activation](X @ params["W1"] + params["b1"])
         return h @ params["W2"] + params["b2"]
 
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        b = self.batch_size if self.batch_size is not None else n_rows
+        b = min(b, n_rows)
+        # fwd + bwd ≈ 3x the two forward matmuls per step
+        per_step = 6 * b * (n_features * self.hidden + self.hidden * n_outputs)
+        return float(self.max_iter * per_step)
+
     def _row_loss(self, params, X, y):
         """Per-row unweighted loss ``(n,)``; task-specific."""
         raise NotImplementedError

@@ -164,6 +164,17 @@ class _TreeBase(BaseLearner):
             out["T"] = prepared["T"][:, idx, :]
         return out
 
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        # per level the split search is one (F·B, n) @ (n, N·K)
+        # contraction (N = 2^level nodes, K = stats per row); summed
+        # over levels N totals 2^d − 1. K: classes for classification,
+        # 3 moments for regression.
+        K = n_outputs if self.task == "classification" else 3
+        nodes_total = 2**self.max_depth - 1
+        return float(
+            2 * n_rows * n_features * self.n_bins * K * nodes_total
+        )
+
     # -- growth ---------------------------------------------------------
 
     def _grow(self, X, S, prepared, axis_name):

@@ -32,17 +32,28 @@ def r2_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
 
 
 def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
-    """Binary ROC AUC via the rank statistic (ties get average rank)."""
+    """Binary ROC AUC via the rank statistic (ties get average rank).
+
+    O(n log n): one sort, then tie runs are averaged with run-boundary
+    arithmetic — no per-unique-value scan (a continuous-score 400k-row
+    test set must cost seconds, not hours).
+    """
     y_true = np.asarray(y_true)
     scores = np.asarray(scores, np.float64)
+    n = len(scores)
     order = np.argsort(scores, kind="mergesort")
-    ranks = np.empty_like(scores)
-    ranks[order] = np.arange(1, len(scores) + 1, dtype=np.float64)
-    # average ranks for ties
-    for v in np.unique(scores[np.isfinite(scores)]):
-        tie = scores == v
-        if tie.sum() > 1:
-            ranks[tie] = ranks[tie].mean()
+    s = scores[order]
+    # start index of each run of equal scores (NaN != NaN, so NaNs are
+    # singleton runs — same behavior as the per-value scan, which also
+    # left non-finite ranks un-averaged)
+    starts = np.flatnonzero(np.r_[True, s[1:] != s[:-1]])
+    counts = np.diff(np.r_[starts, n])
+    # 1-based ranks of run k are starts[k]+1 .. starts[k]+counts[k];
+    # their mean is starts[k] + (counts[k] + 1) / 2
+    run_avg = starts + (counts + 1) / 2.0
+    run_id = np.cumsum(np.r_[False, s[1:] != s[:-1]])
+    ranks = np.empty(n, np.float64)
+    ranks[order] = run_avg[run_id]
     pos = y_true == 1
     n_pos, n_neg = int(pos.sum()), int((~pos).sum())
     if n_pos == 0 or n_neg == 0:
@@ -63,14 +74,26 @@ def fit_report(
     backend: str,
     n_devices: int,
     compile_seconds: float | None = None,
+    h2d_seconds: float | None = None,
+    flops_per_fit: float | None = None,
 ) -> dict[str, Any]:
-    """Structured training report [SURVEY §5 metrics]."""
+    """Structured training report [SURVEY §5 metrics].
+
+    ``fits_per_sec`` counts on-device fit wall clock only (compile is
+    reported separately; it amortizes across fits of the same config).
+    ``fits_per_sec_e2e`` additionally charges the host→device transfer
+    (``h2d_seconds``), matching BASELINE.md's "from assembled feature
+    matrix in host memory" protocol. ``flops_per_fit`` (the learner's
+    analytic cost model) yields achieved TFLOP/s and MFU against the
+    detected chip's bf16 peak.
+    """
     losses = np.asarray(losses, np.float64)
-    return {
+    report: dict[str, Any] = {
         "n_replicas": n_replicas,
         "fit_seconds": fit_seconds,
         "fits_per_sec": n_replicas / fit_seconds if fit_seconds > 0 else float("inf"),
         "compile_seconds": compile_seconds,
+        "h2d_seconds": h2d_seconds,
         "loss_mean": float(losses.mean()),
         "loss_std": float(losses.std()),
         "n_rows": n_rows,
@@ -79,3 +102,18 @@ def fit_report(
         "backend": backend,
         "n_devices": n_devices,
     }
+    if h2d_seconds is not None:
+        e2e = fit_seconds + h2d_seconds
+        report["fits_per_sec_e2e"] = (
+            n_replicas / e2e if e2e > 0 else float("inf")
+        )
+    if flops_per_fit is not None and fit_seconds > 0:
+        from spark_bagging_tpu.utils.profiling import device_peak_tflops
+
+        achieved = flops_per_fit * n_replicas / fit_seconds / 1e12
+        peak = device_peak_tflops()
+        report["model_flops_per_fit"] = flops_per_fit
+        report["achieved_tflops"] = achieved
+        report["peak_tflops_bf16"] = peak
+        report["mfu"] = achieved / peak if peak else None
+    return report

@@ -45,3 +45,38 @@ def log_timing(label: str, level: int = logging.INFO) -> Iterator[None]:
 
 # Re-export: engine code uses named_scope so traces segment by phase.
 named_scope = jax.named_scope
+
+
+# Published per-chip dense bf16 peaks (TFLOP/s); substrings matched
+# against jax Device.device_kind, most-specific first. MFU is reported
+# against the bf16 peak by convention — solver passes that pin f32
+# ("highest" ≈ peak/6, "high" ≈ peak/3 on TPU) show correspondingly
+# lower MFU, which is the honest number for "how much of the chip am I
+# using".
+_TPU_PEAK_TFLOPS_BF16: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 918.0),  # libtpu device_kind spelling, cf. "TPU v5 lite"
+    ("v6e", 918.0),
+    ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def device_peak_tflops(device=None) -> float | None:
+    """Per-chip dense bf16 peak TFLOP/s, or None when unknown (CPU,
+    unrecognized kind). Looks at ``device.device_kind``."""
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _TPU_PEAK_TFLOPS_BF16:
+        if sub in kind:
+            return peak
+    return None

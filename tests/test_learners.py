@@ -164,3 +164,67 @@ class TestLearnerProtocol:
     def test_invalid_param_raises(self):
         with pytest.raises(ValueError, match="Invalid parameter"):
             LogisticRegression().set_params(bogus=1)
+
+
+def test_newton_row_tile_matches_single_pass():
+    """row_tile bounds peak memory; the accumulated statistics must be
+    bitwise-equivalent math (same update, same loss) [VERDICT r1 #3]."""
+    rng = np.random.default_rng(7)
+    n, F, C = 500, 9, 3
+    X = rng.standard_normal((n, F)).astype(np.float32)
+    y = (X @ rng.standard_normal((F, C))).argmax(1)
+    w = rng.poisson(1.0, n).astype(np.float32)
+    key = jax.random.key(0)
+    base = LogisticRegression(max_iter=4)
+    tiled = LogisticRegression(max_iter=4, row_tile=128)  # pads 500->512
+    p0 = base.init_params(key, F, C)
+    pb, ab = jax.jit(
+        lambda p: base.fit(p, jnp.asarray(X), jnp.asarray(y),
+                           jnp.asarray(w), key)
+    )(p0)
+    pt, at = jax.jit(
+        lambda p: tiled.fit(p, jnp.asarray(X), jnp.asarray(y),
+                            jnp.asarray(w), key)
+    )(p0)
+    np.testing.assert_allclose(pb["W"], pt["W"], rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(ab["loss"], at["loss"], rtol=1e-5)
+
+
+def test_row_tile_in_ensemble():
+    from spark_bagging_tpu import BaggingClassifier
+
+    rng = np.random.default_rng(8)
+    X = rng.standard_normal((300, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    a = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3), n_estimators=8,
+        seed=0,
+    ).fit(X, y)
+    b = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3, row_tile=64),
+        n_estimators=8, seed=0,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        a.predict_proba(X), b.predict_proba(X), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_flops_models_exist():
+    from spark_bagging_tpu.models import (
+        DecisionTreeClassifier,
+        DecisionTreeRegressor,
+        MLPClassifier,
+        MLPRegressor,
+    )
+
+    for learner, n_out in [
+        (LogisticRegression(), 3),
+        (LogisticRegression(solver="adam"), 3),
+        (LinearRegression(), 1),
+        (MLPClassifier(), 3),
+        (MLPRegressor(), 1),
+        (DecisionTreeClassifier(), 3),
+        (DecisionTreeRegressor(), 1),
+    ]:
+        f = learner.flops_per_fit(1000, 10, n_out)
+        assert f is not None and f > 0

@@ -52,3 +52,57 @@ def test_fit_report_fields():
     )
     assert rep["fits_per_sec"] == 5.0
     assert rep["loss_mean"] == 1.0
+
+
+def test_roc_auc_heavy_ties_matches_sklearn():
+    rng = np.random.default_rng(3)
+    y = rng.integers(0, 2, 20_000)
+    s = np.round(rng.standard_normal(20_000), 1)  # ~80 unique values
+    ours = roc_auc(y, s)
+    ref = skm.roc_auc_score(y, s)
+    assert abs(ours - ref) < 1e-12
+
+
+def test_roc_auc_large_input_is_fast():
+    import time
+
+    rng = np.random.default_rng(4)
+    n = 1_000_000
+    y = rng.integers(0, 2, n)
+    s = rng.standard_normal(n)  # continuous scores: n unique values
+    t0 = time.perf_counter()
+    roc_auc(y, s)
+    # O(n log n); the old per-unique-value scan took hours here
+    assert time.perf_counter() - t0 < 10.0
+
+
+def test_fit_report_flops_fields(monkeypatch):
+    from spark_bagging_tpu.utils import profiling
+    from spark_bagging_tpu.utils.metrics import fit_report
+
+    # pin the ambient-device peak so the assertions hold on any host
+    monkeypatch.setattr(profiling, "device_peak_tflops", lambda: 100.0)
+    r = fit_report(
+        n_replicas=10, fit_seconds=2.0, losses=np.ones(10), n_rows=100,
+        n_features=5, n_subspace=5, backend="cpu", n_devices=1,
+        compile_seconds=1.0, h2d_seconds=0.5, flops_per_fit=1e9,
+    )
+    assert r["fits_per_sec"] == 5.0
+    assert r["fits_per_sec_e2e"] == 10 / 2.5
+    assert r["achieved_tflops"] == 1e9 * 10 / 2.0 / 1e12
+    assert r["peak_tflops_bf16"] == 100.0
+    assert r["mfu"] == r["achieved_tflops"] / 100.0
+
+
+def test_device_peak_tflops_known_kinds():
+    from spark_bagging_tpu.utils.profiling import device_peak_tflops
+
+    class FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert device_peak_tflops(FakeDev("TPU v5 lite")) == 197.0
+    assert device_peak_tflops(FakeDev("TPU v5p")) == 459.0
+    assert device_peak_tflops(FakeDev("TPU v6 lite")) == 918.0
+    assert device_peak_tflops(FakeDev("TPU v4")) == 275.0
+    assert device_peak_tflops(FakeDev("cpu")) is None
