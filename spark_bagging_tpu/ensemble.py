@@ -237,6 +237,7 @@ def oob_predict_scores(
     n_classes: int | None = None,
     chunk_size: int | None = None,
     identity_subspace: bool = False,
+    data_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Out-of-bag aggregation for ``oob_score`` [SURVEY §4].
 
@@ -247,14 +248,22 @@ def oob_predict_scores(
     (divide by ``n_votes`` for the mean). ``n_votes`` is the per-row
     count of OOB replicas; rows with ``n_votes == 0`` have no OOB
     estimate and must be excluded by the caller.
+
+    ``data_axis``: when the fit ran data-sharded, weights were drawn
+    from ``fold_in(key, shard_index)`` per shard [fit_ensemble]; pass
+    the same axis name (under the same mesh) so regeneration replays
+    the identical stream for this shard's rows.
     """
     n_rows = X.shape[0]
     classification = n_classes is not None
+    row_key = key
+    if data_axis is not None:
+        row_key = jax.random.fold_in(key, jax.lax.axis_index(data_axis))
 
     def one(args):
         params, idx, rid = args
         w = bootstrap_weights_one(
-            key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
+            row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
         )
         mask = oob_mask(w).astype(jnp.float32)
         scores = learner.predict_scores(

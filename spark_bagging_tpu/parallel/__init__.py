@@ -13,10 +13,12 @@ through JAX (``shard_map`` + ``lax.psum``) [SURVEY §5 comms backend].
 from spark_bagging_tpu.parallel.mesh import (
     DATA_AXIS,
     REPLICA_AXIS,
+    device_put_rows,
     make_mesh,
 )
 from spark_bagging_tpu.parallel.sharded import (
     sharded_fit,
+    sharded_oob_scores,
     sharded_predict_classifier,
     sharded_predict_regressor,
 )
@@ -25,8 +27,10 @@ from spark_bagging_tpu.parallel.distributed import initialize_distributed
 __all__ = [
     "DATA_AXIS",
     "REPLICA_AXIS",
+    "device_put_rows",
     "make_mesh",
     "sharded_fit",
+    "sharded_oob_scores",
     "sharded_predict_classifier",
     "sharded_predict_regressor",
     "initialize_distributed",

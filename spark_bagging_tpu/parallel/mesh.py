@@ -45,3 +45,19 @@ def make_mesh(
 
     dev_array = np.asarray(devices).reshape(data, replica)
     return Mesh(dev_array, (DATA_AXIS, REPLICA_AXIS))
+
+
+def device_put_rows(X, mesh: Mesh):
+    """Host matrix → HBM with rows sharded over the ``data`` axis and
+    replicated over ``replica`` — the Arrow→device_put placement step of
+    the north star [B:5]. Row count must be divisible by the data-axis
+    size (``pad_rows``/``pad_rows_X`` first)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if X.shape[0] % mesh.shape[DATA_AXIS] != 0:
+        raise ValueError(
+            f"{X.shape[0]} rows not divisible by data-axis size "
+            f"{mesh.shape[DATA_AXIS]}; pad rows first"
+        )
+    spec = P(DATA_AXIS, *([None] * (X.ndim - 1)))
+    return jax.device_put(X, NamedSharding(mesh, spec))

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_bagging_tpu.ensemble import (
     fit_ensemble,
+    oob_predict_scores,
     predict_ensemble_classifier,
     predict_ensemble_regressor,
 )
@@ -179,6 +180,73 @@ def sharded_predict_classifier(
         )
 
     return _predict(stacked_params, subspaces, X)
+
+
+def sharded_oob_scores(
+    learner: BaseLearner,
+    mesh: Mesh,
+    stacked_params: Any,
+    subspaces: jnp.ndarray,
+    X: jnp.ndarray,
+    key: jax.Array,
+    n_replicas: int,
+    *,
+    sample_ratio: float = 1.0,
+    bootstrap: bool = True,
+    n_classes: int | None = None,
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """OOB aggregation over the mesh [SURVEY §5 comms, VERDICT r1 #8].
+
+    Each shard regenerates *its* rows' bootstrap weights with the same
+    ``fold_in(key, data_shard_index)`` stream the sharded fit used, so
+    membership masks match the fit exactly; per-shard OOB contributions
+    and vote counts are then ``psum``'d over the replica axis (each
+    replica group holds a disjoint slice of the ensemble). Rows stay
+    sharded over the data axis — the host-side ``np.asarray`` is the
+    final all-gather. ``X`` must be padded exactly as at fit time
+    (``pad_rows``/``pad_rows_X`` to the data-axis multiple); padded
+    rows' outputs are garbage and must be sliced off by the caller.
+    """
+    _check_divisible(X.shape[0], n_replicas, mesh)
+    data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    replica_axis = REPLICA_AXIS if mesh.shape.get(REPLICA_AXIS, 1) > 1 else None
+    classification = n_classes is not None
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(REPLICA_AXIS),      # stacked params
+            P(REPLICA_AXIS),      # subspaces
+            P(DATA_AXIS, None),   # X rows
+            P(),                  # key (replicated)
+            P(REPLICA_AXIS),      # replica ids
+        ),
+        out_specs=(
+            P(DATA_AXIS, None) if classification else P(DATA_AXIS),
+            P(DATA_AXIS),
+        ),
+        check_vma=False,
+    )
+    def _oob(params, subs, Xs, k, ids):
+        contrib, votes = oob_predict_scores(
+            learner, params, subs, Xs, k, ids,
+            sample_ratio=sample_ratio,
+            bootstrap=bootstrap,
+            n_classes=n_classes,
+            chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
+            data_axis=data_axis,
+        )
+        if replica_axis is not None:
+            contrib = jax.lax.psum(contrib, replica_axis)
+            votes = jax.lax.psum(votes, replica_axis)
+        return contrib, votes
+
+    ids = jnp.arange(n_replicas, dtype=jnp.int32)
+    return _oob(stacked_params, subspaces, X, key, ids)
 
 
 def sharded_predict_regressor(

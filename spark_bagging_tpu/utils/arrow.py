@@ -1,0 +1,200 @@
+"""Arrow ingestion: parquet/feather → host matrices → sharded HBM.
+
+The north star names this path explicitly: "ships the assembled feature
+matrix (via Arrow) to a TPU host" [B:5, BASELINE.json:4]. Arrow is the
+interchange surface the reference world (Spark DataFrames) exports, so
+the TPU-native framework accepts it natively:
+
+- :func:`load_arrow` — whole-file parquet / feather / Arrow-IPC →
+  ``(X, y)`` float32 host matrices (columnar → dense, zero-copy where
+  the column layout allows).
+- :class:`ArrowChunks` — a :class:`~spark_bagging_tpu.utils.io.ChunkSource`
+  streaming record batches for the out-of-core engine (``fit_stream``)
+  without materializing the file [SURVEY §7 step 8].
+- :func:`device_put_rows` lives in ``parallel.mesh``: host matrix →
+  ``NamedSharding(mesh, P("data", None))`` placement, the
+  Arrow→device_put step of the north star.
+
+pyarrow is an optional dependency — every entry point raises a clear
+ImportError when it is missing; nothing else in the package imports
+this module at import time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_bagging_tpu.utils.io import ChunkSource
+
+
+def _pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+    except ImportError as e:  # pragma: no cover - env without pyarrow
+        raise ImportError(
+            "Arrow ingestion needs pyarrow (optional dependency); "
+            "install it or use the CSV/libsvm/numpy paths"
+        ) from e
+    return pyarrow
+
+
+def _is_parquet(path: str) -> bool:
+    if path.endswith((".parquet", ".pq")):
+        return True
+    if path.endswith((".feather", ".arrow", ".ipc")):
+        return False
+    # sniff: parquet files start and end with the magic bytes "PAR1"
+    with open(path, "rb") as f:
+        return f.read(4) == b"PAR1"
+
+
+def _resolve_label(names: list[str], label_col: int | str) -> str:
+    if isinstance(label_col, str):
+        if label_col not in names:
+            raise ValueError(
+                f"label column {label_col!r} not in schema {names}"
+            )
+        return label_col
+    idx = label_col + len(names) if label_col < 0 else label_col
+    if not 0 <= idx < len(names):
+        raise ValueError(
+            f"label_col {label_col} out of range for {len(names)} columns"
+        )
+    return names[idx]
+
+
+def _resolve_columns(
+    names: list[str],
+    label_col: int | str,
+    columns: list[str] | None,
+) -> tuple[str, list[str]]:
+    """Shared label + feature-column resolution for both entry points."""
+    label = _resolve_label(names, label_col)
+    if columns is not None:
+        missing = [c for c in columns if c not in names]
+        if missing:
+            raise ValueError(f"columns {missing} not in schema {names}")
+    feats = [
+        n for n in (columns if columns is not None else names)
+        if n != label
+    ]
+    if not feats:
+        raise ValueError("no feature columns left after removing label")
+    return label, feats
+
+
+def _batch_to_xy(
+    batch, feature_names: list[str], label_name: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """One Arrow record batch → dense (X, y) float32/float32."""
+    cols = [
+        batch.column(name).to_numpy(zero_copy_only=False)
+        for name in feature_names
+    ]
+    X = np.stack(cols, axis=1).astype(np.float32, copy=False)
+    y = np.asarray(
+        batch.column(label_name).to_numpy(zero_copy_only=False)
+    )
+    return np.ascontiguousarray(X), y
+
+
+def load_arrow(
+    path: str,
+    *,
+    label_col: int | str = -1,
+    columns: list[str] | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Whole-file parquet / feather / IPC → ``(X, y)``.
+
+    ``label_col`` selects the target by column name or index (negative
+    counts from the end, default: last column); ``columns`` optionally
+    restricts the feature set (label excluded automatically).
+    """
+    _pyarrow()
+
+    if _is_parquet(path):
+        import pyarrow.parquet as pq
+
+        label, feats = _resolve_columns(
+            pq.read_schema(path).names, label_col, columns
+        )
+        # column projection: decode only the needed columns
+        table = pq.read_table(path, columns=feats + [label])
+    else:
+        import pyarrow as pa
+
+        with pa.memory_map(path) as source:
+            table = pa.ipc.open_file(source).read_all()
+        label, feats = _resolve_columns(
+            table.column_names, label_col, columns
+        )
+    return _batch_to_xy(table, feats, label)
+
+
+class ArrowChunks(ChunkSource):
+    """Stream a parquet/feather file in fixed-shape chunks [SURVEY §7.8].
+
+    Row count comes from file metadata (no scan); record batches are
+    re-blocked to ``chunk_rows`` by the base class. Deterministic batch
+    order (file order), so per-chunk bootstrap-weight regeneration is
+    exact across epochs [utils/io.py].
+    """
+
+    def __init__(
+        self,
+        path: str,
+        chunk_rows: int,
+        *,
+        label_col: int | str = -1,
+        columns: list[str] | None = None,
+    ):
+        _pyarrow()
+        self.path = path
+        self.chunk_rows = int(chunk_rows)
+        self._parquet = _is_parquet(path)
+        if self._parquet:
+            import pyarrow.parquet as pq
+
+            pf = pq.ParquetFile(path)
+            names = [
+                pf.schema_arrow.field(i).name
+                for i in range(len(pf.schema_arrow))
+            ]
+            self.n_rows = int(pf.metadata.num_rows)
+        else:
+            import pyarrow as pa
+
+            # feather V2 == Arrow IPC; memory-mapped open is zero-copy,
+            # so counting rows touches only record-batch metadata
+            with pa.memory_map(path) as source:
+                reader = pa.ipc.open_file(source)
+                names = reader.schema.names
+                self.n_rows = sum(
+                    reader.get_batch(i).num_rows
+                    for i in range(reader.num_record_batches)
+                )
+        self._label, self._features = _resolve_columns(
+            names, label_col, columns
+        )
+        self.n_features = len(self._features)
+
+    def _iter_raw(self):
+        read_cols = self._features + [self._label]
+        if self._parquet:
+            import pyarrow.parquet as pq
+
+            pf = pq.ParquetFile(self.path)
+            for batch in pf.iter_batches(
+                batch_size=self.chunk_rows, columns=read_cols
+            ):
+                yield _batch_to_xy(batch, self._features, self._label)
+        else:
+            import pyarrow as pa
+
+            del read_cols  # _batch_to_xy picks columns by name
+            with pa.memory_map(self.path) as source:
+                reader = pa.ipc.open_file(source)
+                for i in range(reader.num_record_batches):
+                    yield _batch_to_xy(
+                        reader.get_batch(i), self._features, self._label
+                    )

@@ -246,6 +246,10 @@ def load_dataset(name: str, **kwargs) -> tuple[np.ndarray, np.ndarray]:
             return parse_libsvm(name, **kwargs)
         if name.endswith(".csv"):
             return load_csv(name, **kwargs)
+        if name.endswith((".parquet", ".pq", ".feather", ".arrow", ".ipc")):
+            from spark_bagging_tpu.utils.arrow import load_arrow
+
+            return load_arrow(name, **kwargs)
         raise ValueError(f"unknown file format: {name}")
     raise KeyError(
         f"unknown dataset {name!r}; available: {sorted(_REGISTRY)}"

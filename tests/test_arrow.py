@@ -1,0 +1,118 @@
+"""Arrow ingestion [B:5 north star "via Arrow", VERDICT r1 #5]:
+parquet/feather → (X, y), streaming chunks, sharded device placement."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+import pyarrow.parquet as pq
+
+from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+from spark_bagging_tpu.parallel import device_put_rows, make_mesh
+from spark_bagging_tpu.utils.arrow import ArrowChunks, load_arrow
+from spark_bagging_tpu.utils.datasets import load_dataset
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((600, 5)).astype(np.float32)
+    y = (X[:, 0] - X[:, 3] > 0).astype(np.int64)
+    return X, y
+
+
+@pytest.fixture(scope="module", params=["parquet", "feather"])
+def arrow_file(request, xy, tmp_path_factory):
+    X, y = xy
+    table = pa.table(
+        {f"f{j}": X[:, j] for j in range(X.shape[1])} | {"label": y}
+    )
+    path = tmp_path_factory.mktemp("a") / f"data.{request.param}"
+    if request.param == "parquet":
+        pq.write_table(table, path, row_group_size=128)
+    else:
+        with pa.OSFile(str(path), "wb") as sink:
+            with pa.ipc.new_file(sink, table.schema) as writer:
+                for batch in table.to_batches(max_chunksize=128):
+                    writer.write_batch(batch)
+    return str(path)
+
+
+def test_load_arrow_roundtrip(arrow_file, xy):
+    X, y = xy
+    Xl, yl = load_arrow(arrow_file, label_col="label")
+    np.testing.assert_array_equal(Xl, X)
+    np.testing.assert_array_equal(yl, y)
+    # index addressing (label is the last column)
+    Xi, yi = load_arrow(arrow_file, label_col=-1)
+    np.testing.assert_array_equal(Xi, X)
+    np.testing.assert_array_equal(yi, y)
+
+
+def test_load_arrow_bad_label(arrow_file):
+    with pytest.raises(ValueError, match="not in schema"):
+        load_arrow(arrow_file, label_col="nope")
+    with pytest.raises(ValueError, match="out of range"):
+        load_arrow(arrow_file, label_col=17)
+
+
+def test_load_dataset_dispatches_arrow(arrow_file, xy):
+    X, y = xy
+    Xl, yl = load_dataset(arrow_file, label_col="label")
+    np.testing.assert_array_equal(Xl, X)
+    np.testing.assert_array_equal(yl, y)
+
+
+def test_arrow_chunks_match_whole_file(arrow_file, xy):
+    X, y = xy
+    src = ArrowChunks(arrow_file, chunk_rows=100, label_col="label")
+    assert src.n_rows == 600
+    assert src.n_features == 5
+    parts = [(Xc[:n], yc[:n]) for Xc, yc, n in src.chunks()]
+    np.testing.assert_array_equal(np.concatenate([p[0] for p in parts]), X)
+    np.testing.assert_array_equal(np.concatenate([p[1] for p in parts]), y)
+    # fixed shapes: every chunk is (chunk_rows, F)
+    for Xc, _, _ in src.chunks():
+        assert Xc.shape == (100, 5)
+
+
+def test_arrow_chunks_column_subset(arrow_file, xy):
+    X, _ = xy
+    src = ArrowChunks(
+        arrow_file, chunk_rows=256, label_col="label",
+        columns=["f0", "f2"],
+    )
+    assert src.n_features == 2
+    Xc, _, n = next(iter(src.chunks()))
+    np.testing.assert_array_equal(Xc[:n], X[:256][:, [0, 2]])
+
+
+def test_fit_stream_from_parquet_on_mesh(arrow_file, xy):
+    """The VERDICT done-criterion: a parquet file round-trips through
+    fit_stream on the CPU mesh."""
+    X, y = xy
+    src = ArrowChunks(arrow_file, chunk_rows=200, label_col="label")
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(solver="adam", max_iter=30),
+        n_estimators=8,
+        seed=0,
+        mesh=make_mesh(),
+    )
+    clf.fit_stream(src, classes=[0, 1], n_epochs=3, lr=0.1)
+    assert clf.score(X, y) > 0.85
+
+
+def test_device_put_rows_sharding(xy):
+    import jax
+
+    X, _ = xy
+    mesh = make_mesh(data=4)
+    Xd = device_put_rows(X[:400], mesh)
+    assert Xd.shape == (400, 5)
+    # each device holds a (100, 5) row shard
+    shard_shapes = {s.data.shape for s in Xd.addressable_shards}
+    assert shard_shapes == {(100, 5)}
+    with pytest.raises(ValueError, match="divisible"):
+        device_put_rows(X[:401], mesh)
+    np.testing.assert_array_equal(np.asarray(Xd), X[:400])

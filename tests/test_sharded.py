@@ -132,12 +132,45 @@ def test_indivisible_replicas_raises(breast_cancer):
         BaggingClassifier(n_estimators=10, mesh=mesh).fit(X, y)
 
 
-def test_oob_on_data_sharded_mesh_raises(breast_cancer):
+def test_oob_on_data_sharded_mesh(breast_cancer):
+    """Data-sharded OOB regenerates per-shard weight streams and psums
+    vote counts over the replica axis [VERDICT r1 #8]. The realized
+    bootstrap differs from the unsharded one (documented: fold_in on
+    the shard index), so scores match statistically, not exactly."""
     X, y = breast_cancer
-    with pytest.raises(ValueError, match="data-sharded"):
-        BaggingClassifier(
-            n_estimators=8, oob_score=True, mesh=make_mesh(data=8)
+    ref = BaggingClassifier(n_estimators=32, oob_score=True, seed=3).fit(X, y)
+    for mesh in (make_mesh(data=2), make_mesh(data=8)):
+        clf = BaggingClassifier(
+            n_estimators=32, oob_score=True, seed=3, mesh=mesh
         ).fit(X, y)
+        assert clf.oob_score_ == pytest.approx(ref.oob_score_, abs=0.05)
+        # every row got at least one OOB vote at 32 replicas (P_miss ~
+        # (1 - e^-1)^32 ~ 1e-7), so the decision function is finite
+        assert np.isfinite(clf.oob_decision_function_).all()
+        rowsum = clf.oob_decision_function_.sum(axis=1)
+        np.testing.assert_allclose(rowsum, 1.0, rtol=1e-5)
+
+
+def test_oob_data_sharded_deterministic(breast_cancer):
+    X, y = breast_cancer
+    mesh = make_mesh(data=2)
+    kw = dict(n_estimators=16, oob_score=True, seed=9, mesh=mesh)
+    a = BaggingClassifier(**kw).fit(X, y)
+    b = BaggingClassifier(**kw).fit(X, y)
+    np.testing.assert_array_equal(
+        a.oob_decision_function_, b.oob_decision_function_
+    )
+    assert a.oob_score_ == b.oob_score_
+
+
+def test_oob_regressor_on_data_sharded_mesh(diabetes):
+    X, y = diabetes
+    ref = BaggingRegressor(n_estimators=32, oob_score=True, seed=3).fit(X, y)
+    clf = BaggingRegressor(
+        n_estimators=32, oob_score=True, seed=3, mesh=make_mesh(data=2)
+    ).fit(X, y)
+    assert clf.oob_score_ == pytest.approx(ref.oob_score_, abs=0.07)
+    assert np.isfinite(clf.oob_prediction_).all()
 
 
 def test_oob_on_replica_mesh_matches_unsharded(breast_cancer):
