@@ -390,7 +390,8 @@ class _BaseBagging(ParamsMixin):
 
     def _fit_stream_engine(
         self, source, n_outputs: int, *, n_epochs: int,
-        steps_per_chunk: int, lr: float,
+        steps_per_chunk: int, lr: float, checkpoint_dir=None,
+        checkpoint_every: int = 0, resume_from=None,
     ):
         """Out-of-core fit over a ChunkSource [SURVEY §7 step 8]."""
         from spark_bagging_tpu.streaming import fit_ensemble_stream
@@ -406,15 +407,47 @@ class _BaseBagging(ParamsMixin):
         n_subspace = self._n_subspace(source.n_features)
         key = jax.random.key(self.seed)
         t0 = time.perf_counter()
-        params, subspaces, aux = fit_ensemble_stream(
-            learner, source, key, self.n_estimators, n_outputs,
-            n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
-            sample_ratio=float(self.max_samples),
-            bootstrap=bool(self.bootstrap),
-            n_subspace=n_subspace,
-            bootstrap_features=bool(self.bootstrap_features),
-            mesh=self.mesh,
-        )
+        from spark_bagging_tpu.models.tree import _TreeBase
+
+        if isinstance(learner, _TreeBase):
+            # structure-search learners stream through the multi-pass
+            # level-synchronous engine (tree_stream.py), not SGD
+            from spark_bagging_tpu.tree_stream import (
+                fit_tree_ensemble_stream,
+            )
+
+            if checkpoint_dir is not None or resume_from is not None:
+                raise ValueError(
+                    "checkpoint/resume is not supported for streamed "
+                    "tree fits (each level pass is atomic); re-run fit"
+                )
+            if n_epochs != 1 or steps_per_chunk != 1:
+                raise ValueError(
+                    "n_epochs/steps_per_chunk are SGD-stream knobs; a "
+                    "streamed tree fit always makes max_depth + 2 "
+                    "passes — drop them for tree learners"
+                )
+            params, subspaces, aux = fit_tree_ensemble_stream(
+                learner, source, key, self.n_estimators, n_outputs,
+                sample_ratio=float(self.max_samples),
+                bootstrap=bool(self.bootstrap),
+                n_subspace=n_subspace,
+                bootstrap_features=bool(self.bootstrap_features),
+                mesh=self.mesh,
+            )
+        else:
+            params, subspaces, aux = fit_ensemble_stream(
+                learner, source, key, self.n_estimators, n_outputs,
+                n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
+                sample_ratio=float(self.max_samples),
+                bootstrap=bool(self.bootstrap),
+                n_subspace=n_subspace,
+                bootstrap_features=bool(self.bootstrap_features),
+                mesh=self.mesh,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                resume_from=resume_from,
+            )
         losses_np = np.asarray(aux["loss"])  # device->host barrier
         t_fit = time.perf_counter() - t0
 
@@ -441,6 +474,8 @@ class _BaseBagging(ParamsMixin):
         )
         self.fit_report_["n_chunks"] = aux["n_chunks"]
         self.fit_report_["n_epochs"] = aux["n_epochs"]
+        if "n_passes" in aux:
+            self.fit_report_["n_passes"] = aux["n_passes"]
 
     def _oob_scores(self, X: jnp.ndarray, n_classes: int | None):
         """OOB aggregate + vote counts (rows with zero votes excluded by
@@ -525,14 +560,24 @@ class BaggingClassifier(_BaseBagging):
         steps_per_chunk: int = 1,
         lr: float = 0.01,
         chunk_rows: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume_from: str | None = None,
     ) -> "BaggingClassifier":
         """Out-of-core fit from a ChunkSource (or an ``(X, y)`` tuple,
         auto-chunked) [SURVEY §7 step 8, B:11].
 
         ``classes`` lists the label values; if None, one discovery pass
         over the source collects them (an extra full scan — pass them
-        for large streams). Requires a streamable base learner (SGD
-        path); trees need the in-memory ``fit``.
+        for large streams). SGD-capable learners stream one epoch per
+        ``n_epochs``; tree learners stream through the multi-pass
+        level-synchronous engine (``max_depth + 2`` passes; the SGD
+        knobs ``n_epochs``/``steps_per_chunk``/``lr`` don't apply).
+
+        ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot the fit
+        state every N chunk-steps; ``resume_from`` continues a killed
+        fit from its last snapshot, bit-identical to the uninterrupted
+        run [SURVEY §5 checkpoint].
         """
         from spark_bagging_tpu.utils.io import as_chunk_source
 
@@ -555,6 +600,9 @@ class BaggingClassifier(_BaseBagging):
         self._fit_stream_engine(
             _EncodedChunks(source, self.classes_), self.n_classes_,
             n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume_from=resume_from,
         )
         return self
 
@@ -619,6 +667,9 @@ class BaggingRegressor(_BaseBagging):
         steps_per_chunk: int = 1,
         lr: float = 0.01,
         chunk_rows: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume_from: str | None = None,
     ) -> "BaggingRegressor":
         """Out-of-core fit from a ChunkSource (or ``(X, y)`` tuple)
         [SURVEY §7 step 8]; see ``BaggingClassifier.fit_stream``."""
@@ -626,7 +677,10 @@ class BaggingRegressor(_BaseBagging):
 
         source = as_chunk_source(source, chunk_rows)
         self._fit_stream_engine(source, 1, n_epochs=n_epochs,
-                                steps_per_chunk=steps_per_chunk, lr=lr)
+                                steps_per_chunk=steps_per_chunk, lr=lr,
+                                checkpoint_dir=checkpoint_dir,
+                                checkpoint_every=checkpoint_every,
+                                resume_from=resume_from)
         return self
 
     def predict(self, X) -> np.ndarray:

@@ -177,6 +177,66 @@ class _TreeBase(BaseLearner):
 
     # -- growth ---------------------------------------------------------
 
+    def _hdt(self):
+        """Histogram matmul dtype; CPU XLA lacks BF16×BF16→F32 dots, so
+        the fake-device test backend [SURVEY §4] upgrades to f32."""
+        hdt = jnp.dtype(self.hist_dtype)
+        if hdt == jnp.bfloat16 and jax.default_backend() == "cpu":
+            hdt = jnp.dtype(jnp.float32)
+        return hdt
+
+    def _select_splits(self, hist, edges):
+        """One level's split choice from its left-stats table.
+
+        ``hist``: ``(F, B, N, K)`` cumulative left statistics. Returns
+        ``(feature, threshold, score_sum)`` for the level's N nodes —
+        shared by the in-memory growth loop and the streaming fit.
+        """
+        B = self.n_bins
+        N = hist.shape[2]
+        total = hist[0, -1]  # edge B-1 is +inf ⇒ full-node sums
+        right = total[None, None, :, :] - hist
+        score = self._impurity(hist) + self._impurity(right)
+        best = jnp.argmin(score.reshape(-1, N), axis=0)
+        bf = (best // B).astype(jnp.int32)
+        bb = (best % B).astype(jnp.int32)
+        thr = edges[bf, bb]
+        s = jnp.sum(
+            jnp.take_along_axis(
+                score.reshape(-1, N), best[None, :], axis=0
+            )[0]
+        )
+        return bf, thr, s
+
+    def _chunk_level_hist(self, Xs, S, edges, node, N):
+        """Left-stats table ``(F, B, N, K)`` for one row block, with the
+        threshold indicator built on the fly — the streaming fit's
+        per-chunk accumulation step (memory O(chunk·F·B), independent
+        of total rows) [SURVEY §7 hard-part 4]."""
+        n, F = Xs.shape
+        B = self.n_bins
+        K = S.shape[1]
+        hdt = self._hdt()
+        if self._resolved_impl(n, F) == "fused":
+            from spark_bagging_tpu.ops.hist import binned_left_stats
+
+            return binned_left_stats(
+                Xs, edges, node, S, n_nodes=N, hist_dtype=str(hdt),
+                interpret=jax.default_backend() != "tpu",
+            )
+        Tf = (
+            (Xs[:, :, None] <= edges[None, :, :])
+            .reshape(n, F * B)
+            .astype(hdt)
+        )
+        R = (
+            jax.nn.one_hot(node, N, dtype=hdt)[:, :, None]
+            * S.astype(hdt)[:, None, :]
+        ).reshape(n, N * K)
+        return jnp.matmul(
+            Tf.T, R, preferred_element_type=jnp.float32
+        ).reshape(F, B, N, K)
+
     def _grow(self, X, S, prepared, axis_name):
         """Level-synchronous growth; returns (feature, threshold,
         leaf_index_per_row, per-level impurity curve).
@@ -191,11 +251,7 @@ class _TreeBase(BaseLearner):
         K = S.shape[1]
         edges = prepared["edges"]
         fused = "T" not in prepared
-        hdt = jnp.dtype(self.hist_dtype)
-        if hdt == jnp.bfloat16 and jax.default_backend() == "cpu":
-            # CPU XLA's dot thunk lacks BF16×BF16→F32; the fake-device
-            # test backend [SURVEY §4] silently upgrades to f32.
-            hdt = jnp.dtype(jnp.float32)
+        hdt = self._hdt()
         if not fused:
             Tf = prepared["T"].reshape(n, F * B).astype(hdt)
         Sh = S.astype(hdt)
@@ -232,23 +288,10 @@ class _TreeBase(BaseLearner):
                         ),
                         axis_name,
                     ).reshape(F, B, N, K)
-                total = hist[0, -1]  # edge B-1 is +inf ⇒ full-node sums
-                left = hist
-                right = total[None, None, :, :] - left
-                score = self._impurity(left) + self._impurity(right)
-                best = jnp.argmin(score.reshape(F * B, N), axis=0)
-                bf = (best // B).astype(jnp.int32)
-                bb = (best % B).astype(jnp.int32)
-                thr = edges[bf, bb]
+                bf, thr, score_sum = self._select_splits(hist, edges)
                 feats.append(bf)
                 thrs.append(thr)
-                curve.append(
-                    jnp.sum(
-                        jnp.take_along_axis(
-                            score.reshape(F * B, N), best[None, :], axis=0
-                        )[0]
-                    )
-                )
+                curve.append(score_sum)
                 f_row = bf[node]
                 t_row = thr[node]
                 x_sel = jnp.take_along_axis(X, f_row[:, None], axis=1)[:, 0]
@@ -332,18 +375,14 @@ class DecisionTreeClassifier(_TreeBase):
         w = stats.sum(-1)
         return w - (stats**2).sum(-1) / jnp.maximum(w, _EPS)
 
-    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
-            prepared=None):
-        del key
-        if prepared is None:
-            prepared = self.prepare(X, axis_name=axis_name)
-        C = params["leaf_logp"].shape[1]
-        w = sample_weight.astype(jnp.float32)
-        S = w[:, None] * jax.nn.one_hot(y, C, dtype=jnp.float32)
-        feature, threshold, node, curve = self._grow(
-            X, S, prepared, axis_name
-        )
-        counts = self._leaf_stats(node, S, axis_name)  # (L, C)
+    def _row_stats(self, y, w, n_outputs):
+        """Per-row split statistics: weighted one-hot class counts."""
+        return w[:, None] * jax.nn.one_hot(y, n_outputs, dtype=jnp.float32)
+
+    def _finalize_leaves(self, feature, threshold, counts, curve):
+        """Leaf log-probabilities + report from leaf class counts —
+        shared by the in-memory fit and the streaming fit."""
+        C = counts.shape[1]
         a = self.leaf_smoothing
         logp = jnp.log(
             (counts + a) / (counts.sum(-1, keepdims=True) + a * C)
@@ -359,6 +398,19 @@ class DecisionTreeClassifier(_TreeBase):
             "loss": leaf_gini / w_tot,
             "loss_curve": curve / w_tot,
         }
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del key
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        C = params["leaf_logp"].shape[1]
+        S = self._row_stats(y, sample_weight.astype(jnp.float32), C)
+        feature, threshold, node, curve = self._grow(
+            X, S, prepared, axis_name
+        )
+        counts = self._leaf_stats(node, S, axis_name)  # (L, C)
+        return self._finalize_leaves(feature, threshold, counts, curve)
 
     def predict_scores(self, params, X):
         return params["leaf_logp"][self._route(params, X)]
@@ -388,18 +440,15 @@ class DecisionTreeRegressor(_TreeBase):
         s0, s1, s2 = stats[..., 0], stats[..., 1], stats[..., 2]
         return s2 - s1**2 / jnp.maximum(s0, _EPS)
 
-    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
-            prepared=None):
-        del params, key
-        if prepared is None:
-            prepared = self.prepare(X, axis_name=axis_name)
-        w = sample_weight.astype(jnp.float32)
+    def _row_stats(self, y, w, n_outputs):
+        """Per-row split statistics: weighted moments (w, w·y, w·y²)."""
+        del n_outputs
         yf = y.astype(jnp.float32)
-        S = jnp.stack([w, w * yf, w * yf**2], axis=1)
-        feature, threshold, node, curve = self._grow(
-            X, S, prepared, axis_name
-        )
-        m = self._leaf_stats(node, S, axis_name)  # (L, 3)
+        return jnp.stack([w, w * yf, w * yf**2], axis=1)
+
+    def _finalize_leaves(self, feature, threshold, m, curve):
+        """Leaf means + report from leaf moment sums ``(L, 3)`` —
+        shared by the in-memory fit and the streaming fit."""
         w_tot = jnp.maximum(m[:, 0].sum(), _EPS)
         global_mean = m[:, 1].sum() / w_tot
         value = jnp.where(
@@ -412,6 +461,18 @@ class DecisionTreeRegressor(_TreeBase):
             "leaf_value": value.astype(jnp.float32),
         }
         return new, {"loss": sse / w_tot, "loss_curve": curve / w_tot}
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del params, key
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        S = self._row_stats(y, sample_weight.astype(jnp.float32), 1)
+        feature, threshold, node, curve = self._grow(
+            X, S, prepared, axis_name
+        )
+        m = self._leaf_stats(node, S, axis_name)  # (L, 3)
+        return self._finalize_leaves(feature, threshold, m, curve)
 
     def predict_scores(self, params, X):
         return params["leaf_value"][self._route(params, X)]

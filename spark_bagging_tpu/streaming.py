@@ -27,6 +27,9 @@ the ``pjit`` path, no hand-written ``shard_map`` needed here.
 
 from __future__ import annotations
 
+import json
+import os
+import shutil
 import time
 from typing import Any
 
@@ -64,6 +67,55 @@ def _shard_ensemble(tree: Any, mesh) -> Any:
     return jax.tree.map(put, tree)
 
 
+def _save_stream_checkpoint(
+    path: str, params, opt_state, losses, meta: dict
+) -> None:
+    """Atomic snapshot of the stream-fit state [SURVEY §5 checkpoint,
+    VERDICT r1 #7]: write to a temp dir, then rename into place, so a
+    kill mid-save leaves the previous snapshot intact."""
+    from flax import serialization  # lazy: keep flax off the import path
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    tree = {
+        "params": jax.tree.map(np.asarray, params),
+        "opt_state": serialization.to_state_dict(
+            jax.tree.map(np.asarray, opt_state)
+        ),
+        "final_epoch_losses": (
+            np.stack([np.asarray(l) for l in losses])
+            if losses else np.zeros((0, 0), np.float32)
+        ),
+    }
+    with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(tree))
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    # Never leave a window with no valid snapshot: move the previous
+    # one aside, install the new one, then drop the old. A kill between
+    # the two renames leaves `path.old`, which load falls back to.
+    old = f"{path}.old"
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+    if os.path.isdir(path):
+        os.replace(path, old)
+    os.replace(tmp, path)
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+
+
+def _load_stream_checkpoint(path: str) -> tuple[dict, dict]:
+    from flax import serialization
+
+    if not os.path.isdir(path) and os.path.isdir(f"{path}.old"):
+        path = f"{path}.old"  # crashed between the two snapshot renames
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, "state.msgpack"), "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    return meta, tree
+
+
 def fit_ensemble_stream(
     learner: BaseLearner,
     source: ChunkSource,
@@ -79,18 +131,35 @@ def fit_ensemble_stream(
     n_subspace: int | None = None,
     bootstrap_features: bool = False,
     mesh=None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume_from: str | None = None,
 ) -> tuple[Any, jax.Array, dict[str, Any]]:
     """Fit all replicas by streaming chunks from ``source``.
 
     Returns ``(stacked_params, subspaces, aux)`` exactly like
     ``fit_ensemble`` — the fitted ensemble is indistinguishable
     downstream (predict/persistence) from an in-memory fit.
+
+    Fault tolerance [SURVEY §5 failure detection, VERDICT r1 #7]:
+    ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot
+    ``(params, opt_state, cursor, final-epoch losses)`` atomically every
+    N chunk-steps; ``resume_from`` restores a snapshot and replays the
+    deterministic chunk stream from the saved cursor — a resumed fit is
+    bit-identical to the uninterrupted one (chunk-keyed weight draws
+    don't depend on wall-clock or visit order). The snapshot's config
+    fingerprint must match the current call (validated, clear error).
     """
     if not learner.streamable:
         raise TypeError(
             f"{type(learner).__name__} does not support streaming fits "
             "(no row_loss/penalty); use an SGD-capable learner or the "
             "in-memory fit"
+        )
+    if checkpoint_dir is not None and checkpoint_every <= 0:
+        raise ValueError(
+            "checkpoint_dir is set but checkpoint_every is 0 — no "
+            "snapshot would ever be written; pass checkpoint_every=N"
         )
     n_features = source.n_features
     chunk_rows = source.chunk_rows
@@ -110,6 +179,50 @@ def fit_ensemble_stream(
     params = jax.vmap(init_one)(ids)
     opt = optax.adam(lr)
     opt_state = jax.vmap(opt.init)(params)
+
+    # Config fingerprint: a resumed run must be continuing THIS fit.
+    config = {
+        "key": np.asarray(jax.random.key_data(key)).tolist(),
+        "n_replicas": n_replicas,
+        "n_outputs": n_outputs,
+        "n_epochs": n_epochs,
+        "steps_per_chunk": steps_per_chunk,
+        "lr": lr,
+        "sample_ratio": sample_ratio,
+        "bootstrap": bootstrap,
+        "n_subspace": n_subspace,
+        "bootstrap_features": bootstrap_features,
+        "chunk_rows": chunk_rows,
+        "n_features": n_features,
+        "learner": repr(sorted(
+            (k, repr(v))
+            for k, v in learner.get_params(deep=False).items()
+        )) + type(learner).__qualname__,
+    }
+
+    start_epoch, start_chunk = 0, 0
+    final_epoch_losses: list[jax.Array] = []
+    if resume_from is not None:
+        from flax import serialization
+
+        meta, tree = _load_stream_checkpoint(resume_from)
+        if meta["config"] != config:
+            diff = {
+                k for k in set(meta["config"]) | set(config)
+                if meta["config"].get(k) != config.get(k)
+            }
+            raise ValueError(
+                f"checkpoint at {resume_from} was written by a different "
+                f"fit configuration (mismatched: {sorted(diff)})"
+            )
+        params = serialization.from_state_dict(params, tree["params"])
+        opt_state = serialization.from_state_dict(
+            opt_state, tree["opt_state"]
+        )
+        start_epoch, start_chunk = meta["epoch"], meta["next_chunk"]
+        final_epoch_losses = [
+            jnp.asarray(l) for l in tree["final_epoch_losses"]
+        ]
     # Learners pin MXU matmul precision (the TPU bf16-default hazard —
     # see models/logistic.py); the streamed gradient steps honor the
     # same knob.
@@ -181,9 +294,11 @@ def fit_ensemble_stream(
     n_chunks = source.n_chunks
     t0 = time.perf_counter()
     compile_seconds = None
-    last_epoch_losses = []
-    for epoch in range(n_epochs):
+    steps_done = 0
+    for epoch in range(start_epoch, n_epochs):
         for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
+            if epoch == start_epoch and c < start_chunk:
+                continue  # replay: already consumed before the snapshot
             Xd = jnp.asarray(Xc, jnp.float32)
             yd = jnp.asarray(yc, y_dtype)
             if x_sharding is not None:
@@ -198,11 +313,29 @@ def fit_ensemble_stream(
                 jax.block_until_ready(losses)
                 compile_seconds = time.perf_counter() - t0
             if epoch == n_epochs - 1:
-                last_epoch_losses.append(losses)
-    if not last_epoch_losses:
+                final_epoch_losses.append(losses)
+            steps_done += 1
+            if (
+                checkpoint_dir is not None
+                and checkpoint_every > 0
+                and steps_done % checkpoint_every == 0
+            ):
+                nxt_epoch, nxt_chunk = epoch, c + 1
+                if nxt_chunk >= n_chunks:
+                    nxt_epoch, nxt_chunk = epoch + 1, 0
+                _save_stream_checkpoint(
+                    checkpoint_dir, params, opt_state, final_epoch_losses,
+                    {
+                        "config": config,
+                        "epoch": nxt_epoch,
+                        "next_chunk": nxt_chunk,
+                        "steps_done": steps_done,
+                    },
+                )
+    if not final_epoch_losses:
         raise ValueError("source yielded no chunks")
     # per-replica mean over the final epoch's chunks (reporting only)
-    loss = jnp.stack(last_epoch_losses).mean(axis=0)
+    loss = jnp.stack(final_epoch_losses).mean(axis=0)
     aux = {
         "loss": loss,
         "n_chunks": n_chunks,

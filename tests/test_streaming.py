@@ -236,13 +236,114 @@ def test_stream_mlp(cancer):
     assert sclf.score(X, y) > 0.9
 
 
-def test_stream_rejects_tree(cancer):
+def test_stream_tree_single_chunk_matches_inmemory_exactly(cancer):
+    """With one chunk covering all rows the streamed tree fit must be
+    bit-identical to an in-memory fit on the regenerated chunk weights
+    (same edges — global quantiles — same split math) [VERDICT r1 #9]."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bagging_tpu.ops.bootstrap import bootstrap_weights_one
+    from spark_bagging_tpu.streaming import _CHUNK_STREAM
+
     X, y = cancer
-    with pytest.raises(TypeError, match="streaming"):
+    n = X.shape[0]
+    learner = DecisionTreeClassifier(max_depth=3)
+    seed, R = 2, 4
+    clf = BaggingClassifier(
+        base_learner=learner, n_estimators=R, seed=seed
+    ).fit_stream((X, y), classes=[0, 1], chunk_rows=n)
+
+    key = jax.random.key(seed)
+    chunk_key = jax.random.fold_in(
+        jax.random.fold_in(key, _CHUNK_STREAM), 0
+    )
+    Xd = jnp.asarray(X)
+    yd = jnp.asarray(y, jnp.int32)
+    prepared = learner.prepare(Xd)
+
+    def fit_one(rid):
+        w = bootstrap_weights_one(chunk_key, rid, n)
+        p0 = learner.init_params(None, X.shape[1], 2)
+        params, _ = learner.fit(p0, Xd, yd, w, None, prepared=prepared)
+        return params
+
+    expected = jax.vmap(fit_one)(jnp.arange(R, dtype=jnp.int32))
+    for k in expected:
+        np.testing.assert_array_equal(
+            np.asarray(expected[k]), np.asarray(clf.ensemble_[k])
+        )
+
+
+def test_stream_tree_multi_chunk_accuracy(cancer):
+    X, y = cancer
+    mem = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=4),
+        n_estimators=8, seed=0,
+    ).fit(X, y)
+    stream = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=4),
+        n_estimators=8, seed=0,
+    ).fit_stream((X, y), classes=[0, 1], chunk_rows=128)
+    assert stream.score(X, y) == pytest.approx(mem.score(X, y), abs=0.03)
+    assert stream.score(X, y) > 0.93
+    r = stream.fit_report_
+    assert r["fits_per_sec"] > 0 and r["n_chunks"] == 5
+
+
+def test_stream_tree_deterministic(cancer):
+    X, y = cancer
+    kw = dict(
+        base_learner=DecisionTreeClassifier(max_depth=3),
+        n_estimators=4, seed=7,
+    )
+    a = BaggingClassifier(**kw).fit_stream(
+        (X, y), classes=[0, 1], chunk_rows=128
+    )
+    b = BaggingClassifier(**kw).fit_stream(
+        (X, y), classes=[0, 1], chunk_rows=128
+    )
+    for k in a.ensemble_:
+        np.testing.assert_array_equal(
+            np.asarray(a.ensemble_[k]), np.asarray(b.ensemble_[k])
+        )
+
+
+def test_stream_tree_regressor():
+    from spark_bagging_tpu.models import DecisionTreeRegressor
+
+    X, y = make_regression(800, 8, seed=3)
+    mem = BaggingRegressor(
+        base_learner=DecisionTreeRegressor(max_depth=4),
+        n_estimators=8, seed=0,
+    ).fit(X, y)
+    stream = BaggingRegressor(
+        base_learner=DecisionTreeRegressor(max_depth=4),
+        n_estimators=8, seed=0,
+    ).fit_stream((X, y), chunk_rows=200)
+    assert stream.score(X, y) == pytest.approx(mem.score(X, y), abs=0.05)
+
+
+def test_stream_tree_with_subspaces(cancer):
+    X, y = cancer
+    clf = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3),
+        n_estimators=8, max_features=0.5, seed=1,
+    ).fit_stream((X, y), classes=[0, 1], chunk_rows=128)
+    assert clf.subspaces_.shape == (8, 15)
+    assert clf.score(X, y) > 0.9
+
+
+def test_stream_tree_rejects_checkpoint(cancer):
+    X, y = cancer
+    with pytest.raises(ValueError, match="checkpoint"):
         BaggingClassifier(
             base_learner=DecisionTreeClassifier(max_depth=3),
             n_estimators=2,
-        ).fit_stream((X, y), chunk_rows=128)
+        ).fit_stream(
+            (X, y), classes=[0, 1], chunk_rows=128,
+            checkpoint_dir="/tmp/x", checkpoint_every=1,
+        )
 
 
 def test_stream_rejects_oob(cancer):
@@ -289,4 +390,116 @@ def test_stream_then_save_load_roundtrip(cancer, tmp_path):
     loaded = BaggingClassifier.load(path)
     np.testing.assert_allclose(
         loaded.predict_proba(X[:64]), sclf.predict_proba(X[:64]), rtol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------
+# Mid-training checkpoint / resume [SURVEY §5 checkpoint, VERDICT r1 #7]
+# ---------------------------------------------------------------------
+
+
+from spark_bagging_tpu.utils.io import ChunkSource as _ChunkSource
+
+
+class _KillAfter(_ChunkSource):
+    """ChunkSource wrapper that raises after N chunks — a simulated
+    process kill mid-stream."""
+
+    def __init__(self, inner, n_before_kill):
+        self._inner = inner
+        self._n = n_before_kill
+        self._seen = 0  # persists across epochs (chunks() re-calls)
+        self.n_features = inner.n_features
+        self.n_rows = inner.n_rows
+        self.chunk_rows = inner.chunk_rows
+
+    @property
+    def n_chunks(self):
+        return self._inner.n_chunks
+
+    def chunks(self):
+        for chunk in self._inner.chunks():
+            if self._seen == self._n:
+                raise KeyboardInterrupt("simulated kill")
+            self._seen += 1
+            yield chunk
+
+
+def _stream_kw(**extra):
+    return dict(classes=[0, 1], n_epochs=3, steps_per_chunk=2, lr=0.05,
+                **extra)
+
+
+def test_stream_kill_and_resume_reproduces_uninterrupted(cancer, tmp_path):
+    X, y = cancer
+    ckpt = str(tmp_path / "snap")
+    make = lambda: BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=8, seed=4
+    )
+
+    ref = make().fit_stream(ArrayChunks(X, y, 128), **_stream_kw())
+
+    # run with snapshots every 2 steps, killed mid-epoch
+    with pytest.raises(KeyboardInterrupt):
+        make().fit_stream(
+            _KillAfter(ArrayChunks(X, y, 128), 7), **_stream_kw(
+                checkpoint_dir=ckpt, checkpoint_every=2,
+            )
+        )
+    # resume from the snapshot with the intact source
+    res = make().fit_stream(ArrayChunks(X, y, 128), **_stream_kw(
+        resume_from=ckpt,
+    ))
+    np.testing.assert_allclose(
+        ref.predict_proba(X), res.predict_proba(X), rtol=1e-5, atol=1e-6
+    )
+    import jax
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        ref.ensemble_, res.ensemble_,
+    )
+
+
+def test_stream_resume_rejects_config_mismatch(cancer, tmp_path):
+    X, y = cancer
+    ckpt = str(tmp_path / "snap")
+    BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=8, seed=4
+    ).fit_stream(ArrayChunks(X, y, 128), **_stream_kw(
+        checkpoint_dir=ckpt, checkpoint_every=2,
+    ))
+    with pytest.raises(ValueError, match="different fit configuration"):
+        BaggingClassifier(
+            base_learner=LogisticRegression(), n_estimators=8, seed=5
+        ).fit_stream(ArrayChunks(X, y, 128), **_stream_kw(
+            resume_from=ckpt,
+        ))
+
+
+def test_stream_checkpoint_resume_on_mesh(cancer, tmp_path):
+    """Snapshots gather sharded state to host; resume re-shards onto the
+    mesh — the sharded resumed fit must equal the sharded straight-through
+    fit."""
+    X, y = cancer
+    ckpt = str(tmp_path / "snap")
+    mesh = make_mesh(data=2)
+    make = lambda: BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=8, seed=4,
+        mesh=mesh,
+    )
+    ref = make().fit_stream(ArrayChunks(X, y, 128), **_stream_kw())
+    with pytest.raises(KeyboardInterrupt):
+        make().fit_stream(
+            _KillAfter(ArrayChunks(X, y, 128), 5), **_stream_kw(
+                checkpoint_dir=ckpt, checkpoint_every=1,
+            )
+        )
+    res = make().fit_stream(ArrayChunks(X, y, 128), **_stream_kw(
+        resume_from=ckpt,
+    ))
+    np.testing.assert_allclose(
+        ref.predict_proba(X), res.predict_proba(X), rtol=1e-4, atol=1e-5
     )
