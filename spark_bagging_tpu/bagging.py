@@ -286,12 +286,12 @@ class _BaseBagging(ParamsMixin):
             )
         return X
 
-    def save(self, path: str) -> None:
-        """Persist the fitted ensemble (manifest + msgpack pytree)
-        [SURVEY §3.3]."""
+    def save(self, path: str, *, compress: bool | str = "auto") -> None:
+        """Persist the fitted ensemble (manifest + msgpack pytree,
+        zstd-compressed when available) [SURVEY §3.3]."""
         from spark_bagging_tpu.utils.checkpoint import save_model
 
-        save_model(self, path)
+        save_model(self, path, compress=compress)
 
     @classmethod
     def load(cls, path: str, *, mesh=None):

@@ -41,6 +41,7 @@ from spark_bagging_tpu.ops.bootstrap import (
     fit_key,
     oob_mask,
 )
+from spark_bagging_tpu.utils.debug import check_bootstrap_weights
 from spark_bagging_tpu.utils.profiling import named_scope
 
 
@@ -112,6 +113,7 @@ def fit_ensemble(
             w = bootstrap_weights_one(
                 row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
             )
+            check_bootstrap_weights(w)  # no-op unless debug_mode()
             if row_mask is not None:
                 w = w * row_mask
             idx = feature_subspace_one(

@@ -29,6 +29,52 @@ import numpy as np
 _FORMAT_VERSION = 1
 
 
+def _zstd():
+    """The zstandard module, or None — compression is optional (the
+    reference's Snappy/zstd JNI codec analog [SURVEY §2b])."""
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
+def _write_arrays(path: str, payload: bytes, compress: bool | str) -> str:
+    """Write the msgpack payload, zstd-compressed when requested and
+    available. Returns the filename written."""
+    z = _zstd() if compress in (True, "auto") else None
+    if compress is True and z is None:
+        raise ImportError(
+            "compress=True needs the zstandard module; use "
+            "compress='auto' to fall back to uncompressed"
+        )
+    if z is not None:
+        name = "arrays.msgpack.zst"
+        payload = z.ZstdCompressor(level=3).compress(payload)
+    else:
+        name = "arrays.msgpack"
+    with open(os.path.join(path, name), "wb") as f:
+        f.write(payload)
+    return name
+
+
+def _read_arrays(path: str) -> bytes:
+    """Read the arrays payload, auto-detecting compression."""
+    zst = os.path.join(path, "arrays.msgpack.zst")
+    if os.path.exists(zst):
+        z = _zstd()
+        if z is None:
+            raise ImportError(
+                f"{zst} is zstd-compressed but the zstandard module is "
+                "not installed"
+            )
+        with open(zst, "rb") as f:
+            return z.ZstdDecompressor().decompress(f.read())
+    with open(os.path.join(path, "arrays.msgpack"), "rb") as f:
+        return f.read()
+
+
 def _class_path(obj: Any) -> str:
     cls = type(obj)
     return f"{cls.__module__}:{cls.__qualname__}"
@@ -66,8 +112,13 @@ def _deserialize_value(v: Any) -> Any:
     return v
 
 
-def save_model(model: Any, path: str) -> None:
-    """Save a fitted bagging estimator to directory ``path``."""
+def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
+    """Save a fitted bagging estimator to directory ``path``.
+
+    ``compress``: ``"auto"`` (default) zstd-compresses the array payload
+    when the zstandard module is available, ``True`` requires it,
+    ``False`` writes raw msgpack. Load auto-detects either format.
+    """
     from flax import serialization  # lazy: keep flax off the import path
 
     model._check_fitted()
@@ -117,8 +168,7 @@ def save_model(model: Any, path: str) -> None:
         )
     if hasattr(model, "oob_prediction_"):
         tree["oob_prediction"] = np.asarray(model.oob_prediction_)
-    with open(os.path.join(path, "arrays.msgpack"), "wb") as f:
-        f.write(serialization.msgpack_serialize(tree))
+    _write_arrays(path, serialization.msgpack_serialize(tree), compress)
 
 
 def load_model(path: str, *, mesh=None) -> Any:
@@ -135,8 +185,7 @@ def load_model(path: str, *, mesh=None) -> Any:
             f"checkpoint format {manifest['format_version']} is newer "
             f"than supported ({_FORMAT_VERSION})"
         )
-    with open(os.path.join(path, "arrays.msgpack"), "rb") as f:
-        tree = serialization.msgpack_restore(f.read())
+    tree = serialization.msgpack_restore(_read_arrays(path))
 
     cls = _import_class(manifest["estimator"])
     params = {k: _deserialize_value(v) for k, v in manifest["params"].items()}

@@ -1,0 +1,86 @@
+"""Opt-in debug/sanitizer mode [SURVEY §5 race detection / sanitizers].
+
+The reference leans on the JVM memory model + Spark's immutable RDDs;
+functional JAX has no shared mutable state, so the closest analogs are
+numerical sanitizers: NaN/Inf tracing and shape/value assertions on the
+bootstrap inputs. All of it is OFF by default (the assertions trace into
+the compiled program, and ``jax_debug_nans`` forces eager re-execution
+on failure — both cost performance).
+
+Usage::
+
+    from spark_bagging_tpu.utils.debug import debug_mode
+
+    with debug_mode():                 # NaN checks + engine assertions
+        clf.fit(X, y)
+
+or process-wide: ``enable_debug()`` / ``disable_debug()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+_active = False
+
+
+def debug_active() -> bool:
+    """Engine hook: are debug assertions enabled? (Checked at trace
+    time — toggling requires re-tracing, i.e. a fresh jit cache entry;
+    the engines' lru caches key on hyperparams only, so flip the mode
+    before the first fit of a config.)"""
+    return _active
+
+
+def enable_debug() -> None:
+    """Turn on ``jax_debug_nans`` + engine assertions process-wide."""
+    global _active
+    _active = True
+    jax.config.update("jax_debug_nans", True)
+
+
+def disable_debug() -> None:
+    global _active
+    _active = False
+    jax.config.update("jax_debug_nans", False)
+
+
+@contextlib.contextmanager
+def debug_mode() -> Iterator[None]:
+    """Scoped :func:`enable_debug`/:func:`disable_debug`."""
+    enable_debug()
+    try:
+        yield
+    finally:
+        disable_debug()
+
+
+def check_bootstrap_weights(w: jax.Array) -> None:
+    """Trace-time sanitizer on per-replica bootstrap weights (no-op
+    unless debug is active): weights must be finite and non-negative —
+    a negative or NaN weight means a broken draw or a donated-buffer
+    reuse, the closest thing this stack has to a data race
+    [SURVEY §5]."""
+    if not debug_active():
+        return
+    try:
+        import chex
+
+        chex.assert_rank(w, 1)
+    except ImportError:  # chex is optional; the value checks still run
+        pass
+
+    def _host_assert(wv):
+        import numpy as np
+
+        wv = np.asarray(wv)
+        if not (np.isfinite(wv).all() and (wv >= 0).all()):
+            raise AssertionError(
+                "bootstrap weights must be finite and >= 0 "
+                f"(min={wv.min()})"
+            )
+
+    jax.debug.callback(_host_assert, w)

@@ -105,3 +105,30 @@ def test_loaded_model_oob_reproducible(tmp_path):
     counts_b, votes_b = loaded._oob_scores(X, loaded.n_classes_)
     np.testing.assert_array_equal(votes_a, votes_b)
     np.testing.assert_allclose(counts_a, counts_b)
+
+
+def test_checkpoint_zstd_compression(tmp_path, iris):
+    """zstd payload compression [SURVEY §2b codec analog]: auto mode
+    writes .zst when zstandard is available; load auto-detects both."""
+    pytest.importorskip("zstandard")
+    import os
+
+    X, y = iris
+    clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+
+    p_auto = str(tmp_path / "auto")
+    clf.save(p_auto)
+    assert os.path.exists(os.path.join(p_auto, "arrays.msgpack.zst"))
+    assert not os.path.exists(os.path.join(p_auto, "arrays.msgpack"))
+    loaded = BaggingClassifier.load(p_auto)
+    np.testing.assert_allclose(
+        clf.predict_proba(X), loaded.predict_proba(X), rtol=1e-6
+    )
+
+    p_raw = str(tmp_path / "raw")
+    clf.save(p_raw, compress=False)
+    assert os.path.exists(os.path.join(p_raw, "arrays.msgpack"))
+    loaded_raw = BaggingClassifier.load(p_raw)
+    np.testing.assert_allclose(
+        clf.predict_proba(X), loaded_raw.predict_proba(X), rtol=1e-6
+    )
