@@ -35,6 +35,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from spark_bagging_tpu.ensemble import (
     fit_ensemble,
@@ -46,6 +47,7 @@ from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
 from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
+from spark_bagging_tpu.parallel.multihost import global_put, to_host
 from spark_bagging_tpu.parallel.sharded import (
     pad_rows,
     pad_rows_X,
@@ -266,8 +268,18 @@ class _BaseBagging(ParamsMixin):
             return max(1, min(n_features, round(self.max_features * n_features)))
         return max(1, min(n_features, int(self.max_features)))
 
-    def _validate_X(self, X, *, fitted: bool = False) -> jnp.ndarray:
-        if fitted:
+    def _validate_X(self, X, *, fitted: bool = False):
+        if self.mesh is not None:
+            # mesh paths pad on host then device_put ONCE with the
+            # global sharding (multihost-safe; h2d timed there) — an
+            # eager jnp.asarray here would cost an extra device->host
+            # round trip per fit/predict. Inputs already on device stay
+            # there (global_put reshards them directly).
+            if isinstance(X, jax.Array):
+                X = X.astype(jnp.float32)
+            else:
+                X = np.asarray(X, np.float32)
+        elif fitted:
             # predict path: stay async so the transfer overlaps with
             # dispatch of the prediction computation
             X = jnp.asarray(X, jnp.float32)
@@ -327,6 +339,16 @@ class _BaseBagging(ParamsMixin):
         if self.mesh is not None:
             data_size = self.mesh.shape.get(DATA_AXIS, 1)
             Xp, yp, mask = pad_rows(X, y, data_size)
+            # Global placement: rows sharded over data, replicated over
+            # replica — each process transfers only its shards; also the
+            # single-process fast path (no jit-entry reshard). This is
+            # the fit's one host→device transfer (BASELINE.md h2d).
+            t0 = time.perf_counter()
+            Xp = global_put(Xp, self.mesh, P(DATA_AXIS, None))
+            yp = global_put(yp, self.mesh, P(DATA_AXIS))
+            mask = global_put(mask, self.mesh, P(DATA_AXIS))
+            jax.block_until_ready((Xp, yp, mask))
+            self._h2d_seconds = time.perf_counter() - t0
             fit_fn = _jitted_sharded_fit(
                 learner, self.mesh, n_outputs, float(self.max_samples),
                 bool(self.bootstrap), n_subspace,
@@ -339,10 +361,12 @@ class _BaseBagging(ParamsMixin):
             t_compile = time.perf_counter() - t0
             t0 = time.perf_counter()
             params, subspaces, aux = compiled(Xp, yp, mask, key)
-            # np.asarray is a device->host barrier; block_until_ready is
-            # not reliable on relayed/remote backends. Losses depend on
-            # every fit, so this forces the whole ensemble.
-            losses_np = np.asarray(aux["loss"])
+            # to_host is a device->host barrier (with a cross-process
+            # gather when the replica axis spans hosts);
+            # block_until_ready is not reliable on relayed/remote
+            # backends. Losses depend on every fit, so this forces the
+            # whole ensemble.
+            losses_np = to_host(aux["loss"])
             t_fit = time.perf_counter() - t0
         else:
             fit_fn = _jitted_fit(
@@ -448,7 +472,7 @@ class _BaseBagging(ParamsMixin):
                 checkpoint_every=checkpoint_every,
                 resume_from=resume_from,
             )
-        losses_np = np.asarray(aux["loss"])  # device->host barrier
+        losses_np = to_host(aux["loss"])  # device->host barrier
         t_fit = time.perf_counter() - t0
 
         self.ensemble_ = params
@@ -486,12 +510,13 @@ class _BaseBagging(ParamsMixin):
         n = X.shape[0]
         if self.mesh is not None:
             Xp = pad_rows_X(X, self.mesh.shape.get(DATA_AXIS, 1))
+            Xp = global_put(Xp, self.mesh, P(DATA_AXIS, None))
             agg, votes = _jitted_sharded_oob(
                 self._fitted_learner, self.mesh, self.n_estimators_, ratio,
                 replacement, n_classes, self.chunk_size,
                 self._identity_subspace,
             )(self.ensemble_, self.subspaces_, Xp, self._fit_key)
-            return np.asarray(agg)[:n], np.asarray(votes)[:n]
+            return to_host(agg)[:n], to_host(votes)[:n]
         agg, votes = _jitted_oob(
             self._fitted_learner, self.n_estimators_, ratio, replacement,
             n_classes, self.chunk_size, self._identity_subspace,
@@ -540,7 +565,8 @@ class BaggingClassifier(_BaseBagging):
         self.n_classes_ = int(len(self.classes_))
         if self.n_classes_ < 2:
             raise ValueError("y has a single class")
-        self._fit_engine(X, jnp.asarray(y_enc, jnp.int32), self.n_classes_)
+        y_enc = np.asarray(y_enc, np.int32)  # device placement is the
+        self._fit_engine(X, y_enc, self.n_classes_)  # engine's job
         if self.oob_score:
             counts, votes = self._oob_scores(X, self.n_classes_)
             has_vote = votes > 0
@@ -612,12 +638,13 @@ class BaggingClassifier(_BaseBagging):
         n = X.shape[0]
         if self.mesh is not None:
             X = pad_rows_X(X, self.mesh.shape.get(DATA_AXIS, 1))
+            X = global_put(X, self.mesh, P(DATA_AXIS, None))
             proba = _jitted_sharded_predict_clf(
                 self._fitted_learner, self.mesh, self.n_classes_,
                 self.n_estimators_, self.voting, self.chunk_size,
                 self._identity_subspace,
             )(self.ensemble_, self.subspaces_, X)
-            return np.asarray(proba)[:n]
+            return to_host(proba)[:n]
         proba = _jitted_predict_clf(
             self._fitted_learner, self.n_classes_, self.n_estimators_,
             self.voting, self.chunk_size, self._identity_subspace,
@@ -641,7 +668,7 @@ class BaggingRegressor(_BaseBagging):
 
     def fit(self, X, y) -> "BaggingRegressor":
         X = self._validate_X(X)
-        y = jnp.asarray(y, jnp.float32)
+        y = np.asarray(y, np.float32)
         if y.ndim == 2 and y.shape[1] == 1:
             y = y[:, 0]
         if y.ndim != 1:
@@ -689,11 +716,12 @@ class BaggingRegressor(_BaseBagging):
         n = X.shape[0]
         if self.mesh is not None:
             X = pad_rows_X(X, self.mesh.shape.get(DATA_AXIS, 1))
+            X = global_put(X, self.mesh, P(DATA_AXIS, None))
             pred = _jitted_sharded_predict_reg(
                 self._fitted_learner, self.mesh, self.n_estimators_,
                 self.chunk_size, self._identity_subspace,
             )(self.ensemble_, self.subspaces_, X)
-            return np.asarray(pred)[:n]
+            return to_host(pred)[:n]
         pred = _jitted_predict_reg(
             self._fitted_learner, self.n_estimators_, self.chunk_size,
             self._identity_subspace,

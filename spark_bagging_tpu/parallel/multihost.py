@@ -1,0 +1,72 @@
+"""Multi-process (multi-host) array plumbing [SURVEY §5 comms backend].
+
+The reference delegates cross-node data movement to Spark's
+driver/executor runtime [SURVEY §1 L1]; here a multi-host TPU pod is
+one global ``(data, replica)`` mesh spanning every process joined via
+``jax.distributed`` (``parallel/distributed.py``), and the two
+host↔device seams the estimator needs are:
+
+- **in**: every process holds the same host matrix (the broadcast-data
+  design of bagging — no shuffle [B:5]); :func:`global_put` places it
+  as ONE global array with the mesh sharding, so each process transfers
+  only its addressable shards.
+- **out**: sharded results (row predictions ``P(data)``, per-replica
+  losses ``P(replica)``) are not fully addressable on any single
+  process; :func:`to_host` gathers them to a complete numpy array on
+  every process (the analog of Spark's ``collect()`` to the driver —
+  except every host gets the result, which is what SPMD callers want).
+
+Both helpers are no-ops-with-benefits in single-process runs, so the
+estimator calls them unconditionally on mesh paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def is_multiprocess_mesh(mesh: Mesh | None) -> bool:
+    """Does the mesh span devices owned by more than one process?"""
+    if mesh is None:
+        return False
+    procs = {d.process_index for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+def global_put(x: Any, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
+    """Place a host array as a global array sharded per ``spec``.
+
+    Every process must pass the same value (bagging broadcasts the
+    dataset [B:5]); ``jax.device_put`` then transfers only the shards
+    addressable from this process. Accepts numpy or an existing (local
+    or global) ``jax.Array``; committed single-device arrays are pulled
+    back to host first in multi-process runs, since a cross-process
+    device→device reshard needs a global source.
+    """
+    if (
+        isinstance(x, jax.Array)
+        and x.is_fully_addressable
+        and is_multiprocess_mesh(mesh)
+    ):
+        x = np.asarray(x)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def to_host(x: Any) -> np.ndarray:
+    """Device→host barrier that works on multi-process global arrays.
+
+    Fully-addressable arrays (always the case single-process) go
+    through plain ``np.asarray``. A multi-process sharded array is
+    assembled with an ``all_gather`` over its mesh so every process
+    returns the complete value [SURVEY §5 comms: ``lax.all_gather``
+    assembling row-sharded results].
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)

@@ -61,28 +61,39 @@ def _check_divisible(n_rows: int, n_replicas: int, mesh: Mesh) -> None:
         )
 
 
-def pad_rows_X(X, multiple: int) -> jnp.ndarray:
+def _xp(*arrays):
+    """numpy for host arrays, jnp otherwise — padding a host matrix must
+    not bounce it through the device (the mesh path device_puts once,
+    with its global sharding, AFTER padding)."""
+    import numpy as np
+
+    return np if all(isinstance(a, np.ndarray) for a in arrays) else jnp
+
+
+def pad_rows_X(X, multiple: int):
     """Pad only X's rows to a multiple (predict path — no y/mask needed;
     padded predictions are sliced off by the caller)."""
+    xp = _xp(X)
     rem = (-X.shape[0]) % multiple
     if rem == 0:
         return X
-    return jnp.concatenate([X, jnp.zeros((rem, X.shape[1]), X.dtype)])
+    return xp.concatenate([X, xp.zeros((rem, X.shape[1]), X.dtype)])
 
 
-def pad_rows(
-    X, y, multiple: int
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def pad_rows(X, y, multiple: int):
     """Pad rows to a multiple; returns (X, y, row_mask) with mask 0 on
     padding so padded rows carry zero sample weight everywhere."""
+    import numpy as np
+
+    xp = _xp(X, y)
     n = X.shape[0]
     rem = (-n) % multiple
-    mask = jnp.ones((n,), jnp.float32)
+    mask = xp.ones((n,), np.float32)
     if rem == 0:
         return X, y, mask
-    Xp = jnp.concatenate([X, jnp.zeros((rem, X.shape[1]), X.dtype)])
-    yp = jnp.concatenate([y, jnp.zeros((rem,), y.dtype)])
-    maskp = jnp.concatenate([mask, jnp.zeros((rem,), jnp.float32)])
+    Xp = xp.concatenate([X, xp.zeros((rem, X.shape[1]), X.dtype)])
+    yp = xp.concatenate([y, xp.zeros((rem,), y.dtype)])
+    maskp = xp.concatenate([mask, xp.zeros((rem,), np.float32)])
     return Xp, yp, maskp
 
 

@@ -26,6 +26,8 @@ from typing import Any
 import jax
 import numpy as np
 
+from spark_bagging_tpu.parallel.multihost import to_host
+
 _FORMAT_VERSION = 1
 
 
@@ -157,9 +159,14 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
     }
     with open(os.path.join(path, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
+    # to_host gathers non-addressable shards when the fit ran on a mesh
+    # spanning processes. The gather is COLLECTIVE: every process must
+    # call save() (gating the call on process_index deadlocks it); give
+    # each process its own path, or accept last-writer-wins of
+    # identical bytes on shared storage.
     tree = {
-        "ensemble": jax.tree.map(np.asarray, model.ensemble_),
-        "subspaces": np.asarray(model.subspaces_),
+        "ensemble": jax.tree.map(to_host, model.ensemble_),
+        "subspaces": to_host(model.subspaces_),
     }
     # OOB arrays ride along so a loaded model is fully OOB-fitted.
     if hasattr(model, "oob_decision_function_"):
