@@ -256,21 +256,35 @@ def main() -> None:
     import jax
 
     wanted = [int(c) for c in args.configs.split(",")]
-    results = []
-    for c in wanted:
-        t0 = time.perf_counter()
-        res = CONFIGS[c](args.scale)
-        res["wall_seconds"] = round(time.perf_counter() - t0, 2)
-        res["backend"] = jax.default_backend()
-        print(json.dumps(res))
-        results.append(res)
-
     out = args.json_out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"results_{args.scale}.json",
     )
-    with open(out, "w") as f:
-        json.dump({"scale": args.scale, "results": results}, f, indent=2)
+    results, failures = [], []
+    for c in wanted:
+        t0 = time.perf_counter()
+        try:
+            res = CONFIGS[c](args.scale)
+        except Exception as e:  # noqa: BLE001 — a dropped TPU tunnel or
+            # OOM on one config must not lose the finished ones
+            failures.append({
+                "config": c,
+                "error": f"{type(e).__name__}: {e}"[:400],
+            })
+            print(json.dumps(failures[-1]), file=sys.stderr)
+            res = None
+        if res is not None:
+            res["wall_seconds"] = round(time.perf_counter() - t0, 2)
+            res["backend"] = jax.default_backend()
+            print(json.dumps(res))
+            results.append(res)
+        # incremental persist: every completed config survives a crash
+        with open(out, "w") as f:
+            json.dump(
+                {"scale": args.scale, "results": results,
+                 "failures": failures},
+                f, indent=2,
+            )
 
     print(f"\n| # | config | metric | value | fits/sec | wall s |")
     print(f"|---|---|---|---|---|---|")
@@ -279,6 +293,8 @@ def main() -> None:
             f"| {r['config']} | {r['name']} | {r['metric']} | {r['value']} "
             f"| {r['fits_per_sec']} | {r['wall_seconds']} |"
         )
+    if failures:
+        sys.exit(1)  # a green exit must mean every requested config ran
 
 
 if __name__ == "__main__":

@@ -63,9 +63,24 @@ from spark_bagging_tpu.utils.profiling import log_timing
 
 @functools.lru_cache(maxsize=256)
 def _jitted_fit(learner, n_outputs, sample_ratio, bootstrap, n_subspace,
-                bootstrap_features, chunk_size):
+                bootstrap_features, chunk_size, with_weights=False):
     """Compiled-ensemble cache: learners hash by hyperparams, so repeated
-    fits with the same config and shapes reuse the XLA executable."""
+    fits with the same config and shapes reuse the XLA executable.
+    ``with_weights`` compiles the user-``sample_weight`` variant (the
+    weights multiply every replica's bootstrap counts, the reference's
+    weight-column semantics)."""
+    if with_weights:
+        return jax.jit(
+            lambda X, y, key, ids, sw: fit_ensemble(
+                learner, X, y, key, ids, n_outputs,
+                sample_ratio=sample_ratio,
+                bootstrap=bootstrap,
+                n_subspace=n_subspace,
+                bootstrap_features=bootstrap_features,
+                chunk_size=chunk_size,
+                row_mask=sw,
+            )
+        )
     return jax.jit(
         lambda X, y, key, ids: fit_ensemble(
             learner, X, y, key, ids, n_outputs,
@@ -324,7 +339,8 @@ class _BaseBagging(ParamsMixin):
                 f"{type(self).__name__} is not fitted; call fit(X, y) first"
             )
 
-    def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int):
+    def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int,
+                    sample_weight=None):
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
         if self.oob_score and not self.bootstrap and self.max_samples >= 1.0:
@@ -332,6 +348,20 @@ class _BaseBagging(ParamsMixin):
                 "oob_score requires out-of-bag rows: use bootstrap=True or "
                 "max_samples < 1.0"
             )
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, np.float32)
+            if sample_weight.shape != (X.shape[0],):
+                raise ValueError(
+                    f"sample_weight shape {sample_weight.shape} != "
+                    f"({X.shape[0]},)"
+                )
+            if (sample_weight < 0).any():
+                raise ValueError("sample_weight must be non-negative")
+            if not (sample_weight > 0).any():
+                raise ValueError(
+                    "sample_weight is all-zero: no rows would carry "
+                    "weight (w_sum=0 divides the solvers)"
+                )
         learner = self._learner()
         n_subspace = self._n_subspace(X.shape[1])
         key = jax.random.key(self.seed)
@@ -339,6 +369,12 @@ class _BaseBagging(ParamsMixin):
         if self.mesh is not None:
             data_size = self.mesh.shape.get(DATA_AXIS, 1)
             Xp, yp, mask = pad_rows(X, y, data_size)
+            if sample_weight is not None:
+                # weights ride the padding mask (padding stays 0-weight)
+                pad = Xp.shape[0] - X.shape[0]
+                mask = mask * np.concatenate(
+                    [sample_weight, np.zeros((pad,), np.float32)]
+                )
             # Global placement: rows sharded over data, replicated over
             # replica — each process transfers only its shards; also the
             # single-process fast path (no jit-entry reshard). This is
@@ -373,14 +409,18 @@ class _BaseBagging(ParamsMixin):
                 learner, n_outputs, float(self.max_samples),
                 bool(self.bootstrap), n_subspace,
                 bool(self.bootstrap_features), self.chunk_size,
+                with_weights=sample_weight is not None,
+            )
+            args = (X, y, key, ids) if sample_weight is None else (
+                X, y, key, ids, jnp.asarray(sample_weight)
             )
             # Compile (cached across fits with identical config+shapes).
             t0 = time.perf_counter()
             with log_timing("ensemble compile", logging.DEBUG):
-                compiled = fit_fn.lower(X, y, key, ids).compile()
+                compiled = fit_fn.lower(*args).compile()
             t_compile = time.perf_counter() - t0
             t0 = time.perf_counter()
-            params, subspaces, aux = compiled(X, y, key, ids)
+            params, subspaces, aux = compiled(*args)
             losses_np = np.asarray(aux["loss"])  # device->host barrier
             t_fit = time.perf_counter() - t0
 
@@ -556,7 +596,10 @@ class BaggingClassifier(_BaseBagging):
         )
         self.voting = voting
 
-    def fit(self, X, y) -> "BaggingClassifier":
+    def fit(self, X, y, sample_weight=None) -> "BaggingClassifier":
+        """Fit the ensemble. ``sample_weight`` (the reference's
+        weight-column semantics) multiplies every replica's bootstrap
+        counts; OOB membership stays weight-independent."""
         X = self._validate_X(X)
         y = np.asarray(y)
         if y.shape[0] != X.shape[0]:
@@ -566,7 +609,8 @@ class BaggingClassifier(_BaseBagging):
         if self.n_classes_ < 2:
             raise ValueError("y has a single class")
         y_enc = np.asarray(y_enc, np.int32)  # device placement is the
-        self._fit_engine(X, y_enc, self.n_classes_)  # engine's job
+        self._fit_engine(X, y_enc, self.n_classes_,  # engine's job
+                         sample_weight=sample_weight)
         if self.oob_score:
             counts, votes = self._oob_scores(X, self.n_classes_)
             has_vote = votes > 0
@@ -655,6 +699,18 @@ class BaggingClassifier(_BaseBagging):
         proba = self.predict_proba(X)
         return self.classes_[proba.argmax(axis=1)]
 
+    def predict_log_proba(self, X) -> np.ndarray:
+        """Log of the aggregated class probabilities (sklearn parity)."""
+        return np.log(np.maximum(self.predict_proba(X), 1e-38))
+
+    def decision_function(self, X) -> np.ndarray:
+        """(n,) margin for binary problems, (n, C) probabilities
+        otherwise — the sklearn ensemble convention."""
+        proba = self.predict_proba(X)
+        if proba.shape[1] == 2:
+            return proba[:, 1] - proba[:, 0]
+        return proba
+
     def score(self, X, y) -> float:
         return accuracy(np.asarray(y), self.predict(X))
 
@@ -666,7 +722,9 @@ class BaggingRegressor(_BaseBagging):
     task = "regression"
     _default_learner = LinearRegression
 
-    def fit(self, X, y) -> "BaggingRegressor":
+    def fit(self, X, y, sample_weight=None) -> "BaggingRegressor":
+        """Fit the ensemble; ``sample_weight`` as in
+        :meth:`BaggingClassifier.fit`."""
         X = self._validate_X(X)
         y = np.asarray(y, np.float32)
         if y.ndim == 2 and y.shape[1] == 1:
@@ -675,7 +733,7 @@ class BaggingRegressor(_BaseBagging):
             raise ValueError(f"y must be 1-D, got shape {y.shape}")
         if y.shape[0] != X.shape[0]:
             raise ValueError("X and y row counts differ")
-        self._fit_engine(X, y, 1)
+        self._fit_engine(X, y, 1, sample_weight=sample_weight)
         if self.oob_score:
             sums, votes = self._oob_scores(X, None)
             has_vote = votes > 0

@@ -124,7 +124,11 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
     from flax import serialization  # lazy: keep flax off the import path
 
     model._check_fitted()
-    os.makedirs(path, exist_ok=True)
+    # The to_host gathers below are COLLECTIVE on a mesh spanning
+    # processes: EVERY process must call save(). Only process 0 touches
+    # the filesystem (single-writer, as in streaming's checkpointer —
+    # concurrent writers to one shared path can tear files), so ``path``
+    # must be on storage all hosts can read for a pod-wide load().
     params = {
         k: _serialize_value(v)
         for k, v in model.get_params(deep=False).items()
@@ -157,13 +161,6 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
         "params": params,
         "fitted": fitted,
     }
-    with open(os.path.join(path, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
-    # to_host gathers non-addressable shards when the fit ran on a mesh
-    # spanning processes. The gather is COLLECTIVE: every process must
-    # call save() (gating the call on process_index deadlocks it); give
-    # each process its own path, or accept last-writer-wins of
-    # identical bytes on shared storage.
     tree = {
         "ensemble": jax.tree.map(to_host, model.ensemble_),
         "subspaces": to_host(model.subspaces_),
@@ -175,6 +172,11 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
         )
     if hasattr(model, "oob_prediction_"):
         tree["oob_prediction"] = np.asarray(model.oob_prediction_)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
     _write_arrays(path, serialization.msgpack_serialize(tree), compress)
 
 

@@ -249,3 +249,88 @@ class TestParamsProtocol:
         c = clf.clone()
         assert not hasattr(c, "ensemble_")
         assert c.get_params(deep=False) == clf.get_params(deep=False)
+
+
+class TestSampleWeight:
+    """User sample_weight = the reference's weight-column semantics:
+    weights multiply every replica's bootstrap counts."""
+
+    def test_weighted_equals_duplicated_rows(self, breast_cancer):
+        X, y = breast_cancer
+        X, y = X[:120], y[:120]
+        k = np.asarray([1, 2, 3] * 40)
+        # degenerate ensemble (no resampling) isolates weight handling
+        base = dict(n_estimators=1, bootstrap=False, max_samples=1.0, seed=0)
+        w_fit = BaggingClassifier(**base).fit(X, y, sample_weight=k)
+        dup = BaggingClassifier(**base).fit(
+            np.repeat(X, k, axis=0), np.repeat(y, k)
+        )
+        np.testing.assert_allclose(
+            w_fit.predict_proba(X), dup.predict_proba(X), rtol=1e-3,
+            atol=1e-4,
+        )
+
+    def test_zero_weight_rows_ignored(self, breast_cancer):
+        X, y = breast_cancer
+        n = len(y)
+        y_bad = y.copy()
+        w = np.ones(n, np.float32)
+        w[: n // 4] = 0.0
+        y_bad[: n // 4] = 1 - y_bad[: n // 4]  # corrupt zero-weight rows
+        base = dict(n_estimators=4, seed=0)
+        a = BaggingClassifier(**base).fit(X, y_bad, sample_weight=w)
+        b = BaggingClassifier(**base).fit(X[n // 4:], y[n // 4:])
+        assert a.score(X[n // 4:], y[n // 4:]) == pytest.approx(
+            b.score(X[n // 4:], y[n // 4:]), abs=0.02
+        )
+
+    def test_mesh_weighted_fit(self, breast_cancer):
+        from spark_bagging_tpu.parallel import make_mesh
+
+        X, y = breast_cancer
+        w = np.random.default_rng(0).uniform(0.5, 2.0, len(y)).astype(
+            np.float32
+        )
+        mesh = make_mesh(data=2)
+        m = BaggingClassifier(n_estimators=8, seed=0, mesh=mesh).fit(
+            X, y, sample_weight=w
+        )
+        s = BaggingClassifier(n_estimators=8, seed=0).fit(
+            X, y, sample_weight=w
+        )
+        assert m.score(X, y) == pytest.approx(s.score(X, y), abs=0.02)
+
+    def test_regressor_weighted(self, diabetes):
+        X, y = diabetes
+        w = np.ones(len(y), np.float32)
+        reg = BaggingRegressor(n_estimators=8, seed=0).fit(
+            X, y, sample_weight=w
+        )
+        ref = BaggingRegressor(n_estimators=8, seed=0).fit(X, y)
+        np.testing.assert_allclose(
+            reg.predict(X), ref.predict(X), rtol=1e-4, atol=1e-4
+        )
+
+    def test_bad_weights_raise(self, breast_cancer):
+        X, y = breast_cancer
+        with pytest.raises(ValueError, match="sample_weight"):
+            BaggingClassifier().fit(X, y, sample_weight=np.ones(3))
+        with pytest.raises(ValueError, match="non-negative"):
+            BaggingClassifier().fit(
+                X, y, sample_weight=-np.ones(len(y), np.float32)
+            )
+
+
+def test_predict_log_proba_and_decision_function(breast_cancer):
+    X, y = breast_cancer
+    clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    lp = clf.predict_log_proba(X)
+    np.testing.assert_allclose(np.exp(lp), clf.predict_proba(X), rtol=1e-5)
+    df = clf.decision_function(X)
+    assert df.shape == (len(y),)
+    assert ((df > 0) == (clf.predict(X) == clf.classes_[1])).all()
+
+    Xi, yi = load_iris(return_X_y=True)
+    Xi = StandardScaler().fit_transform(Xi).astype(np.float32)
+    clf3 = BaggingClassifier(n_estimators=4, seed=0).fit(Xi, yi)
+    assert clf3.decision_function(Xi).shape == (len(yi), 3)
