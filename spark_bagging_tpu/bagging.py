@@ -462,10 +462,15 @@ class _BaseBagging(ParamsMixin):
 
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
-        if self.oob_score:
+        if self.oob_score and self.mesh is not None:
             raise ValueError(
-                "oob_score is not supported with fit_stream (per-chunk "
-                "weight draws have no global OOB regeneration path)"
+                "oob_score with fit_stream is single-mesh only; drop the "
+                "mesh or compute OOB separately"
+            )
+        if self.oob_score and not self.bootstrap and self.max_samples >= 1.0:
+            raise ValueError(
+                "oob_score requires out-of-bag rows: use bootstrap=True or "
+                "max_samples < 1.0"
             )
         learner = self._learner()
         n_subspace = self._n_subspace(source.n_features)
@@ -541,6 +546,21 @@ class _BaseBagging(ParamsMixin):
         if "n_passes" in aux:
             self.fit_report_["n_passes"] = aux["n_passes"]
 
+    def _oob_scores_stream(self, source, n_classes: int | None):
+        """Streamed OOB: one extra pass regenerating each replica's
+        chunk-keyed membership [VERDICT r1 #3's fit_stream carve-out].
+        Returns ``(agg, votes, y)`` in stream order."""
+        from spark_bagging_tpu.streaming import oob_scores_stream
+
+        ratio, replacement = self._fit_sampling
+        return oob_scores_stream(
+            self._fitted_learner, source, self._fit_key,
+            self.ensemble_, self.subspaces_, self.n_estimators_,
+            sample_ratio=ratio, bootstrap=replacement,
+            n_classes=n_classes, chunk_size=self.chunk_size,
+            identity_subspace=self._identity_subspace,
+        )
+
     def _oob_scores(self, X: jnp.ndarray, n_classes: int | None):
         """OOB aggregate + vote counts (rows with zero votes excluded by
         caller) [SURVEY §4]. On a mesh, rows are padded exactly as at
@@ -596,6 +616,18 @@ class BaggingClassifier(_BaseBagging):
         )
         self.voting = voting
 
+    def _finalize_oob(self, counts, votes, y_enc) -> None:
+        """OOB vote counts -> ``oob_score_`` (accuracy over voted rows)
+        + ``oob_decision_function_`` (NaN where no replica voted) —
+        shared by the in-memory and streamed fits [SURVEY §4]."""
+        has_vote = votes > 0
+        oob_pred = counts.argmax(axis=1)
+        self.oob_score_ = accuracy(y_enc[has_vote], oob_pred[has_vote])
+        self.oob_decision_function_ = np.where(
+            has_vote[:, None], counts / np.maximum(votes, 1)[:, None],
+            np.nan,
+        )
+
     def fit(self, X, y, sample_weight=None) -> "BaggingClassifier":
         """Fit the ensemble. ``sample_weight`` (the reference's
         weight-column semantics) multiplies every replica's bootstrap
@@ -613,12 +645,7 @@ class BaggingClassifier(_BaseBagging):
                          sample_weight=sample_weight)
         if self.oob_score:
             counts, votes = self._oob_scores(X, self.n_classes_)
-            has_vote = votes > 0
-            oob_pred = counts.argmax(axis=1)
-            self.oob_score_ = accuracy(y_enc[has_vote], oob_pred[has_vote])
-            self.oob_decision_function_ = np.where(
-                has_vote[:, None], counts / np.maximum(votes, 1)[:, None], np.nan
-            )
+            self._finalize_oob(counts, votes, y_enc)
         return self
 
     def fit_stream(
@@ -667,13 +694,19 @@ class BaggingClassifier(_BaseBagging):
         if len(self.classes_) != len(classes):
             raise ValueError("classes contains duplicate values")
         self.n_classes_ = int(len(self.classes_))
+        enc = _EncodedChunks(source, self.classes_)
         self._fit_stream_engine(
-            _EncodedChunks(source, self.classes_), self.n_classes_,
+            enc, self.n_classes_,
             n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             resume_from=resume_from,
         )
+        if self.oob_score:
+            counts, votes, y_enc = self._oob_scores_stream(
+                enc, self.n_classes_
+            )
+            self._finalize_oob(counts, votes, y_enc)
         return self
 
     def predict_proba(self, X) -> np.ndarray:
@@ -722,6 +755,19 @@ class BaggingRegressor(_BaseBagging):
     task = "regression"
     _default_learner = LinearRegression
 
+    def _finalize_oob(self, sums, votes, y) -> None:
+        """OOB prediction sums -> ``oob_prediction_`` (NaN where no
+        replica voted) + ``oob_score_`` (R² over voted rows) — shared
+        by the in-memory and streamed fits [SURVEY §4]."""
+        has_vote = votes > 0
+        self.oob_prediction_ = np.where(
+            has_vote, sums / np.maximum(votes, 1), np.nan
+        )
+        self.oob_score_ = r2_score(
+            np.asarray(y, np.float32)[has_vote],
+            self.oob_prediction_[has_vote],
+        )
+
     def fit(self, X, y, sample_weight=None) -> "BaggingRegressor":
         """Fit the ensemble; ``sample_weight`` as in
         :meth:`BaggingClassifier.fit`."""
@@ -736,12 +782,7 @@ class BaggingRegressor(_BaseBagging):
         self._fit_engine(X, y, 1, sample_weight=sample_weight)
         if self.oob_score:
             sums, votes = self._oob_scores(X, None)
-            has_vote = votes > 0
-            oob_pred = sums[has_vote] / votes[has_vote]
-            self.oob_prediction_ = np.where(
-                has_vote, sums / np.maximum(votes, 1), np.nan
-            )
-            self.oob_score_ = r2_score(np.asarray(y)[has_vote], oob_pred)
+            self._finalize_oob(sums, votes, y)
         return self
 
     def fit_stream(
@@ -766,6 +807,9 @@ class BaggingRegressor(_BaseBagging):
                                 checkpoint_dir=checkpoint_dir,
                                 checkpoint_every=checkpoint_every,
                                 resume_from=resume_from)
+        if self.oob_score:
+            sums, votes, y_np = self._oob_scores_stream(source, None)
+            self._finalize_oob(sums, votes, y_np)
         return self
 
     def predict(self, X) -> np.ndarray:

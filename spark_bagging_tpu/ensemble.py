@@ -256,31 +256,64 @@ def oob_predict_scores(
     the same axis name (under the same mesh) so regeneration replays
     the identical stream for this shard's rows.
     """
-    n_rows = X.shape[0]
-    classification = n_classes is not None
     row_key = key
     if data_axis is not None:
         row_key = jax.random.fold_in(key, jax.lax.axis_index(data_axis))
 
     def one(args):
         params, idx, rid = args
-        w = bootstrap_weights_one(
-            row_key, rid, n_rows, ratio=sample_ratio, replacement=bootstrap
+        return oob_replica_contrib(
+            learner, params, idx, rid, X, row_key,
+            sample_ratio=sample_ratio, bootstrap=bootstrap,
+            n_classes=n_classes, identity_subspace=identity_subspace,
         )
-        mask = oob_mask(w).astype(jnp.float32)
-        scores = learner.predict_scores(
-            params, X if identity_subspace else X[:, idx]
-        )
-        if classification:
-            onehot = jax.nn.one_hot(
-                jnp.argmax(scores, axis=-1), n_classes, dtype=jnp.float32
-            )
-            return onehot * mask[:, None], mask
-        return scores * mask, mask
 
-    args = (stacked_params, subspaces, replica_ids)
-    if chunk_size is None:
-        contrib, votes = jax.vmap(one)(args)
-    else:
-        contrib, votes = jax.lax.map(one, args, batch_size=chunk_size)
+    contrib, votes = map_replicas(
+        one, (stacked_params, subspaces, replica_ids), chunk_size
+    )
     return contrib.sum(axis=0), votes.sum(axis=0)
+
+
+def oob_replica_contrib(
+    learner: BaseLearner,
+    params: Any,
+    idx: jax.Array,
+    rid: jax.Array,
+    X: jax.Array,
+    weight_key: jax.Array,
+    *,
+    sample_ratio: float,
+    bootstrap: bool,
+    n_classes: int | None,
+    identity_subspace: bool,
+    extra_mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """One replica's OOB vote contract, shared by the in-memory,
+    sharded, and streamed OOB paths: regenerate the replica's weights
+    from ``weight_key``, vote (one-hot argmax for classification,
+    masked prediction sum for regression) only where they are zero.
+    ``extra_mask`` ANDs in additional row validity (chunk padding)."""
+    w = bootstrap_weights_one(
+        weight_key, rid, X.shape[0], ratio=sample_ratio,
+        replacement=bootstrap,
+    )
+    mask = oob_mask(w).astype(jnp.float32)
+    if extra_mask is not None:
+        mask = mask * extra_mask
+    scores = learner.predict_scores(
+        params, X if identity_subspace else X[:, idx]
+    )
+    if n_classes is not None:
+        onehot = jax.nn.one_hot(
+            jnp.argmax(scores, axis=-1), n_classes, dtype=jnp.float32
+        )
+        return onehot * mask[:, None], mask
+    return scores * mask, mask
+
+
+def map_replicas(fn, args, chunk_size: int | None):
+    """vmap over replicas, or ``lax.map`` in ``chunk_size`` batches to
+    bound the per-step memory (the ``parallelism`` knob)."""
+    if chunk_size is None:
+        return jax.vmap(fn)(args)
+    return jax.lax.map(fn, args, batch_size=chunk_size)

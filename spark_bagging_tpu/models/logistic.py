@@ -168,6 +168,12 @@ class LogisticRegression(BaseLearner):
     # -- Newton --------------------------------------------------------
 
     def _resolved_hessian(self, C: int) -> str:
+        if self.hessian_impl not in ("auto", "blocked", "fused"):
+            # re-validate: set_params() bypasses __init__
+            raise ValueError(
+                f"hessian_impl must be auto|blocked|fused, got "
+                f"{self.hessian_impl!r}"
+            )
         if self.hessian_impl != "auto":
             return self.hessian_impl
         return "fused" if C > 8 else "blocked"

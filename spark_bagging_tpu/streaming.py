@@ -357,3 +357,74 @@ def fit_ensemble_stream(
         "first_step_seconds": compile_seconds,
     }
     return params, subspaces, aux
+
+
+def oob_scores_stream(
+    learner: BaseLearner,
+    source: ChunkSource,
+    key: jax.Array,
+    stacked_params: Any,
+    subspaces: jax.Array,
+    n_replicas: int,
+    *,
+    sample_ratio: float = 1.0,
+    bootstrap: bool = True,
+    n_classes: int | None = None,
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """OOB aggregation for a streamed fit: ONE extra pass over the
+    source [SURVEY §4, closing VERDICT r1 #3's fit_stream carve-out].
+
+    Works because chunk-keyed weight draws are epoch-stable: both stream
+    engines (SGD and level-synchronous trees) draw chunk ``c``'s weights
+    from ``fold_in(fold_in(key, _CHUNK_STREAM), c)``, so regenerating
+    them here replays each replica's exact membership, and ``w == 0``
+    rows are its out-of-bag rows — the same contract as the in-memory
+    ``oob_predict_scores``.
+
+    Returns ``(agg, n_votes, y)`` over all valid rows in stream order:
+    ``agg`` is vote counts ``(n, C)`` for classification or prediction
+    sums ``(n,)`` for regression; rows with ``n_votes == 0`` have no
+    OOB estimate.
+    """
+    from spark_bagging_tpu.ensemble import map_replicas, oob_replica_contrib
+
+    row_key = jax.random.fold_in(key, _CHUNK_STREAM)
+    chunk_rows = source.chunk_rows
+    ids = jnp.arange(n_replicas, dtype=jnp.int32)
+    precision = getattr(learner, "precision", "highest")
+
+    @jax.jit
+    def chunk_oob(params, subs, X, n_valid, chunk_uid):
+        valid = (jnp.arange(chunk_rows) < n_valid).astype(jnp.float32)
+        chunk_key = jax.random.fold_in(row_key, chunk_uid)
+
+        def one(args):
+            p, idx, rid = args
+            with jax.default_matmul_precision(precision):
+                return oob_replica_contrib(
+                    learner, p, idx, rid, X, chunk_key,
+                    sample_ratio=sample_ratio, bootstrap=bootstrap,
+                    n_classes=n_classes,
+                    identity_subspace=identity_subspace,
+                    extra_mask=valid,
+                )
+
+        contrib, votes = map_replicas(one, (params, subs, ids), chunk_size)
+        return contrib.sum(axis=0), votes.sum(axis=0)
+
+    aggs, votes_all, ys = [], [], []
+    for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
+        a, v = chunk_oob(
+            stacked_params, subspaces, jnp.asarray(Xc, jnp.float32),
+            jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
+        )
+        aggs.append(np.asarray(a)[:n_valid])
+        votes_all.append(np.asarray(v)[:n_valid])
+        ys.append(np.asarray(yc)[:n_valid])
+    return (
+        np.concatenate(aggs),
+        np.concatenate(votes_all),
+        np.concatenate(ys),
+    )

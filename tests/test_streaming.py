@@ -346,12 +346,15 @@ def test_stream_tree_rejects_checkpoint(cancer):
         )
 
 
-def test_stream_rejects_oob(cancer):
+def test_stream_oob_rejects_mesh(cancer):
+    """Streamed OOB is single-mesh only (for now)."""
+    from spark_bagging_tpu.parallel import make_mesh
+
     X, y = cancer
-    with pytest.raises(ValueError, match="oob_score"):
-        BaggingClassifier(n_estimators=2, oob_score=True).fit_stream(
-            (X, y), chunk_rows=128
-        )
+    with pytest.raises(ValueError, match="single-mesh"):
+        BaggingClassifier(
+            n_estimators=8, oob_score=True, mesh=make_mesh(data=2)
+        ).fit_stream((X, y), chunk_rows=128)
 
 
 def test_stream_subspaces(cancer):
@@ -503,3 +506,56 @@ def test_stream_checkpoint_resume_on_mesh(cancer, tmp_path):
     np.testing.assert_allclose(
         ref.predict_proba(X), res.predict_proba(X), rtol=1e-4, atol=1e-5
     )
+
+
+# ---------------------------------------------------------------------
+# Streamed OOB (one extra pass; chunk-keyed membership regeneration)
+# ---------------------------------------------------------------------
+
+
+def test_stream_oob_classifier(cancer):
+    X, y = cancer
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=32, seed=0,
+        oob_score=True,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=128), n_epochs=20, lr=0.05)
+    assert clf.oob_score_ > 0.9
+    df = clf.oob_decision_function_
+    assert df.shape == (len(y), 2)
+    voted = ~np.isnan(df[:, 0])
+    # λ=1 Poisson per chunk: OOB fraction per (row, replica) ≈ e⁻¹, so
+    # nearly every row gets some OOB vote across 32 replicas
+    assert voted.mean() > 0.99
+    np.testing.assert_allclose(df[voted].sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_stream_oob_regressor():
+    X, y = make_regression(600, 6, seed=2)
+    mu, s = X.mean(0), X.std(0) + 1e-8
+    X = ((X - mu) / s).astype(np.float32)
+    reg = BaggingRegressor(
+        base_learner=LinearRegression(), n_estimators=32, seed=0,
+        oob_score=True,
+    ).fit_stream((X, y), n_epochs=60, lr=0.1, chunk_rows=128)
+    assert reg.oob_score_ > 0.6
+    assert reg.oob_prediction_.shape == (len(y),)
+
+
+def test_stream_oob_tree(cancer):
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+
+    X, y = cancer
+    clf = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=16, seed=0, oob_score=True,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=128))
+    assert clf.oob_score_ > 0.85
+
+
+def test_stream_oob_without_oob_rows_raises(cancer):
+    X, y = cancer
+    with pytest.raises(ValueError, match="out-of-bag"):
+        BaggingClassifier(
+            n_estimators=4, oob_score=True, bootstrap=False,
+            max_samples=1.0,
+        ).fit_stream(ArrayChunks(X, y, chunk_rows=128))
