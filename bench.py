@@ -129,6 +129,11 @@ def main() -> None:
     # temps at (chunk, tile, C), lifting the chunk ceiling.
     p.add_argument("--chunk-size", type=int, default=200)
     p.add_argument("--row-tile", type=int, default=None)
+    # "blocked" emits C²/2 (d, d)-output matmuls — at d=55 the MXU's
+    # 128x128 output tiles run ~18% full; "fused" emits one
+    # (C·d, n)@(n, C·d) matmul whose 385-wide output tiles far better.
+    p.add_argument("--hessian-impl", default="auto",
+                   choices=["auto", "blocked", "fused"])
     p.add_argument("--max-iter", type=int, default=3)
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")
@@ -176,7 +181,7 @@ def main() -> None:
 
     learner = LogisticRegression(
         l2=args.l2, max_iter=args.max_iter, precision=args.precision,
-        row_tile=args.row_tile,
+        row_tile=args.row_tile, hessian_impl=args.hessian_impl,
     )
     clf = BaggingClassifier(
         base_learner=learner,

@@ -1,0 +1,47 @@
+"""Device-mesh sharding: replicas and rows over a (data, replica) mesh.
+
+The reference scales by Spark partitions + driver-side fit futures
+[SURVEY §2c]; here the same two axes are a jax.sharding Mesh — replicas
+shard over `replica`, rows over `data`, learner row-statistics `psum`
+across data shards (bit-identical to the single-device fit).
+
+Run with any device count; to fake an 8-device topology on CPU:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/02_mesh_sharding.py --cpu
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import BaggingClassifier, make_mesh
+
+X, y = load_breast_cancer(return_X_y=True)
+X = StandardScaler().fit_transform(X).astype(np.float32)
+
+n_dev = jax.device_count()
+data = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+mesh = make_mesh(data=data)  # remaining devices on the replica axis
+print(f"mesh: {dict(mesh.shape)} over {n_dev} {jax.default_backend()} device(s)")
+
+clf = BaggingClassifier(
+    n_estimators=max(8, n_dev * 4), mesh=mesh, oob_score=True, seed=0
+).fit(X, y)
+print(f"accuracy {clf.score(X, y):.4f}  OOB {clf.oob_score_:.4f}")
+
+# Multi-host pods: call initialize_distributed() first (one process per
+# host), build the mesh over jax.devices() (global), and pass the same
+# host arrays on every process — see tests/test_multihost.py for a
+# runnable 2-process example.
