@@ -485,17 +485,14 @@ class _BaseBagging(ParamsMixin):
                 fit_tree_ensemble_stream,
             )
 
-            if checkpoint_dir is not None or resume_from is not None:
-                raise ValueError(
-                    "checkpoint/resume is not supported for streamed "
-                    "tree fits (each level pass is atomic); re-run fit"
-                )
             if n_epochs != 1 or steps_per_chunk != 1:
                 raise ValueError(
                     "n_epochs/steps_per_chunk are SGD-stream knobs; a "
                     "streamed tree fit always makes max_depth + 2 "
                     "passes — drop them for tree learners"
                 )
+            # Trees snapshot at every pass boundary; checkpoint_every
+            # (a per-chunk-step knob) does not apply.
             params, subspaces, aux = fit_tree_ensemble_stream(
                 learner, source, key, self.n_estimators, n_outputs,
                 sample_ratio=float(self.max_samples),
@@ -503,6 +500,8 @@ class _BaseBagging(ParamsMixin):
                 n_subspace=n_subspace,
                 bootstrap_features=bool(self.bootstrap_features),
                 mesh=self.mesh,
+                checkpoint_dir=checkpoint_dir,
+                resume_from=resume_from,
             )
         else:
             params, subspaces, aux = fit_ensemble_stream(
@@ -672,9 +671,10 @@ class BaggingClassifier(_BaseBagging):
         knobs ``n_epochs``/``steps_per_chunk``/``lr`` don't apply).
 
         ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot the fit
-        state every N chunk-steps; ``resume_from`` continues a killed
-        fit from its last snapshot, bit-identical to the uninterrupted
-        run [SURVEY §5 checkpoint].
+        state every N chunk-steps (tree learners instead snapshot at
+        every pass boundary and ignore ``checkpoint_every``);
+        ``resume_from`` continues a killed fit from its last snapshot,
+        bit-identical to the uninterrupted run [SURVEY §5 checkpoint].
         """
         from spark_bagging_tpu.utils.io import as_chunk_source
 

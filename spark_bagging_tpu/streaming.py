@@ -96,6 +96,38 @@ def _save_stream_checkpoint(
             if losses else np.zeros((0, 0), np.float32)
         ),
     }
+    save_snapshot(path, tree, meta)
+
+
+def learner_fingerprint(learner: BaseLearner) -> str:
+    """Stable hyperparameter fingerprint for resume-config validation
+    (shared by the SGD and tree stream checkpointers)."""
+    return repr(sorted(
+        (k, repr(v)) for k, v in learner.get_params(deep=False).items()
+    )) + type(learner).__qualname__
+
+
+def check_resume_config(meta: dict, config: dict, path: str) -> None:
+    """A resumed run must be continuing THIS fit: raise with the
+    mismatched keys if the snapshot's config fingerprint differs."""
+    saved = meta.get("config", {})
+    if saved != config:
+        diff = {
+            k for k in set(saved) | set(config)
+            if saved.get(k) != config.get(k)
+        }
+        raise ValueError(
+            f"checkpoint at {path} was written by a different fit "
+            f"configuration (mismatched: {sorted(diff)})"
+        )
+
+
+def save_snapshot(path: str, tree: Any, meta: dict) -> None:
+    """Atomically install a (msgpack pytree, JSON meta) snapshot at
+    ``path`` — the shared mechanism for every stream checkpointer.
+    Single-writer: non-0 processes return before touching the FS."""
+    from flax import serialization
+
     if jax.process_index() != 0:
         return
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -207,10 +239,7 @@ def fit_ensemble_stream(
         "bootstrap_features": bootstrap_features,
         "chunk_rows": chunk_rows,
         "n_features": n_features,
-        "learner": repr(sorted(
-            (k, repr(v))
-            for k, v in learner.get_params(deep=False).items()
-        )) + type(learner).__qualname__,
+        "learner": learner_fingerprint(learner),
     }
 
     start_epoch, start_chunk = 0, 0
@@ -219,15 +248,7 @@ def fit_ensemble_stream(
         from flax import serialization
 
         meta, tree = _load_stream_checkpoint(resume_from)
-        if meta["config"] != config:
-            diff = {
-                k for k in set(meta["config"]) | set(config)
-                if meta["config"].get(k) != config.get(k)
-            }
-            raise ValueError(
-                f"checkpoint at {resume_from} was written by a different "
-                f"fit configuration (mismatched: {sorted(diff)})"
-            )
+        check_resume_config(meta, config, resume_from)
         params = serialization.from_state_dict(params, tree["params"])
         opt_state = serialization.from_state_dict(
             opt_state, tree["opt_state"]

@@ -44,7 +44,7 @@ from spark_bagging_tpu.ops.bootstrap import (
     bootstrap_weights_one,
     feature_subspaces,
 )
-from spark_bagging_tpu.streaming import _CHUNK_STREAM
+from spark_bagging_tpu.streaming import _CHUNK_STREAM, learner_fingerprint
 from spark_bagging_tpu.utils.io import ChunkSource
 
 
@@ -60,9 +60,21 @@ def fit_tree_ensemble_stream(
     n_subspace: int | None = None,
     bootstrap_features: bool = False,
     mesh=None,
+    checkpoint_dir: str | None = None,
+    resume_from: str | None = None,
 ) -> tuple[Any, jax.Array, dict[str, Any]]:
     """Stream-fit a tree ensemble; same return contract as
-    ``fit_ensemble_stream`` (stacked params, subspaces, aux)."""
+    ``fit_ensemble_stream`` (stacked params, subspaces, aux).
+
+    Fault tolerance [SURVEY §5 failure detection]: level-synchronous
+    growth has natural snapshot points — pass boundaries. With
+    ``checkpoint_dir`` set, the engine snapshots
+    ``(edges, per-level splits, pass cursor)`` after every completed
+    pass (the state is tiny — O(R·2^d) — unlike the mid-pass histogram
+    accumulator); ``resume_from`` skips the completed passes and
+    re-runs only the in-flight one, reproducing the uninterrupted fit
+    exactly (chunk-keyed weight draws are visit-order independent).
+    """
     if mesh is not None:
         raise NotImplementedError(
             "streamed tree fits run single-device for now; drop mesh= or "
@@ -82,6 +94,53 @@ def fit_tree_ensemble_stream(
     t0 = time.perf_counter()
     first_step_seconds = None
 
+    import numpy as np
+
+    # Pass cursor: 0 = edge pass, 1..d = level passes, d+1 = leaf pass.
+    config = {
+        "key": np.asarray(jax.random.key_data(key)).tolist(),
+        "n_replicas": n_replicas,
+        "n_outputs": n_outputs,
+        "sample_ratio": sample_ratio,
+        "bootstrap": bootstrap,
+        "n_subspace": n_subspace,
+        "bootstrap_features": bootstrap_features,
+        "chunk_rows": chunk_rows,
+        "n_features": n_features,
+        "learner": learner_fingerprint(learner),
+    }
+    start_pass = 0
+    edges = None
+    resumed_state: dict | None = None
+    if resume_from is not None:
+        from spark_bagging_tpu.streaming import (
+            _load_stream_checkpoint,
+            check_resume_config,
+        )
+
+        meta, tree_state = _load_stream_checkpoint(resume_from)
+        check_resume_config(meta, config, resume_from)
+        start_pass = meta["next_pass"]
+        resumed_state = tree_state
+        if "edges" in tree_state:
+            edges = jnp.asarray(tree_state["edges"])
+
+    def _snapshot(next_pass, feats_lvls, thrs_lvls, curve):
+        if checkpoint_dir is None:
+            return
+        from spark_bagging_tpu.streaming import save_snapshot
+
+        tree_state = {
+            "edges": np.asarray(edges),
+            "feats": [np.asarray(f) for f in feats_lvls],
+            "thrs": [np.asarray(t) for t in thrs_lvls],
+            "curve": [np.asarray(c) for c in curve],
+        }
+        save_snapshot(
+            checkpoint_dir, tree_state,
+            {"config": config, "next_pass": next_pass},
+        )
+
     # -- pass 0: averaged per-chunk quantile edges over the full
     #    feature set (replicas slice their subspace columns later) ----
     @jax.jit
@@ -91,24 +150,29 @@ def fit_tree_ensemble_stream(
         has = (nv > 0).astype(jnp.float32)
         return jnp.where(jnp.isfinite(interior), interior, 0.0) * has, has
 
-    e_sum = jnp.zeros((n_features, B - 1), jnp.float32)
-    e_cnt = jnp.zeros((), jnp.float32)
-    n_chunks = 0
-    for Xc, _, n_valid in source.chunks():
-        e, has = edge_chunk(
-            jnp.asarray(Xc, jnp.float32), jnp.asarray(n_valid, jnp.int32)
+    if start_pass == 0:
+        e_sum = jnp.zeros((n_features, B - 1), jnp.float32)
+        e_cnt = jnp.zeros((), jnp.float32)
+        n_chunks = 0
+        for Xc, _, n_valid in source.chunks():
+            e, has = edge_chunk(
+                jnp.asarray(Xc, jnp.float32), jnp.asarray(n_valid, jnp.int32)
+            )
+            e_sum, e_cnt = e_sum + e, e_cnt + has
+            n_chunks += 1
+            if first_step_seconds is None:
+                jax.block_until_ready(e)
+                first_step_seconds = time.perf_counter() - t0
+        if n_chunks == 0:
+            raise ValueError("source yielded no chunks")
+        interior = e_sum / jnp.maximum(e_cnt, 1.0)
+        edges = jnp.concatenate(
+            [interior, jnp.full((n_features, 1), jnp.inf, jnp.float32)],
+            axis=1,
         )
-        e_sum, e_cnt = e_sum + e, e_cnt + has
-        n_chunks += 1
-        if first_step_seconds is None:
-            jax.block_until_ready(e)
-            first_step_seconds = time.perf_counter() - t0
-    if n_chunks == 0:
-        raise ValueError("source yielded no chunks")
-    interior = e_sum / jnp.maximum(e_cnt, 1.0)
-    edges = jnp.concatenate(
-        [interior, jnp.full((n_features, 1), jnp.inf, jnp.float32)], axis=1
-    )
+        _snapshot(1, (), (), [])
+    else:
+        n_chunks = source.n_chunks  # edge pass already done (snapshot)
 
     y_dtype = (
         jnp.int32 if learner.task == "classification" else jnp.float32
@@ -136,7 +200,13 @@ def fit_tree_ensemble_stream(
     feats_lvls: tuple = ()  # per level: (R, 2^level) arrays
     thrs_lvls: tuple = ()
     curve = []
+    if resumed_state is not None and start_pass >= 1:
+        feats_lvls = tuple(jnp.asarray(f) for f in resumed_state["feats"])
+        thrs_lvls = tuple(jnp.asarray(tl) for tl in resumed_state["thrs"])
+        curve = [jnp.asarray(c) for c in resumed_state["curve"]]
     for level in range(d):
+        if level + 1 < start_pass:
+            continue  # this level's pass completed before the snapshot
         N = 2**level
 
         @jax.jit
@@ -166,6 +236,9 @@ def fit_tree_ensemble_stream(
                 jnp.asarray(Xc, jnp.float32), jnp.asarray(yc, y_dtype),
                 jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
             )
+            if first_step_seconds is None:  # resumed past the edge pass
+                jax.block_until_ready(hist)
+                first_step_seconds = time.perf_counter() - t0
 
         @jax.jit
         def select(hist):
@@ -179,6 +252,7 @@ def fit_tree_ensemble_stream(
         feats_lvls = feats_lvls + (bf,)
         thrs_lvls = thrs_lvls + (thr,)
         curve.append(score)
+        _snapshot(level + 2, feats_lvls, thrs_lvls, curve)
 
     # -- final pass: leaf statistics ----------------------------------
     K = 3 if learner.task == "regression" else n_outputs
@@ -203,6 +277,9 @@ def fit_tree_ensemble_stream(
             jnp.asarray(Xc, jnp.float32), jnp.asarray(yc, y_dtype),
             jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
         )
+        if first_step_seconds is None:  # resumed straight at leaf pass
+            jax.block_until_ready(leaf_acc)
+            first_step_seconds = time.perf_counter() - t0
 
     @jax.jit
     def finalize(leaf_acc, curve_stack):

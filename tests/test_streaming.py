@@ -334,16 +334,13 @@ def test_stream_tree_with_subspaces(cancer):
     assert clf.score(X, y) > 0.9
 
 
-def test_stream_tree_rejects_checkpoint(cancer):
+def test_stream_tree_rejects_sgd_knobs(cancer):
     X, y = cancer
-    with pytest.raises(ValueError, match="checkpoint"):
+    with pytest.raises(ValueError, match="SGD-stream knobs"):
         BaggingClassifier(
             base_learner=DecisionTreeClassifier(max_depth=3),
             n_estimators=2,
-        ).fit_stream(
-            (X, y), classes=[0, 1], chunk_rows=128,
-            checkpoint_dir="/tmp/x", checkpoint_every=1,
-        )
+        ).fit_stream((X, y), classes=[0, 1], chunk_rows=128, n_epochs=3)
 
 
 def test_stream_oob_rejects_mesh(cancer):
@@ -559,3 +556,83 @@ def test_stream_oob_without_oob_rows_raises(cancer):
             n_estimators=4, oob_score=True, bootstrap=False,
             max_samples=1.0,
         ).fit_stream(ArrayChunks(X, y, chunk_rows=128))
+
+
+# ---------------------------------------------------------------------
+# Tree-stream checkpoint/resume (pass-boundary snapshots)
+# ---------------------------------------------------------------------
+
+
+from spark_bagging_tpu.utils.io import ChunkSource as _ChunkSource
+
+
+class _KillAfterScans(_ChunkSource):
+    """ChunkSource wrapper that raises after N full scans — simulates a
+    crash mid-pass for the multi-pass tree engine."""
+
+    def __init__(self, inner, n_scans):
+        self._inner = inner
+        self._n = n_scans
+        self._scans = 0
+        self.n_features = inner.n_features
+        self.n_rows = inner.n_rows
+        self.chunk_rows = inner.chunk_rows
+
+    @property
+    def n_chunks(self):
+        return self._inner.n_chunks
+
+    def chunks(self):
+        self._scans += 1
+        if self._scans > self._n:
+            raise RuntimeError("simulated crash")
+        yield from self._inner.chunks()
+
+
+def test_tree_stream_checkpoint_resume(cancer, tmp_path):
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+
+    X, y = cancer
+    ckpt = str(tmp_path / "tree_ckpt")
+    # classes passed explicitly: the discovery pre-scan would otherwise
+    # consume one _KillAfterScans scan and shift the crash point
+    mk = lambda: BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=8, seed=0,
+    )
+    # uninterrupted reference
+    ref = mk().fit_stream(ArrayChunks(X, y, chunk_rows=128), classes=[0, 1])
+
+    # crash during the level-2 pass: edge + level-0 + level-1 scans
+    # completed, so resume must restore TWO levels of splits
+    killer = _KillAfterScans(ArrayChunks(X, y, chunk_rows=128), 3)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        mk().fit_stream(killer, checkpoint_dir=ckpt, classes=[0, 1])
+
+    # resume replays only the in-flight pass onward; result identical
+    import json
+
+    with open(f"{ckpt}/meta.json") as f:
+        assert json.load(f)["next_pass"] == 3  # two levels snapshotted
+    resumed = mk().fit_stream(
+        ArrayChunks(X, y, chunk_rows=128), resume_from=ckpt, classes=[0, 1]
+    )
+    np.testing.assert_allclose(
+        resumed.predict_proba(X), ref.predict_proba(X), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_tree_stream_resume_rejects_config_change(cancer, tmp_path):
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+
+    X, y = cancer
+    ckpt = str(tmp_path / "tree_ckpt2")
+    BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+        n_estimators=4, seed=0,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=256), checkpoint_dir=ckpt)
+    with pytest.raises(ValueError, match="different fit configuration"):
+        BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+            n_estimators=4, seed=1,  # different seed
+        ).fit_stream(ArrayChunks(X, y, chunk_rows=256), resume_from=ckpt)
