@@ -39,7 +39,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from spark_bagging_tpu.parallel.multihost import to_host
+from spark_bagging_tpu.parallel.multihost import global_put, to_host
 
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.ops.bootstrap import (
@@ -63,9 +63,9 @@ def _shard_ensemble(tree: Any, mesh) -> Any:
     def put(leaf):
         leaf = jnp.asarray(leaf)
         if leaf.ndim == 0:
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
+            return global_put(leaf, mesh, P())
         spec = P(REPLICA_AXIS, *([None] * (leaf.ndim - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return global_put(leaf, mesh, spec)
     return jax.tree.map(put, tree)
 
 
@@ -333,11 +333,15 @@ def fit_ensemble_stream(
         for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
             if epoch == start_epoch and c < start_chunk:
                 continue  # replay: already consumed before the snapshot
-            Xd = jnp.asarray(Xc, jnp.float32)
-            yd = jnp.asarray(yc, y_dtype)
             if x_sharding is not None:
-                Xd = jax.device_put(Xd, x_sharding)
-                yd = jax.device_put(yd, y_sharding)
+                # host chunk → ONE global placement (multihost-safe:
+                # every process streams the same chunks, each transfers
+                # only its shards — the broadcast-data design [B:5])
+                Xd = jax.device_put(np.asarray(Xc, np.float32), x_sharding)
+                yd = jax.device_put(np.asarray(yc, y_dtype), y_sharding)
+            else:
+                Xd = jnp.asarray(Xc, jnp.float32)
+                yd = jnp.asarray(yc, y_dtype)
             params, opt_state, losses = chunk_step(
                 params, opt_state, Xd, yd,
                 jnp.asarray(n_valid, jnp.int32),

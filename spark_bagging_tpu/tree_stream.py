@@ -38,13 +38,20 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_bagging_tpu.models.tree import _TreeBase, _quantile_edges
 from spark_bagging_tpu.ops.bootstrap import (
     bootstrap_weights_one,
     feature_subspaces,
 )
-from spark_bagging_tpu.streaming import _CHUNK_STREAM, learner_fingerprint
+from spark_bagging_tpu.streaming import (
+    _CHUNK_STREAM,
+    _load_stream_checkpoint,
+    check_resume_config,
+    learner_fingerprint,
+    save_snapshot,
+)
 from spark_bagging_tpu.utils.io import ChunkSource
 
 
@@ -94,8 +101,6 @@ def fit_tree_ensemble_stream(
     t0 = time.perf_counter()
     first_step_seconds = None
 
-    import numpy as np
-
     # Pass cursor: 0 = edge pass, 1..d = level passes, d+1 = leaf pass.
     config = {
         "key": np.asarray(jax.random.key_data(key)).tolist(),
@@ -113,11 +118,6 @@ def fit_tree_ensemble_stream(
     edges = None
     resumed_state: dict | None = None
     if resume_from is not None:
-        from spark_bagging_tpu.streaming import (
-            _load_stream_checkpoint,
-            check_resume_config,
-        )
-
         meta, tree_state = _load_stream_checkpoint(resume_from)
         check_resume_config(meta, config, resume_from)
         start_pass = meta["next_pass"]
@@ -128,8 +128,6 @@ def fit_tree_ensemble_stream(
     def _snapshot(next_pass, feats_lvls, thrs_lvls, curve):
         if checkpoint_dir is None:
             return
-        from spark_bagging_tpu.streaming import save_snapshot
-
         tree_state = {
             "edges": np.asarray(edges),
             "feats": [np.asarray(f) for f in feats_lvls],

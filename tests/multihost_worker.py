@@ -53,6 +53,14 @@ def main() -> None:
     ).fit(X, y)
     proba = clf.predict_proba(X)
 
+    # streamed fit over the same global mesh: every process streams the
+    # same chunks; global_put ships only the local shards [B:11]
+    from spark_bagging_tpu import ArrayChunks
+
+    sclf = BaggingClassifier(n_estimators=8, seed=1, mesh=mesh)
+    sclf.fit_stream(ArrayChunks(X, y, chunk_rows=128), n_epochs=8, lr=0.05)
+    stream_acc = float(sclf.score(X, y))
+
     with open(f"{out_path}.{pid}", "w") as f:
         json.dump({
             "process_id": pid,
@@ -61,6 +69,7 @@ def main() -> None:
             "oob_score": float(clf.oob_score_),
             "proba_head": np.asarray(proba[:16]).tolist(),
             "losses_mean": float(np.mean(clf.fit_report_["loss_mean"])),
+            "stream_accuracy": stream_acc,
         }, f)
 
 

@@ -103,3 +103,13 @@ def test_matches_single_process_mesh(worker_results):
     np.testing.assert_allclose(
         clf.predict_proba(X)[:16], r0["proba_head"], rtol=1e-3, atol=1e-4
     )
+
+
+def test_multihost_stream_fit(worker_results):
+    """fit_stream over the 2-process mesh: chunks global_put per shard,
+    the pjit step's collectives ride the (Gloo) interconnect."""
+    r0, r1 = worker_results
+    assert r0["stream_accuracy"] == pytest.approx(
+        r1["stream_accuracy"], abs=1e-9
+    )
+    assert r0["stream_accuracy"] > 0.9
