@@ -197,6 +197,15 @@ def main() -> None:
     acc = clf.score(X[:100_000], y[:100_000])
     parity = bool(acc >= baseline["accuracy"] - args.parity_tol)
 
+    # Inference hot path [SURVEY §3.2]: the batched 1000-replica
+    # forward + soft-vote reduction, timed steady-state (one warm-up
+    # call compiles + pages in the row block).
+    n_pred = min(100_000, args.n_rows)
+    clf.predict_proba(X[:n_pred])
+    t0 = time.perf_counter()
+    clf.predict_proba(X[:n_pred])
+    predict_rows_per_sec = n_pred / (time.perf_counter() - t0)
+
     fps = report["fits_per_sec"]
     result = {
         "metric": metric,
@@ -213,6 +222,7 @@ def main() -> None:
         "compile_seconds": round(report["compile_seconds"], 2),
         "h2d_seconds": round(report["h2d_seconds"], 3),
         "fits_per_sec_e2e": round(report["fits_per_sec_e2e"], 2),
+        "predict_rows_per_sec": round(predict_rows_per_sec, 0),
     }
     if report.get("mfu") is not None:
         result["achieved_tflops"] = round(report["achieved_tflops"], 1)

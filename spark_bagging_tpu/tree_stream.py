@@ -40,11 +40,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import PartitionSpec as P
+
 from spark_bagging_tpu.models.tree import _TreeBase, _quantile_edges
 from spark_bagging_tpu.ops.bootstrap import (
     bootstrap_weights_one,
     feature_subspaces,
 )
+from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
+from spark_bagging_tpu.parallel.multihost import global_put, to_host
 from spark_bagging_tpu.streaming import (
     _CHUNK_STREAM,
     _load_stream_checkpoint,
@@ -82,13 +86,22 @@ def fit_tree_ensemble_stream(
     re-runs only the in-flight one, reproducing the uninterrupted fit
     exactly (chunk-keyed weight draws are visit-order independent).
     """
-    if mesh is not None:
-        raise NotImplementedError(
-            "streamed tree fits run single-device for now; drop mesh= or "
-            "use the in-memory fit for sharded trees"
-        )
     n_features = source.n_features
     chunk_rows = source.chunk_rows
+    data_size = replica_size = 1
+    if mesh is not None:
+        data_size = mesh.shape.get(DATA_AXIS, 1)
+        replica_size = mesh.shape.get(REPLICA_AXIS, 1)
+        if n_replicas % replica_size != 0:
+            raise ValueError(
+                f"n_replicas={n_replicas} not divisible by replica mesh "
+                f"axis {replica_size}"
+            )
+        if chunk_rows % data_size != 0:
+            raise ValueError(
+                f"chunk_rows={chunk_rows} not divisible by data mesh "
+                f"axis {data_size}"
+            )
     if n_subspace is None:
         n_subspace = n_features
     identity = n_subspace == n_features and not bootstrap_features
@@ -112,6 +125,10 @@ def fit_tree_ensemble_stream(
         "bootstrap_features": bootstrap_features,
         "chunk_rows": chunk_rows,
         "n_features": n_features,
+        # the weight stream folds the data-shard index, so a resumed
+        # run must use the same data-axis size or its remaining passes
+        # would draw different bootstrap weights than the snapshot's
+        "data_size": data_size,
         "learner": learner_fingerprint(learner),
     }
     start_pass = 0
@@ -129,10 +146,11 @@ def fit_tree_ensemble_stream(
         if checkpoint_dir is None:
             return
         tree_state = {
-            "edges": np.asarray(edges),
-            "feats": [np.asarray(f) for f in feats_lvls],
-            "thrs": [np.asarray(t) for t in thrs_lvls],
-            "curve": [np.asarray(c) for c in curve],
+            # to_host: split arrays are P(replica)-sharded on a mesh
+            "edges": to_host(edges),
+            "feats": [to_host(f) for f in feats_lvls],
+            "thrs": [to_host(t) for t in thrs_lvls],
+            "curve": [to_host(c) for c in curve],
         }
         save_snapshot(
             checkpoint_dir, tree_state,
@@ -176,23 +194,78 @@ def fit_tree_ensemble_stream(
         jnp.int32 if learner.task == "classification" else jnp.float32
     )
 
-    def replica_inputs(rid, idx, X, chunk_key, valid):
+    sharded_data = mesh is not None and data_size > 1
+
+    def local_ctx(chunk_uid, n_valid, rows):
+        """(validity mask, weight key) for this shard's block of the
+        chunk. Data-sharded: shard i holds rows [i·rows, (i+1)·rows) and
+        folds its axis index into the draw key — the same independent
+        per-shard stream the in-memory data-sharded fit uses."""
+        chunk_key = jax.random.fold_in(row_key, chunk_uid)
+        off = 0
+        if sharded_data:
+            i = jax.lax.axis_index(DATA_AXIS)
+            chunk_key = jax.random.fold_in(chunk_key, i)
+            off = i * rows
+        valid = ((off + jnp.arange(rows)) < n_valid).astype(jnp.float32)
+        return valid, chunk_key
+
+    def replica_inputs(rid, idx, X, e, chunk_key, valid):
         w = bootstrap_weights_one(
-            chunk_key, rid, chunk_rows,
+            chunk_key, rid, X.shape[0],
             ratio=sample_ratio, replacement=bootstrap,
         ) * valid
         Xs = X if identity else X[:, idx]
-        e_r = edges if identity else edges[idx]
+        e_r = e if identity else e[idx]
         return w, Xs, e_r
 
     def route_partial(feats_lvls, thrs_lvls, Xs):
-        rel = jnp.zeros((chunk_rows,), jnp.int32)
+        rel = jnp.zeros((Xs.shape[0],), jnp.int32)
         for f_lvl, t_lvl in zip(feats_lvls, thrs_lvls):
             f_row = f_lvl[rel]
             t_row = t_lvl[rel]
             x_sel = jnp.take_along_axis(Xs, f_row[:, None], axis=1)[:, 0]
             rel = rel * 2 + (x_sel > t_row).astype(jnp.int32)
         return rel
+
+    def _wrap_step(body):
+        """jit the per-chunk accumulation; on a mesh, shard_map it with
+        rows over ``data`` (per-shard hists ``psum`` back — the
+        treeAggregate analog) and replicas over ``replica``."""
+        if mesh is None:
+            return jax.jit(body)
+        r = P(REPLICA_AXIS)
+        return jax.jit(jax.shard_map(
+            body,
+            mesh=mesh,
+            #       acc fls tls  X                    y             e
+            in_specs=(r, r, r, P(DATA_AXIS, None), P(DATA_AXIS), P(),
+                      P(), P(), r, r),  # n_valid, chunk_uid, ids, subs
+            out_specs=r,
+            check_vma=False,
+        ))
+
+    def _accumulate(step_fn, acc, stats_src):
+        """Run one pass over the stream, folding chunks into ``acc``."""
+        nonlocal first_step_seconds
+        for c, (Xc, yc, n_valid) in enumerate(stats_src.chunks()):
+            if mesh is not None:
+                Xd = global_put(
+                    np.asarray(Xc, np.float32), mesh, P(DATA_AXIS, None)
+                )
+                yd = global_put(np.asarray(yc, y_dtype), mesh, P(DATA_AXIS))
+            else:
+                Xd = jnp.asarray(Xc, jnp.float32)
+                yd = jnp.asarray(yc, y_dtype)
+            acc = step_fn(
+                acc, feats_lvls, thrs_lvls, Xd, yd, edges_arg,
+                jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
+                ids, subspaces,
+            )
+            if first_step_seconds is None:
+                jax.block_until_ready(acc)
+                first_step_seconds = time.perf_counter() - t0
+        return acc
 
     # -- passes 1..d: one histogram accumulation pass per level -------
     feats_lvls: tuple = ()  # per level: (R, 2^level) arrays
@@ -202,41 +275,43 @@ def fit_tree_ensemble_stream(
         feats_lvls = tuple(jnp.asarray(f) for f in resumed_state["feats"])
         thrs_lvls = tuple(jnp.asarray(tl) for tl in resumed_state["thrs"])
         curve = [jnp.asarray(c) for c in resumed_state["curve"]]
+    # Replicated global placement for the shard_map constants; plain
+    # host/device arrays single-mesh.
+    if mesh is not None:
+        edges_arg = global_put(np.asarray(edges), mesh, P())
+        subspaces = global_put(np.asarray(subspaces), mesh, P(REPLICA_AXIS))
+        ids = global_put(np.asarray(ids), mesh, P(REPLICA_AXIS))
+    else:
+        edges_arg = edges
+
     for level in range(d):
         if level + 1 < start_pass:
             continue  # this level's pass completed before the snapshot
         N = 2**level
 
-        @jax.jit
-        def level_step(hist, fls, tls, X, y, n_valid, chunk_uid,
-                       _N=N):
-            valid = (jnp.arange(chunk_rows) < n_valid).astype(jnp.float32)
-            chunk_key = jax.random.fold_in(row_key, chunk_uid)
+        def level_body(hist, fls, tls, X, y, e, n_valid, chunk_uid,
+                       ids_l, subs_l, _N=N):
+            valid, chunk_key = local_ctx(chunk_uid, n_valid, X.shape[0])
 
             def one(h, f_r, t_r, rid, idx):
-                w, Xs, e_r = replica_inputs(rid, idx, X, chunk_key, valid)
+                w, Xs, e_r = replica_inputs(
+                    rid, idx, X, e, chunk_key, valid
+                )
                 node = route_partial(f_r, t_r, Xs)
                 S = learner._row_stats(y, w, n_outputs)
                 with jax.default_matmul_precision(learner.precision):
-                    return h + learner._chunk_level_hist(
-                        Xs, S, e_r, node, _N
-                    )
+                    delta = learner._chunk_level_hist(Xs, S, e_r, node, _N)
+                if sharded_data:
+                    delta = jax.lax.psum(delta, DATA_AXIS)
+                return h + delta
 
-            return jax.vmap(one)(hist, fls, tls, ids, subspaces)
+            return jax.vmap(one)(hist, fls, tls, ids_l, subs_l)
 
         K = 3 if learner.task == "regression" else n_outputs
         hist = jnp.zeros(
             (n_replicas, n_subspace, B, N, K), jnp.float32
         )
-        for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
-            hist = level_step(
-                hist, feats_lvls, thrs_lvls,
-                jnp.asarray(Xc, jnp.float32), jnp.asarray(yc, y_dtype),
-                jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
-            )
-            if first_step_seconds is None:  # resumed past the edge pass
-                jax.block_until_ready(hist)
-                first_step_seconds = time.perf_counter() - t0
+        hist = _accumulate(_wrap_step(level_body), hist, source)
 
         @jax.jit
         def select(hist):
@@ -255,29 +330,23 @@ def fit_tree_ensemble_stream(
     # -- final pass: leaf statistics ----------------------------------
     K = 3 if learner.task == "regression" else n_outputs
 
-    @jax.jit
-    def leaf_step(acc, X, y, n_valid, chunk_uid):
-        valid = (jnp.arange(chunk_rows) < n_valid).astype(jnp.float32)
-        chunk_key = jax.random.fold_in(row_key, chunk_uid)
+    def leaf_body(acc, fls, tls, X, y, e, n_valid, chunk_uid,
+                  ids_l, subs_l):
+        valid, chunk_key = local_ctx(chunk_uid, n_valid, X.shape[0])
 
         def one(a, f_r, t_r, rid, idx):
-            w, Xs, _ = replica_inputs(rid, idx, X, chunk_key, valid)
+            w, Xs, _ = replica_inputs(rid, idx, X, e, chunk_key, valid)
             node = route_partial(f_r, t_r, Xs)
             S = learner._row_stats(y, w, n_outputs)
-            return a + learner._leaf_stats(node, S, None)
+            delta = learner._leaf_stats(node, S, None)
+            if sharded_data:
+                delta = jax.lax.psum(delta, DATA_AXIS)
+            return a + delta
 
-        return jax.vmap(one)(acc, feats_lvls, thrs_lvls, ids, subspaces)
+        return jax.vmap(one)(acc, fls, tls, ids_l, subs_l)
 
     leaf_acc = jnp.zeros((n_replicas, 2**d, K), jnp.float32)
-    for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
-        leaf_acc = leaf_step(
-            leaf_acc,
-            jnp.asarray(Xc, jnp.float32), jnp.asarray(yc, y_dtype),
-            jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
-        )
-        if first_step_seconds is None:  # resumed straight at leaf pass
-            jax.block_until_ready(leaf_acc)
-            first_step_seconds = time.perf_counter() - t0
+    leaf_acc = _accumulate(_wrap_step(leaf_body), leaf_acc, source)
 
     @jax.jit
     def finalize(leaf_acc, curve_stack):

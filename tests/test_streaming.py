@@ -636,3 +636,69 @@ def test_tree_stream_resume_rejects_config_change(cancer, tmp_path):
             base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
             n_estimators=4, seed=1,  # different seed
         ).fit_stream(ArrayChunks(X, y, chunk_rows=256), resume_from=ckpt)
+
+
+# ---------------------------------------------------------------------
+# Data-parallel streamed trees (shard_map level passes)
+# ---------------------------------------------------------------------
+
+
+def test_tree_stream_replica_mesh_matches_unsharded(cancer):
+    """Replica-only mesh: no data fold_in, so the streamed tree fit is
+    numerically identical to the unsharded stream fit."""
+    X, y = cancer
+    mk = lambda mesh: BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=8, seed=0, mesh=mesh,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=128), classes=[0, 1])
+    ref = mk(None)
+    import jax
+
+    sharded = mk(make_mesh(data=1, replica=4, devices=jax.devices()[:4]))
+    np.testing.assert_allclose(
+        sharded.predict_proba(X), ref.predict_proba(X), rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_tree_stream_data_mesh_accuracy(cancer):
+    """Data-sharded streamed trees: per-shard draws differ (documented),
+    accuracy must match statistically; chunk_rows must divide."""
+    X, y = cancer
+    mesh = make_mesh(data=4, replica=2)
+    clf = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=8, seed=0, mesh=mesh,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=128), classes=[0, 1])
+    ref = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=8, seed=0,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=128), classes=[0, 1])
+    assert abs(clf.score(X, y) - ref.score(X, y)) < 0.04
+    with pytest.raises(ValueError, match="divisible"):
+        BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=2),
+            n_estimators=8, mesh=make_mesh(data=8),
+        ).fit_stream(ArrayChunks(X, y, chunk_rows=100), classes=[0, 1])
+
+
+def test_tree_stream_resume_rejects_mesh_change(cancer, tmp_path):
+    """The weight stream folds the data-shard index — resuming under a
+    different data-axis size must be refused."""
+    X, y = cancer
+    ckpt = str(tmp_path / "tree_ckpt3")
+    BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+        n_estimators=8, seed=0, mesh=make_mesh(data=4, replica=2),
+    ).fit_stream(
+        ArrayChunks(X, y, chunk_rows=128), classes=[0, 1],
+        checkpoint_dir=ckpt,
+    )
+    with pytest.raises(ValueError, match="different fit configuration"):
+        BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+            n_estimators=8, seed=0,  # no mesh: data_size 1 != 4
+        ).fit_stream(
+            ArrayChunks(X, y, chunk_rows=128), classes=[0, 1],
+            resume_from=ckpt,
+        )
