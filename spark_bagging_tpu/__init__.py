@@ -21,6 +21,7 @@ from spark_bagging_tpu.models import (
     BaseLearner,
     DecisionTreeClassifier,
     DecisionTreeRegressor,
+    GaussianNB,
     LinearRegression,
     LogisticRegression,
     MLPClassifier,
@@ -46,6 +47,7 @@ __all__ = [
     "LinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
+    "GaussianNB",
     "MLPClassifier",
     "MLPRegressor",
     "make_mesh",

@@ -11,6 +11,7 @@ from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
 from spark_bagging_tpu.models.mlp import MLPClassifier, MLPRegressor
+from spark_bagging_tpu.models.naive_bayes import GaussianNB
 from spark_bagging_tpu.models.tree import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -22,6 +23,7 @@ __all__ = [
     "LinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
+    "GaussianNB",
     "MLPClassifier",
     "MLPRegressor",
 ]
