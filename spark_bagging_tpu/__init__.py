@@ -28,6 +28,7 @@ from spark_bagging_tpu.models import (
     MLPRegressor,
 )
 from spark_bagging_tpu.parallel import make_mesh
+from spark_bagging_tpu.utils.arrow import ArrowChunks
 from spark_bagging_tpu.utils.checkpoint import load_model, save_model
 from spark_bagging_tpu.utils.io import (
     ArrayChunks,
@@ -55,6 +56,7 @@ __all__ = [
     "load_model",
     "ChunkSource",
     "ArrayChunks",
+    "ArrowChunks",
     "SyntheticChunks",
     "LibsvmChunks",
     "CSVChunks",

@@ -744,8 +744,8 @@ class BaggingClassifier(_BaseBagging):
             return proba[:, 1] - proba[:, 0]
         return proba
 
-    def score(self, X, y) -> float:
-        return accuracy(np.asarray(y), self.predict(X))
+    def score(self, X, y, sample_weight=None) -> float:
+        return accuracy(y, self.predict(X), sample_weight=sample_weight)
 
 
 class BaggingRegressor(_BaseBagging):
@@ -830,5 +830,5 @@ class BaggingRegressor(_BaseBagging):
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(pred)
 
-    def score(self, X, y) -> float:
-        return r2_score(np.asarray(y), self.predict(X))
+    def score(self, X, y, sample_weight=None) -> float:
+        return r2_score(y, self.predict(X), sample_weight=sample_weight)

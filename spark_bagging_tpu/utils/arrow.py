@@ -16,8 +16,11 @@ the TPU-native framework accepts it natively:
   Arrow→device_put step of the north star.
 
 pyarrow is an optional dependency — every entry point raises a clear
-ImportError when it is missing; nothing else in the package imports
-this module at import time.
+ImportError when it is missing. This module is imported at package
+import time (``ArrowChunks`` is a top-level export), so the pyarrow
+import MUST stay deferred inside ``_pyarrow()``: a module-level
+``import pyarrow`` would break ``import spark_bagging_tpu`` for every
+install without it.
 """
 
 from __future__ import annotations

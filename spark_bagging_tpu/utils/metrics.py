@@ -14,20 +14,37 @@ from typing import Any
 import numpy as np
 
 
-def accuracy(y_true: np.ndarray, y_pred: np.ndarray) -> float:
-    return float(np.mean(np.asarray(y_true) == np.asarray(y_pred)))
+def _weights(sample_weight, n: int) -> np.ndarray:
+    if sample_weight is None:
+        return np.ones((n,), np.float64)
+    w = np.asarray(sample_weight, np.float64).ravel()
+    if w.shape != (n,):
+        raise ValueError(f"sample_weight shape {w.shape} != ({n},)")
+    if w.sum() <= 0:
+        raise ValueError("sample_weight sums to zero")
+    return w
+
+
+def accuracy(y_true, y_pred, sample_weight=None) -> float:
+    y_true = np.asarray(y_true).ravel()
+    correct = (y_true == np.asarray(y_pred).ravel()).astype(np.float64)
+    w = _weights(sample_weight, len(correct))
+    return float((correct * w).sum() / w.sum())
 
 
 def rmse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
-    d = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    d = (np.asarray(y_true, np.float64).ravel()
+         - np.asarray(y_pred, np.float64).ravel())
     return float(np.sqrt(np.mean(d**2)))
 
 
-def r2_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
-    y_true = np.asarray(y_true, np.float64)
-    y_pred = np.asarray(y_pred, np.float64)
-    ss_res = float(np.sum((y_true - y_pred) ** 2))
-    ss_tot = float(np.sum((y_true - y_true.mean()) ** 2))
+def r2_score(y_true, y_pred, sample_weight=None) -> float:
+    y_true = np.asarray(y_true, np.float64).ravel()
+    y_pred = np.asarray(y_pred, np.float64).ravel()
+    w = _weights(sample_weight, len(y_true))
+    mean = (w * y_true).sum() / w.sum()
+    ss_res = float((w * (y_true - y_pred) ** 2).sum())
+    ss_tot = float((w * (y_true - mean) ** 2).sum())
     return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
 
 

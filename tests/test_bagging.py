@@ -334,3 +334,31 @@ def test_predict_log_proba_and_decision_function(breast_cancer):
     Xi = StandardScaler().fit_transform(Xi).astype(np.float32)
     clf3 = BaggingClassifier(n_estimators=4, seed=0).fit(Xi, yi)
     assert clf3.decision_function(Xi).shape == (len(yi), 3)
+
+
+def test_score_sample_weight(breast_cancer):
+    X, y = breast_cancer
+    clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    w = np.where(y == 1, 2.0, 1.0)
+    s = clf.score(X, y, sample_weight=w)
+    correct = (clf.predict(X) == y).astype(float)
+    assert s == pytest.approx((correct * w).sum() / w.sum())
+    assert clf.score(X, y) == pytest.approx(correct.mean())
+
+
+def test_regressor_score_sample_weight(diabetes):
+    X, y = diabetes
+    reg = BaggingRegressor(n_estimators=4, seed=0).fit(X, y)
+    w = np.ones(len(y))
+    assert reg.score(X, y, sample_weight=w) == pytest.approx(
+        reg.score(X, y), abs=1e-9
+    )
+
+
+def test_score_column_vector_y_and_zero_weights(breast_cancer):
+    X, y = breast_cancer
+    clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    # column-vector y must not silently broadcast to (n, n)
+    assert clf.score(X, y.reshape(-1, 1)) == pytest.approx(clf.score(X, y))
+    with pytest.raises(ValueError, match="sums to zero"):
+        clf.score(X, y, sample_weight=np.zeros(len(y)))
