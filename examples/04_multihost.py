@@ -68,9 +68,14 @@ def main() -> None:
             )
             for pid in range(2)
         ]
-        for p in procs:
-            p.wait(timeout=300)
-            assert p.returncode == 0, "worker failed"
+        try:
+            for p in procs:
+                p.wait(timeout=300)
+                assert p.returncode == 0, "worker failed"
+        finally:
+            for p in procs:  # never orphan the sibling on failure
+                if p.poll() is None:
+                    p.kill()
         for pid in range(2):
             with open(f"{out}.{pid}") as f:
                 print(json.load(f))

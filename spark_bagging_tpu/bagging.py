@@ -339,6 +339,37 @@ class _BaseBagging(ParamsMixin):
                 f"{type(self).__name__} is not fitted; call fit(X, y) first"
             )
 
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        """Mean decrease in impurity per (global) feature, normalized to
+        sum 1 — Spark ML's ``featureImportances`` analog, available when
+        the base learner is a decision tree (its fitted params carry
+        per-node split gains). Subspace-relative split features are
+        mapped back through each replica's subspace draw.
+        """
+        if not hasattr(self, "ensemble_"):
+            # AttributeError (not RuntimeError) so hasattr() probes on
+            # unfitted estimators return False, sklearn-style
+            raise AttributeError(
+                "feature_importances_ is only available after fit"
+            )
+        if not isinstance(self.ensemble_, dict) or "gain" not in self.ensemble_:
+            raise AttributeError(
+                "feature_importances_ requires a tree base learner "
+                "(fitted params carry no split gains)"
+            )
+        gains = to_host(self.ensemble_["gain"])      # (R, M)
+        feats = to_host(self.ensemble_["feature"])   # (R, M) subspace-rel
+        if self._identity_subspace:
+            global_feat = feats
+        else:
+            subs = to_host(self.subspaces_)          # (R, n_subspace)
+            global_feat = np.take_along_axis(subs, feats, axis=1)
+        imp = np.zeros((self.n_features_in_,), np.float64)
+        np.add.at(imp, global_feat.ravel(), gains.astype(np.float64).ravel())
+        total = imp.sum()
+        return imp / total if total > 0 else imp
+
     def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int,
                     sample_weight=None):
         if self.n_estimators < 1:

@@ -201,12 +201,13 @@ class _TreeBase(BaseLearner):
         bf = (best // B).astype(jnp.int32)
         bb = (best % B).astype(jnp.int32)
         thr = edges[bf, bb]
-        s = jnp.sum(
-            jnp.take_along_axis(
-                score.reshape(-1, N), best[None, :], axis=0
-            )[0]
-        )
-        return bf, thr, s
+        child = jnp.take_along_axis(
+            score.reshape(-1, N), best[None, :], axis=0
+        )[0]
+        # per-node impurity decrease — the MDI numerator for
+        # ``feature_importances_`` (Spark ML featureImportances analog)
+        gain = jnp.maximum(self._impurity(total) - child, 0.0)
+        return bf, thr, jnp.sum(child), gain
 
     def _chunk_level_hist(self, Xs, S, edges, node, N):
         """Left-stats table ``(F, B, N, K)`` for one row block, with the
@@ -239,7 +240,7 @@ class _TreeBase(BaseLearner):
 
     def _grow(self, X, S, prepared, axis_name):
         """Level-synchronous growth; returns (feature, threshold,
-        leaf_index_per_row, per-level impurity curve).
+        per-node gain, leaf_index_per_row, per-level impurity curve).
 
         ``S`` is the per-row statistics matrix ``(n, K)`` whose left/
         right sums drive the impurity: weighted one-hot classes for
@@ -257,7 +258,7 @@ class _TreeBase(BaseLearner):
         Sh = S.astype(hdt)
 
         node = jnp.zeros((n,), jnp.int32)  # level-relative node index
-        feats, thrs, curve = [], [], []
+        feats, thrs, curve, gains = [], [], [], []
         with jax.default_matmul_precision(self.precision):
             for level in range(d):
                 N = 2**level
@@ -288,10 +289,11 @@ class _TreeBase(BaseLearner):
                         ),
                         axis_name,
                     ).reshape(F, B, N, K)
-                bf, thr, score_sum = self._select_splits(hist, edges)
+                bf, thr, score_sum, gain = self._select_splits(hist, edges)
                 feats.append(bf)
                 thrs.append(thr)
                 curve.append(score_sum)
+                gains.append(gain)
                 f_row = bf[node]
                 t_row = thr[node]
                 x_sel = jnp.take_along_axis(X, f_row[:, None], axis=1)[:, 0]
@@ -299,6 +301,7 @@ class _TreeBase(BaseLearner):
         return (
             jnp.concatenate(feats),
             jnp.concatenate(thrs),
+            jnp.concatenate(gains),
             node,
             jnp.stack(curve),
         )
@@ -366,6 +369,7 @@ class DecisionTreeClassifier(_TreeBase):
         return {
             "feature": jnp.zeros((M,), jnp.int32),
             "threshold": jnp.zeros((M,), jnp.float32),
+            "gain": jnp.zeros((M,), jnp.float32),
             "leaf_logp": jnp.zeros((L, n_outputs), jnp.float32),
         }
 
@@ -379,7 +383,7 @@ class DecisionTreeClassifier(_TreeBase):
         """Per-row split statistics: weighted one-hot class counts."""
         return w[:, None] * jax.nn.one_hot(y, n_outputs, dtype=jnp.float32)
 
-    def _finalize_leaves(self, feature, threshold, counts, curve):
+    def _finalize_leaves(self, feature, threshold, gain, counts, curve):
         """Leaf log-probabilities + report from leaf class counts —
         shared by the in-memory fit and the streaming fit."""
         C = counts.shape[1]
@@ -392,6 +396,7 @@ class DecisionTreeClassifier(_TreeBase):
         new = {
             "feature": feature,
             "threshold": threshold,
+            "gain": gain.astype(jnp.float32),
             "leaf_logp": logp.astype(jnp.float32),
         }
         return new, {
@@ -406,11 +411,11 @@ class DecisionTreeClassifier(_TreeBase):
             prepared = self.prepare(X, axis_name=axis_name)
         C = params["leaf_logp"].shape[1]
         S = self._row_stats(y, sample_weight.astype(jnp.float32), C)
-        feature, threshold, node, curve = self._grow(
+        feature, threshold, gain, node, curve = self._grow(
             X, S, prepared, axis_name
         )
         counts = self._leaf_stats(node, S, axis_name)  # (L, C)
-        return self._finalize_leaves(feature, threshold, counts, curve)
+        return self._finalize_leaves(feature, threshold, gain, counts, curve)
 
     def predict_scores(self, params, X):
         return params["leaf_logp"][self._route(params, X)]
@@ -431,6 +436,7 @@ class DecisionTreeRegressor(_TreeBase):
         return {
             "feature": jnp.zeros((M,), jnp.int32),
             "threshold": jnp.zeros((M,), jnp.float32),
+            "gain": jnp.zeros((M,), jnp.float32),
             "leaf_value": jnp.zeros((L,), jnp.float32),
         }
 
@@ -446,7 +452,7 @@ class DecisionTreeRegressor(_TreeBase):
         yf = y.astype(jnp.float32)
         return jnp.stack([w, w * yf, w * yf**2], axis=1)
 
-    def _finalize_leaves(self, feature, threshold, m, curve):
+    def _finalize_leaves(self, feature, threshold, gain, m, curve):
         """Leaf means + report from leaf moment sums ``(L, 3)`` —
         shared by the in-memory fit and the streaming fit."""
         w_tot = jnp.maximum(m[:, 0].sum(), _EPS)
@@ -458,6 +464,7 @@ class DecisionTreeRegressor(_TreeBase):
         new = {
             "feature": feature,
             "threshold": threshold,
+            "gain": gain.astype(jnp.float32),
             "leaf_value": value.astype(jnp.float32),
         }
         return new, {"loss": sse / w_tot, "loss_curve": curve / w_tot}
@@ -468,11 +475,11 @@ class DecisionTreeRegressor(_TreeBase):
         if prepared is None:
             prepared = self.prepare(X, axis_name=axis_name)
         S = self._row_stats(y, sample_weight.astype(jnp.float32), 1)
-        feature, threshold, node, curve = self._grow(
+        feature, threshold, gain, node, curve = self._grow(
             X, S, prepared, axis_name
         )
         m = self._leaf_stats(node, S, axis_name)  # (L, 3)
-        return self._finalize_leaves(feature, threshold, m, curve)
+        return self._finalize_leaves(feature, threshold, gain, m, curve)
 
     def predict_scores(self, params, X):
         return params["leaf_value"][self._route(params, X)]

@@ -142,7 +142,7 @@ def fit_tree_ensemble_stream(
         if "edges" in tree_state:
             edges = jnp.asarray(tree_state["edges"])
 
-    def _snapshot(next_pass, feats_lvls, thrs_lvls, curve):
+    def _snapshot(next_pass, feats_lvls, thrs_lvls, gains_lvls, curve):
         if checkpoint_dir is None:
             return
         tree_state = {
@@ -150,6 +150,7 @@ def fit_tree_ensemble_stream(
             "edges": to_host(edges),
             "feats": [to_host(f) for f in feats_lvls],
             "thrs": [to_host(t) for t in thrs_lvls],
+            "gains": [to_host(g) for g in gains_lvls],
             "curve": [to_host(c) for c in curve],
         }
         save_snapshot(
@@ -186,7 +187,7 @@ def fit_tree_ensemble_stream(
             [interior, jnp.full((n_features, 1), jnp.inf, jnp.float32)],
             axis=1,
         )
-        _snapshot(1, (), (), [])
+        _snapshot(1, (), (), (), [])
     else:
         n_chunks = source.n_chunks  # edge pass already done (snapshot)
 
@@ -270,10 +271,18 @@ def fit_tree_ensemble_stream(
     # -- passes 1..d: one histogram accumulation pass per level -------
     feats_lvls: tuple = ()  # per level: (R, 2^level) arrays
     thrs_lvls: tuple = ()
+    gains_lvls: tuple = ()
     curve = []
     if resumed_state is not None and start_pass >= 1:
+        if "gains" not in resumed_state:
+            raise ValueError(
+                "tree-stream snapshot predates split-gain tracking "
+                "(no 'gains' key) — re-run the fit to produce a "
+                "current-format checkpoint"
+            )
         feats_lvls = tuple(jnp.asarray(f) for f in resumed_state["feats"])
         thrs_lvls = tuple(jnp.asarray(tl) for tl in resumed_state["thrs"])
+        gains_lvls = tuple(jnp.asarray(g) for g in resumed_state["gains"])
         curve = [jnp.asarray(c) for c in resumed_state["curve"]]
     # Replicated global placement for the shard_map constants; plain
     # host/device arrays single-mesh.
@@ -321,11 +330,12 @@ def fit_tree_ensemble_stream(
 
             return jax.vmap(one)(hist, subspaces)
 
-        bf, thr, score = select(hist)
+        bf, thr, score, gain = select(hist)
         feats_lvls = feats_lvls + (bf,)
         thrs_lvls = thrs_lvls + (thr,)
+        gains_lvls = gains_lvls + (gain,)
         curve.append(score)
-        _snapshot(level + 2, feats_lvls, thrs_lvls, curve)
+        _snapshot(level + 2, feats_lvls, thrs_lvls, gains_lvls, curve)
 
     # -- final pass: leaf statistics ----------------------------------
     K = 3 if learner.task == "regression" else n_outputs
@@ -350,13 +360,14 @@ def fit_tree_ensemble_stream(
 
     @jax.jit
     def finalize(leaf_acc, curve_stack):
-        def one(f_r, t_r, leaf, cv):
+        def one(f_r, t_r, g_r, leaf, cv):
             return learner._finalize_leaves(
-                jnp.concatenate(f_r), jnp.concatenate(t_r), leaf, cv
+                jnp.concatenate(f_r), jnp.concatenate(t_r),
+                jnp.concatenate(g_r), leaf, cv,
             )
 
         return jax.vmap(one)(
-            feats_lvls, thrs_lvls, leaf_acc, curve_stack
+            feats_lvls, thrs_lvls, gains_lvls, leaf_acc, curve_stack
         )
 
     params, aux_tree = finalize(leaf_acc, jnp.stack(curve, axis=1))

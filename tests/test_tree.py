@@ -5,6 +5,7 @@ sklearn parity, vmap-ability, ensemble integration [SURVEY §4, §7 hard-parts
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
 from sklearn.preprocessing import StandardScaler
 from sklearn.tree import DecisionTreeClassifier as SkTreeClf
@@ -275,3 +276,68 @@ class TestTreeBagging:
         )
         clf.fit(X, y)
         assert clf.score(X, y) > 0.9
+
+
+# ---------------------------------------------------------------------
+# feature_importances_ (Spark ML featureImportances analog)
+# ---------------------------------------------------------------------
+
+
+def test_feature_importances_find_informative_features():
+    from spark_bagging_tpu import BaggingClassifier
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    y = rng.integers(0, 2, n)
+    X = rng.standard_normal((n, 10)).astype(np.float32)
+    X[:, 3] += 2.5 * y  # only features 3 and 7 carry signal
+    X[:, 7] -= 2.0 * y
+    clf = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=16, seed=0,
+    ).fit(X, y)
+    imp = clf.feature_importances_
+    assert imp.shape == (10,)
+    assert imp.sum() == pytest.approx(1.0)
+    assert (imp >= 0).all()
+    assert imp[3] + imp[7] > 0.8  # informative features dominate
+    # with feature subspaces: global mapping must still hold
+    sub = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3, n_bins=16),
+        n_estimators=32, max_features=0.5, seed=0,
+    ).fit(X, y)
+    imp_s = sub.feature_importances_
+    assert imp_s.sum() == pytest.approx(1.0)
+    assert imp_s[3] + imp_s[7] > 0.6
+
+
+def test_feature_importances_regressor_and_stream():
+    from spark_bagging_tpu import ArrayChunks, BaggingRegressor
+    from spark_bagging_tpu.models import DecisionTreeRegressor
+
+    rng = np.random.default_rng(1)
+    n = 1500
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = (3.0 * X[:, 2] + rng.standard_normal(n) * 0.1).astype(np.float32)
+    reg = BaggingRegressor(
+        base_learner=DecisionTreeRegressor(max_depth=3, n_bins=16),
+        n_estimators=8, seed=0,
+    ).fit(X, y)
+    imp = reg.feature_importances_
+    assert imp.argmax() == 2 and imp[2] > 0.8
+    # streamed tree fit carries gains identically
+    sreg = BaggingRegressor(
+        base_learner=DecisionTreeRegressor(max_depth=3, n_bins=16),
+        n_estimators=8, seed=0,
+    ).fit_stream(ArrayChunks(X, y, chunk_rows=512))
+    assert sreg.feature_importances_.argmax() == 2
+
+
+def test_feature_importances_requires_tree():
+    from spark_bagging_tpu import BaggingClassifier
+
+    _, _, X, y = _breast_cancer()
+    clf = BaggingClassifier(n_estimators=2, seed=0).fit(X, y)
+    with pytest.raises(AttributeError, match="tree base learner"):
+        _ = clf.feature_importances_
