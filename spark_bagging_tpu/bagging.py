@@ -95,7 +95,8 @@ def _jitted_fit(learner, n_outputs, sample_ratio, bootstrap, n_subspace,
 
 @functools.lru_cache(maxsize=256)
 def _jitted_sharded_fit(learner, mesh, n_outputs, sample_ratio, bootstrap,
-                        n_subspace, bootstrap_features, chunk_size, n_replicas):
+                        n_subspace, bootstrap_features, chunk_size,
+                        n_replicas, id_offset=0):
     return jax.jit(
         lambda X, y, mask, key: sharded_fit(
             learner, mesh, X, y, mask, key, n_replicas, n_outputs,
@@ -104,6 +105,7 @@ def _jitted_sharded_fit(learner, mesh, n_outputs, sample_ratio, bootstrap,
             n_subspace=n_subspace,
             bootstrap_features=bootstrap_features,
             chunk_size=chunk_size,
+            id_offset=id_offset,
         )
     )
 
@@ -231,6 +233,7 @@ class _BaseBagging(ParamsMixin):
         seed: int = 0,
         chunk_size: int | None = None,
         mesh=None,
+        warm_start: bool = False,
     ):
         self.base_learner = base_learner
         self.n_estimators = n_estimators
@@ -242,6 +245,7 @@ class _BaseBagging(ParamsMixin):
         self.seed = seed
         self.chunk_size = chunk_size
         self.mesh = mesh
+        self.warm_start = warm_start
 
     # -- sklearn ecosystem interop -------------------------------------
 
@@ -370,8 +374,61 @@ class _BaseBagging(ParamsMixin):
         total = imp.sum()
         return imp / total if total > 0 else imp
 
+    def _warm_start_from(self, X, learner) -> int:
+        """Validate a warm start and return the first NEW replica id.
+
+        Replica streams are keyed by (seed, id), so fitting ids
+        [R_old, R_new) and concatenating reproduces EXACTLY the cold
+        fit of the larger ensemble — provided nothing that shapes the
+        streams changed; everything that did not freeze at the first
+        fit is validated here.
+        """
+        if self.n_estimators < self.n_estimators_:
+            raise ValueError(
+                f"warm_start cannot shrink the ensemble "
+                f"({self.n_estimators_} -> {self.n_estimators})"
+            )
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"warm_start X has {X.shape[1]} features; fitted on "
+                f"{self.n_features_in_}"
+            )
+        if learner != self._fitted_learner:
+            raise ValueError(
+                "warm_start requires the same base learner "
+                "hyperparameters as the original fit"
+            )
+        if not np.array_equal(
+            np.asarray(jax.random.key_data(jax.random.key(self.seed))),
+            np.asarray(jax.random.key_data(self._fit_key)),
+        ):
+            raise ValueError(
+                "warm_start requires the original seed: old replicas "
+                "drew from it, and OOB replays every replica's stream "
+                "from one key"
+            )
+        if (float(self.max_samples), bool(self.bootstrap)) != self._fit_sampling:
+            raise ValueError(
+                "warm_start requires unchanged max_samples/bootstrap"
+            )
+        if getattr(self, "_fit_subspace_cfg", None) is None:
+            raise ValueError(
+                "warm_start requires an in-session in-memory fit to "
+                "extend (stream-fitted or checkpoint-loaded ensembles "
+                "use different replica streams)"
+            )
+        if (
+            self._n_subspace(X.shape[1]),
+            bool(self.bootstrap_features),
+        ) != self._fit_subspace_cfg:
+            raise ValueError(
+                "warm_start requires unchanged max_features/"
+                "bootstrap_features"
+            )
+        return self.n_estimators_
+
     def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int,
-                    sample_weight=None):
+                    sample_weight=None, id_start: int = 0):
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
         if self.oob_score and not self.bootstrap and self.max_samples >= 1.0:
@@ -396,7 +453,8 @@ class _BaseBagging(ParamsMixin):
         learner = self._learner()
         n_subspace = self._n_subspace(X.shape[1])
         key = jax.random.key(self.seed)
-        ids = jnp.arange(self.n_estimators, dtype=jnp.int32)
+        n_new = self.n_estimators - id_start
+        ids = jnp.arange(id_start, self.n_estimators, dtype=jnp.int32)
         if self.mesh is not None:
             data_size = self.mesh.shape.get(DATA_AXIS, 1)
             Xp, yp, mask = pad_rows(X, y, data_size)
@@ -420,7 +478,7 @@ class _BaseBagging(ParamsMixin):
                 learner, self.mesh, n_outputs, float(self.max_samples),
                 bool(self.bootstrap), n_subspace,
                 bool(self.bootstrap_features), self.chunk_size,
-                self.n_estimators,
+                n_new, id_start,
             )
             t0 = time.perf_counter()
             with log_timing("sharded ensemble compile", logging.DEBUG):
@@ -455,6 +513,31 @@ class _BaseBagging(ParamsMixin):
             losses_np = np.asarray(aux["loss"])  # device->host barrier
             t_fit = time.perf_counter() - t0
 
+        if id_start > 0:
+            # warm start: splice the new replicas after the old ones
+            # (host-side concat, then re-placed with the mesh sharding)
+            def _cat(old_leaf, new_leaf):
+                return np.concatenate(
+                    [to_host(old_leaf), to_host(new_leaf)], axis=0
+                )
+
+            params = jax.tree.map(_cat, self.ensemble_, params)
+            subspaces = _cat(self.subspaces_, subspaces)
+            if self.mesh is not None:
+                rspec = lambda a: P(  # noqa: E731
+                    REPLICA_AXIS, *([None] * (np.ndim(a) - 1))
+                )
+                params = jax.tree.map(
+                    lambda a: global_put(a, self.mesh, rspec(a)), params
+                )
+                subspaces = global_put(
+                    subspaces, self.mesh, rspec(subspaces)
+                )
+            else:
+                # back to device arrays, or every later predict/OOB
+                # call would re-upload the whole stacked ensemble
+                params = jax.tree.map(jnp.asarray, params)
+                subspaces = jnp.asarray(subspaces)
         self.ensemble_ = params
         self.subspaces_ = subspaces
         self.n_features_in_ = int(X.shape[1])
@@ -464,11 +547,12 @@ class _BaseBagging(ParamsMixin):
         self._fit_key = key
         self._fitted_learner = learner
         self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        self._fit_subspace_cfg = (n_subspace, bool(self.bootstrap_features))
         self._identity_subspace = (
             n_subspace == X.shape[1] and not self.bootstrap_features
         )
         self.fit_report_ = fit_report(
-            n_replicas=self.n_estimators,
+            n_replicas=n_new,
             fit_seconds=t_fit,
             losses=losses_np,
             n_rows=int(X.shape[0]),
@@ -482,6 +566,8 @@ class _BaseBagging(ParamsMixin):
                 int(X.shape[0]), n_subspace, n_outputs
             ),
         )
+        if id_start > 0:
+            self.fit_report_["warm_started_from"] = id_start
 
     def _fit_stream_engine(
         self, source, n_outputs: int, *, n_epochs: int,
@@ -557,6 +643,9 @@ class _BaseBagging(ParamsMixin):
         self._fit_key = key
         self._fitted_learner = learner
         self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        # stream fits use chunk-keyed replica streams — not extendable
+        # by the in-memory warm start (guard keys on this attribute)
+        self._fit_subspace_cfg = None
         self._identity_subspace = (
             n_subspace == source.n_features and not self.bootstrap_features
         )
@@ -639,10 +728,12 @@ class BaggingClassifier(_BaseBagging):
         seed: int = 0,
         chunk_size: int | None = None,
         mesh=None,
+        warm_start: bool = False,
     ):
         super().__init__(
             base_learner, n_estimators, max_samples, bootstrap, max_features,
             bootstrap_features, oob_score, seed, chunk_size, mesh,
+            warm_start,
         )
         self.voting = voting
 
@@ -666,13 +757,30 @@ class BaggingClassifier(_BaseBagging):
         y = np.asarray(y)
         if y.shape[0] != X.shape[0]:
             raise ValueError("X and y row counts differ")
-        self.classes_, y_enc = np.unique(y, return_inverse=True)
+        classes, y_enc = np.unique(y, return_inverse=True)
+        id_start = 0
+        if self.warm_start and hasattr(self, "ensemble_"):
+            if not np.array_equal(classes, self.classes_):
+                raise ValueError(
+                    "warm_start requires the same class set as the "
+                    "original fit"
+                )
+            id_start = self._warm_start_from(X, self._learner())
+            if id_start == self.n_estimators:
+                import warnings
+
+                warnings.warn(
+                    "warm_start fit without increasing n_estimators: "
+                    "nothing refit (OOB state unchanged)", UserWarning,
+                )
+                return self
+        self.classes_ = classes
         self.n_classes_ = int(len(self.classes_))
         if self.n_classes_ < 2:
             raise ValueError("y has a single class")
         y_enc = np.asarray(y_enc, np.int32)  # device placement is the
         self._fit_engine(X, y_enc, self.n_classes_,  # engine's job
-                         sample_weight=sample_weight)
+                         sample_weight=sample_weight, id_start=id_start)
         if self.oob_score:
             counts, votes = self._oob_scores(X, self.n_classes_)
             self._finalize_oob(counts, votes, y_enc)
@@ -810,7 +918,19 @@ class BaggingRegressor(_BaseBagging):
             raise ValueError(f"y must be 1-D, got shape {y.shape}")
         if y.shape[0] != X.shape[0]:
             raise ValueError("X and y row counts differ")
-        self._fit_engine(X, y, 1, sample_weight=sample_weight)
+        id_start = 0
+        if self.warm_start and hasattr(self, "ensemble_"):
+            id_start = self._warm_start_from(X, self._learner())
+            if id_start == self.n_estimators:
+                import warnings
+
+                warnings.warn(
+                    "warm_start fit without increasing n_estimators: "
+                    "nothing refit (OOB state unchanged)", UserWarning,
+                )
+                return self
+        self._fit_engine(X, y, 1, sample_weight=sample_weight,
+                         id_start=id_start)
         if self.oob_score:
             sums, votes = self._oob_scores(X, None)
             self._finalize_oob(sums, votes, y)

@@ -112,12 +112,15 @@ def sharded_fit(
     n_subspace: int | None = None,
     bootstrap_features: bool = False,
     chunk_size: int | None = None,
+    id_offset: int = 0,
 ) -> tuple[Any, jnp.ndarray, dict[str, jnp.ndarray]]:
     """Ensemble fit over the mesh; same contract as
     :func:`spark_bagging_tpu.ensemble.fit_ensemble`.
 
     The returned params/subspaces keep their global replica axis
-    (sharded ``P(replica)`` on device); losses likewise.
+    (sharded ``P(replica)`` on device); losses likewise. ``id_offset``
+    shifts the replica ids (warm start: ids [offset, offset+n) draw the
+    same streams a cold fit of a larger ensemble would give them).
     """
     _check_divisible(X.shape[0], n_replicas, mesh)
     data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
@@ -151,7 +154,7 @@ def sharded_fit(
         )
         return params, subspaces, aux["loss"]
 
-    ids = jnp.arange(n_replicas, dtype=jnp.int32)
+    ids = id_offset + jnp.arange(n_replicas, dtype=jnp.int32)
     params, subspaces, losses = _fit(X, y, row_mask, key, ids)
     return params, subspaces, {"loss": losses}
 

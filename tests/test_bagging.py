@@ -362,3 +362,84 @@ def test_score_column_vector_y_and_zero_weights(breast_cancer):
     assert clf.score(X, y.reshape(-1, 1)) == pytest.approx(clf.score(X, y))
     with pytest.raises(ValueError, match="sums to zero"):
         clf.score(X, y, sample_weight=np.zeros(len(y)))
+
+
+class TestWarmStart:
+    """warm_start grows a fitted ensemble; id-keyed replica streams make
+    the result EXACTLY a cold fit of the larger ensemble."""
+
+    def test_equals_cold_fit(self, breast_cancer):
+        X, y = breast_cancer
+        cold = BaggingClassifier(
+            n_estimators=16, seed=0, max_features=0.8
+        ).fit(X, y)
+        warm = BaggingClassifier(
+            n_estimators=8, seed=0, max_features=0.8, warm_start=True
+        ).fit(X, y)
+        warm.set_params(n_estimators=16).fit(X, y)
+        assert warm.n_estimators_ == 16
+        assert warm.fit_report_["warm_started_from"] == 8
+        np.testing.assert_array_equal(
+            np.asarray(warm.subspaces_), np.asarray(cold.subspaces_)
+        )
+        np.testing.assert_allclose(
+            warm.predict_proba(X), cold.predict_proba(X),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_equals_cold_fit_on_mesh(self, breast_cancer):
+        from spark_bagging_tpu.parallel import make_mesh
+
+        X, y = breast_cancer
+        mesh = make_mesh(data=2)  # (2, 4): delta must divide 4
+        cold = BaggingClassifier(n_estimators=16, seed=0, mesh=mesh).fit(X, y)
+        warm = BaggingClassifier(
+            n_estimators=8, seed=0, mesh=mesh, warm_start=True
+        ).fit(X, y)
+        warm.set_params(n_estimators=16).fit(X, y)
+        np.testing.assert_allclose(
+            warm.predict_proba(X), cold.predict_proba(X),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_regressor_and_oob(self, diabetes):
+        X, y = diabetes
+        cold = BaggingRegressor(n_estimators=12, seed=1, oob_score=True).fit(X, y)
+        warm = BaggingRegressor(
+            n_estimators=4, seed=1, oob_score=True, warm_start=True
+        ).fit(X, y)
+        warm.set_params(n_estimators=12).fit(X, y)
+        assert warm.oob_score_ == pytest.approx(cold.oob_score_, abs=1e-6)
+        np.testing.assert_allclose(
+            warm.predict(X), cold.predict(X), rtol=1e-5, atol=1e-5
+        )
+
+    def test_validation(self, breast_cancer):
+        X, y = breast_cancer
+        warm = BaggingClassifier(
+            n_estimators=4, seed=0, warm_start=True
+        ).fit(X, y)
+        with pytest.raises(ValueError, match="shrink"):
+            warm.set_params(n_estimators=2).fit(X, y)
+        warm.set_params(n_estimators=4)
+        with pytest.raises(ValueError, match="max_samples"):
+            warm.set_params(max_samples=0.5, n_estimators=8).fit(X, y)
+        warm.set_params(max_samples=1.0)
+        with pytest.raises(ValueError, match="class set"):
+            warm.set_params(n_estimators=8).fit(X, np.where(y == 0, 7, y))
+        with pytest.raises(ValueError, match="seed"):
+            warm.set_params(seed=5, n_estimators=8).fit(X, y)
+        warm.set_params(seed=0)
+        # same n_estimators: warns, ensemble unchanged
+        before = np.asarray(warm.ensemble_["W"])
+        with pytest.warns(UserWarning, match="without increasing"):
+            warm.set_params(n_estimators=4).fit(X, y)
+        np.testing.assert_array_equal(before, np.asarray(warm.ensemble_["W"]))
+
+    def test_stream_fit_not_extendable(self, breast_cancer):
+        X, y = breast_cancer
+        warm = BaggingClassifier(
+            n_estimators=4, seed=0, warm_start=True
+        ).fit_stream((X, y), chunk_rows=256)
+        with pytest.raises(ValueError, match="in-memory fit"):
+            warm.set_params(n_estimators=8).fit(X, y)
