@@ -225,7 +225,7 @@ class _BaseBagging(ParamsMixin):
         self,
         base_learner: BaseLearner | None = None,
         n_estimators: int = 10,
-        max_samples: float = 1.0,
+        max_samples: float | int = 1.0,
         bootstrap: bool = True,
         max_features: float | int = 1.0,
         bootstrap_features: bool = False,
@@ -281,6 +281,29 @@ class _BaseBagging(ParamsMixin):
                 f"{type(self).__name__} needs {self.task}"
             )
         return learner
+
+    def _sample_ratio(self, n_rows: int) -> float:
+        """Resolve ``max_samples`` to a Poisson rate: a float is the
+        rate itself; an int is an absolute expected sample count
+        (sklearn semantics), i.e. rate ``max_samples / n_rows``."""
+        import numbers
+
+        ms = self.max_samples
+        if isinstance(ms, bool) or not isinstance(ms, numbers.Real):
+            raise ValueError(f"max_samples must be int or float, got {ms!r}")
+        if isinstance(ms, numbers.Integral):
+            ms = int(ms)
+            if not 1 <= ms <= n_rows:
+                raise ValueError(
+                    f"int max_samples must be in [1, {n_rows}], got {ms}"
+                )
+            return ms / n_rows
+        ms = float(ms)
+        if not 0.0 < ms <= 1.0:
+            raise ValueError(
+                f"float max_samples must be in (0, 1], got {ms}"
+            )
+        return ms
 
     def _n_subspace(self, n_features: int) -> int:
         if isinstance(self.max_features, float):
@@ -407,9 +430,13 @@ class _BaseBagging(ParamsMixin):
                 "drew from it, and OOB replays every replica's stream "
                 "from one key"
             )
-        if (float(self.max_samples), bool(self.bootstrap)) != self._fit_sampling:
+        if (
+            self._sample_ratio(X.shape[0]), bool(self.bootstrap)
+        ) != self._fit_sampling:
             raise ValueError(
-                "warm_start requires unchanged max_samples/bootstrap"
+                "warm_start requires unchanged max_samples/bootstrap "
+                "(an int max_samples resolves against the CURRENT row "
+                "count — a different-sized X changes the rate)"
             )
         if getattr(self, "_fit_subspace_cfg", None) is None:
             raise ValueError(
@@ -431,7 +458,8 @@ class _BaseBagging(ParamsMixin):
                     sample_weight=None, id_start: int = 0):
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
-        if self.oob_score and not self.bootstrap and self.max_samples >= 1.0:
+        ratio = self._sample_ratio(int(X.shape[0]))
+        if self.oob_score and not self.bootstrap and ratio >= 1.0:
             raise ValueError(
                 "oob_score requires out-of-bag rows: use bootstrap=True or "
                 "max_samples < 1.0"
@@ -475,7 +503,7 @@ class _BaseBagging(ParamsMixin):
             jax.block_until_ready((Xp, yp, mask))
             self._h2d_seconds = time.perf_counter() - t0
             fit_fn = _jitted_sharded_fit(
-                learner, self.mesh, n_outputs, float(self.max_samples),
+                learner, self.mesh, n_outputs, ratio,
                 bool(self.bootstrap), n_subspace,
                 bool(self.bootstrap_features), self.chunk_size,
                 n_new, id_start,
@@ -495,7 +523,7 @@ class _BaseBagging(ParamsMixin):
             t_fit = time.perf_counter() - t0
         else:
             fit_fn = _jitted_fit(
-                learner, n_outputs, float(self.max_samples),
+                learner, n_outputs, ratio,
                 bool(self.bootstrap), n_subspace,
                 bool(self.bootstrap_features), self.chunk_size,
                 with_weights=sample_weight is not None,
@@ -546,7 +574,7 @@ class _BaseBagging(ParamsMixin):
         self.n_estimators_ = int(self.n_estimators)
         self._fit_key = key
         self._fitted_learner = learner
-        self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        self._fit_sampling = (ratio, bool(self.bootstrap))
         self._fit_subspace_cfg = (n_subspace, bool(self.bootstrap_features))
         self._identity_subspace = (
             n_subspace == X.shape[1] and not self.bootstrap_features
@@ -584,7 +612,8 @@ class _BaseBagging(ParamsMixin):
                 "oob_score with fit_stream is single-mesh only; drop the "
                 "mesh or compute OOB separately"
             )
-        if self.oob_score and not self.bootstrap and self.max_samples >= 1.0:
+        ratio = self._sample_ratio(int(source.n_rows))
+        if self.oob_score and not self.bootstrap and ratio >= 1.0:
             raise ValueError(
                 "oob_score requires out-of-bag rows: use bootstrap=True or "
                 "max_samples < 1.0"
@@ -612,7 +641,7 @@ class _BaseBagging(ParamsMixin):
             # (a per-chunk-step knob) does not apply.
             params, subspaces, aux = fit_tree_ensemble_stream(
                 learner, source, key, self.n_estimators, n_outputs,
-                sample_ratio=float(self.max_samples),
+                sample_ratio=ratio,
                 bootstrap=bool(self.bootstrap),
                 n_subspace=n_subspace,
                 bootstrap_features=bool(self.bootstrap_features),
@@ -624,7 +653,7 @@ class _BaseBagging(ParamsMixin):
             params, subspaces, aux = fit_ensemble_stream(
                 learner, source, key, self.n_estimators, n_outputs,
                 n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
-                sample_ratio=float(self.max_samples),
+                sample_ratio=ratio,
                 bootstrap=bool(self.bootstrap),
                 n_subspace=n_subspace,
                 bootstrap_features=bool(self.bootstrap_features),
@@ -642,7 +671,7 @@ class _BaseBagging(ParamsMixin):
         self.n_estimators_ = int(self.n_estimators)
         self._fit_key = key
         self._fitted_learner = learner
-        self._fit_sampling = (float(self.max_samples), bool(self.bootstrap))
+        self._fit_sampling = (ratio, bool(self.bootstrap))
         # stream fits use chunk-keyed replica streams — not extendable
         # by the in-memory warm start (guard keys on this attribute)
         self._fit_subspace_cfg = None
@@ -719,7 +748,7 @@ class BaggingClassifier(_BaseBagging):
         self,
         base_learner: BaseLearner | None = None,
         n_estimators: int = 10,
-        max_samples: float = 1.0,
+        max_samples: float | int = 1.0,
         bootstrap: bool = True,
         max_features: float | int = 1.0,
         bootstrap_features: bool = False,

@@ -104,9 +104,11 @@ def bootstrap_weights_one(
 
     - ``replacement=True``: Poisson(ratio) counts — the scalable form of
       the with-replacement bootstrap [B:5][P:5].
-    - ``replacement=False``: exact ``floor(ratio * n_rows)``-subset
-      without replacement (0/1 mask), mirroring the reference's
-      subsampling-without-replacement option [SURVEY §2a#2].
+    - ``replacement=False``: exact ``round(ratio * n_rows)``-subset
+      (at least 1) without replacement (0/1 mask), mirroring the
+      reference's subsampling-without-replacement option [SURVEY
+      §2a#2]. Rounding (not floor) keeps an integer ``max_samples``
+      count exact through its ratio = count/n representation.
 
     ``ratio`` maps to the reference's row-sampling ratio param
     (``max_samples`` in the sklearn vocabulary).
@@ -119,11 +121,11 @@ def bootstrap_weights_one(
             counts = jax.random.poisson(k, ratio, (n_rows,))
         return jnp.minimum(counts, _MAX_COUNT).astype(dtype)
 
-    m = int(ratio * n_rows)
+    m = max(1, int(round(ratio * n_rows)))
     if m >= n_rows:
         return jnp.ones((n_rows,), dtype)
-    if m <= 0:
-        raise ValueError(f"ratio={ratio} selects zero of {n_rows} rows")
+    if ratio <= 0:
+        raise ValueError(f"ratio={ratio} must be positive")
     u = jax.random.uniform(k, (n_rows,))
     # The m-th smallest u is the inclusion threshold; ties have
     # probability ~0 in float32 for practical n.

@@ -443,3 +443,29 @@ class TestWarmStart:
         ).fit_stream((X, y), chunk_rows=256)
         with pytest.raises(ValueError, match="in-memory fit"):
             warm.set_params(n_estimators=8).fit(X, y)
+
+
+def test_int_max_samples(breast_cancer):
+    """sklearn semantics: int max_samples = absolute expected sample
+    count, equivalent to the float ratio count/n."""
+    X, y = breast_cancer
+    n = len(y)
+    a = BaggingClassifier(n_estimators=8, max_samples=n // 2, seed=0).fit(X, y)
+    b = BaggingClassifier(
+        n_estimators=8, max_samples=(n // 2) / n, seed=0
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        a.predict_proba(X), b.predict_proba(X), rtol=1e-6, atol=1e-7
+    )
+    # subsampling without replacement leaves OOB rows even at int count
+    c = BaggingClassifier(
+        n_estimators=16, max_samples=n // 2, bootstrap=False,
+        oob_score=True, seed=0,
+    ).fit(X, y)
+    assert 0.8 < c.oob_score_ <= 1.0
+    with pytest.raises(ValueError, match="max_samples"):
+        BaggingClassifier(max_samples=n + 1).fit(X, y)
+    with pytest.raises(ValueError, match="max_samples"):
+        BaggingClassifier(max_samples=1.5).fit(X, y)
+    with pytest.raises(ValueError, match="max_samples"):
+        BaggingClassifier(max_samples=0).fit(X, y)

@@ -56,9 +56,15 @@ def test_without_replacement_full_ratio_is_all_ones():
     np.testing.assert_array_equal(np.asarray(w), np.ones((16, 50)))
 
 
-def test_without_replacement_zero_rows_raises():
-    with pytest.raises(ValueError):
+def test_without_replacement_tiny_ratio_floors_at_one_row():
+    # a positive ratio always selects >= 1 row (int max_samples=1 is
+    # valid); only non-positive ratios are rejected
+    w = np.asarray(
         bootstrap_weights(KEY, IDS, 100, ratio=0.001, replacement=False)
+    )
+    assert (w.sum(axis=1) == 1).all()
+    with pytest.raises(ValueError):
+        bootstrap_weights(KEY, IDS, 100, ratio=0.0, replacement=False)
 
 
 def test_subspace_without_replacement_unique_and_in_range():
@@ -98,3 +104,18 @@ def test_replica_keys_fold_in():
     np.testing.assert_array_equal(
         jax.random.key_data(ks[2]), jax.random.key_data(expected)
     )
+
+
+def test_subsample_count_rounds_exactly():
+    """round(ratio·n) keeps an int max_samples exact through its
+    count/n ratio representation (15/22 must select 15, not 14), and
+    tiny ratios floor at one row instead of crashing."""
+    import jax
+
+    key = jax.random.key(0)
+    from spark_bagging_tpu.ops.bootstrap import bootstrap_weights_one
+
+    w = bootstrap_weights_one(key, 0, 22, ratio=15 / 22, replacement=False)
+    assert int(np.asarray(w).sum()) == 15
+    w1 = bootstrap_weights_one(key, 0, 49, ratio=1 / 49, replacement=False)
+    assert int(np.asarray(w1).sum()) == 1
