@@ -694,6 +694,22 @@ class _BaseBagging(ParamsMixin):
         if "n_passes" in aux:
             self.fit_report_["n_passes"] = aux["n_passes"]
 
+    def _stream_chunks(self, source, chunk_rows=None):
+        """Validated chunk iterator for the streaming predict/score
+        paths (the reference's ``transform`` over a distributed
+        DataFrame [SURVEY §3.2] — here any ChunkSource / (X, y) pair;
+        labels ride along and are ignored where not needed)."""
+        from spark_bagging_tpu.utils.io import as_chunk_source
+
+        self._check_fitted()
+        source = as_chunk_source(source, chunk_rows)
+        if source.n_features != self.n_features_in_:
+            raise ValueError(
+                f"source has {source.n_features} features; the ensemble "
+                f"was fitted on {self.n_features_in_}"
+            )
+        return source
+
     def _oob_scores_stream(self, source, n_classes: int | None):
         """Streamed OOB: one extra pass regenerating each replica's
         chunk-keyed membership [VERDICT r1 #3's fit_stream carve-out].
@@ -912,6 +928,32 @@ class BaggingClassifier(_BaseBagging):
             return proba[:, 1] - proba[:, 0]
         return proba
 
+    def predict_proba_stream(self, source, chunk_rows=None) -> np.ndarray:
+        """Out-of-core ``predict_proba``: aggregate chunk by chunk —
+        only one chunk is ever resident on device."""
+        out = [
+            self.predict_proba(Xc[:n])
+            for Xc, _, n in self._stream_chunks(source, chunk_rows).chunks()
+        ]
+        if not out:
+            raise ValueError("source yielded no chunks")
+        return np.concatenate(out)
+
+    def predict_stream(self, source, chunk_rows=None) -> np.ndarray:
+        proba = self.predict_proba_stream(source, chunk_rows)
+        return self.classes_[proba.argmax(axis=1)]
+
+    def score_stream(self, source, chunk_rows=None) -> float:
+        """Out-of-core accuracy over a labeled ChunkSource."""
+        correct = total = 0
+        for Xc, yc, n in self._stream_chunks(source, chunk_rows).chunks():
+            pred = self.predict(Xc[:n])
+            correct += int((np.asarray(yc[:n]) == pred).sum())
+            total += int(n)
+        if total == 0:
+            raise ValueError("source yielded no chunks")
+        return correct / total
+
     def score(self, X, y, sample_weight=None) -> float:
         return accuracy(y, self.predict(X), sample_weight=sample_weight)
 
@@ -1009,6 +1051,38 @@ class BaggingRegressor(_BaseBagging):
             self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(pred)
+
+    def predict_stream(self, source, chunk_rows=None) -> np.ndarray:
+        """Out-of-core ``predict``: one chunk resident at a time."""
+        out = [
+            self.predict(Xc[:n])
+            for Xc, _, n in self._stream_chunks(source, chunk_rows).chunks()
+        ]
+        if not out:
+            raise ValueError("source yielded no chunks")
+        return np.concatenate(out)
+
+    def score_stream(self, source, chunk_rows=None) -> float:
+        """Out-of-core R² from one-pass accumulated moments, shifted
+        by the first chunk's target mean — raw Σy² − (Σy)²/n cancels
+        catastrophically for large-mean targets."""
+        n_tot = 0
+        shift = None
+        s_yd = s_yd2 = s_res = 0.0
+        for Xc, yc, n in self._stream_chunks(source, chunk_rows).chunks():
+            yv = np.asarray(yc[:n], np.float64)
+            pred = np.asarray(self.predict(Xc[:n]), np.float64)
+            if shift is None:
+                shift = float(yv.mean()) if n else 0.0
+            yd = yv - shift
+            n_tot += int(n)
+            s_yd += float(yd.sum())
+            s_yd2 += float((yd**2).sum())
+            s_res += float(((yv - pred) ** 2).sum())
+        if n_tot == 0:
+            raise ValueError("source yielded no chunks")
+        ss_tot = s_yd2 - s_yd**2 / n_tot
+        return 1.0 - s_res / ss_tot if ss_tot > 0 else 0.0
 
     def score(self, X, y, sample_weight=None) -> float:
         return r2_score(y, self.predict(X), sample_weight=sample_weight)

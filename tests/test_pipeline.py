@@ -89,3 +89,29 @@ def test_grid_search(cancer):
     grid.fit(X[:200], y[:200])
     assert grid.best_score_ > 0.9
     assert set(grid.best_params_) == {"n_estimators", "base_learner__l2"}
+
+
+def test_cross_val_score(cancer):
+    """sklearn cross-validation over the estimator (Pipeline-style
+    composition promise [SURVEY §3.4])."""
+    from sklearn.model_selection import cross_val_score
+
+    X, y = cancer
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    scores = cross_val_score(
+        BaggingClassifier(n_estimators=4, seed=0), X, y, cv=3
+    )
+    assert scores.shape == (3,)
+    assert scores.mean() > 0.9
+
+
+def test_calibration_and_metrics_interop(cancer):
+    """decision_function/predict_proba feed sklearn metrics directly."""
+    from sklearn.metrics import log_loss, roc_auc_score
+
+    X, y = cancer
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    clf = BaggingClassifier(n_estimators=8, seed=0).fit(X, y)
+    auc = roc_auc_score(y, clf.decision_function(X))
+    assert auc > 0.99
+    assert log_loss(y, clf.predict_proba(X)) < 0.2

@@ -702,3 +702,61 @@ def test_tree_stream_resume_rejects_mesh_change(cancer, tmp_path):
             ArrayChunks(X, y, chunk_rows=128), classes=[0, 1],
             resume_from=ckpt,
         )
+
+
+# ---------------------------------------------------------------------
+# Out-of-core prediction/scoring (the transform analog at scale)
+# ---------------------------------------------------------------------
+
+
+def test_predict_stream_matches_in_memory(cancer):
+    X, y = cancer
+    clf = BaggingClassifier(n_estimators=8, seed=0).fit(X, y)
+    src = ArrayChunks(X, y, chunk_rows=100)
+    np.testing.assert_allclose(
+        clf.predict_proba_stream(src), clf.predict_proba(X),
+        rtol=1e-6, atol=1e-7,
+    )
+    np.testing.assert_array_equal(clf.predict_stream(src), clf.predict(X))
+    assert clf.score_stream(src) == pytest.approx(clf.score(X, y))
+    with pytest.raises(ValueError, match="features"):
+        clf.predict_stream(ArrayChunks(X[:, :5], y, chunk_rows=100))
+
+
+def test_regressor_predict_stream_matches_in_memory():
+    X, y = make_regression(500, 6, seed=0)
+    mu, s = X.mean(0), X.std(0) + 1e-8
+    X = ((X - mu) / s).astype(np.float32)
+    reg = BaggingRegressor(n_estimators=8, seed=0).fit(X, y)
+    src = ArrayChunks(X, y.astype(np.float32), chunk_rows=128)
+    np.testing.assert_allclose(
+        reg.predict_stream(src), reg.predict(X), rtol=1e-5, atol=1e-5
+    )
+    assert reg.score_stream(src) == pytest.approx(
+        reg.score(X, y), abs=1e-6
+    )
+
+
+def test_regressor_score_stream_large_mean_targets():
+    """Shifted one-pass moments must agree with the centered r2_score
+    even when the stream's targets carry a huge constant offset (the
+    raw sum-of-squares formula cancels catastrophically there)."""
+    from spark_bagging_tpu.utils.metrics import r2_score
+
+    X, y = make_regression(2000, 5, seed=3)
+    mu, s = X.mean(0), X.std(0) + 1e-8
+    X = ((X - mu) / s).astype(np.float32)
+    y_norm = (y / (y.std() + 1e-8)).astype(np.float32)
+    reg = BaggingRegressor(n_estimators=8, seed=0).fit(X, y_norm)
+    pred = reg.predict(X)
+    for offset in (0.0, 3e7):
+        y_stream = y_norm.astype(np.float64) + offset
+        src = ArrayChunks(X, y_stream, chunk_rows=256)
+        assert reg.score_stream(src) == pytest.approx(
+            r2_score(y_stream, pred), rel=1e-9, abs=1e-9
+        )
+    with pytest.raises(ValueError, match="no chunks"):
+        reg.score_stream(ArrayChunks(
+            np.empty((0, X.shape[1]), np.float32),
+            np.empty(0, np.float32), chunk_rows=16,
+        ))
