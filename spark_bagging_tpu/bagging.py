@@ -607,11 +607,6 @@ class _BaseBagging(ParamsMixin):
 
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
-        if self.oob_score and self.mesh is not None:
-            raise ValueError(
-                "oob_score with fit_stream is single-mesh only; drop the "
-                "mesh or compute OOB separately"
-            )
         ratio = self._sample_ratio(int(source.n_rows))
         if self.oob_score and not self.bootstrap and ratio >= 1.0:
             raise ValueError(
@@ -619,11 +614,30 @@ class _BaseBagging(ParamsMixin):
                 "max_samples < 1.0"
             )
         learner = self._learner()
+        from spark_bagging_tpu.models.tree import _TreeBase
+        from spark_bagging_tpu.parallel.multihost import is_multiprocess_mesh
+
+        if self.oob_score and self.mesh is not None:
+            # streamed OOB replays the plain chunk-keyed draw stream —
+            # valid unless the fit folded the data-shard index into its
+            # draws (data-sharded TREE streams), and single-process
+            # only (each OOB pass feeds local chunks)
+            if is_multiprocess_mesh(self.mesh):
+                raise ValueError(
+                    "oob_score with fit_stream is single-process only"
+                )
+            if (
+                isinstance(learner, _TreeBase)
+                and self.mesh.shape.get(DATA_AXIS, 1) > 1
+            ):
+                raise ValueError(
+                    "oob_score cannot replay a data-sharded tree "
+                    "stream's per-shard draws; use a replica-only mesh "
+                    "or drop oob_score"
+                )
         n_subspace = self._n_subspace(source.n_features)
         key = jax.random.key(self.seed)
         t0 = time.perf_counter()
-        from spark_bagging_tpu.models.tree import _TreeBase
-
         if isinstance(learner, _TreeBase):
             # structure-search learners stream through the multi-pass
             # level-synchronous engine (tree_stream.py), not SGD

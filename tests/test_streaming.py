@@ -343,15 +343,41 @@ def test_stream_tree_rejects_sgd_knobs(cancer):
         ).fit_stream((X, y), classes=[0, 1], chunk_rows=128, n_epochs=3)
 
 
-def test_stream_oob_rejects_mesh(cancer):
-    """Streamed OOB is single-mesh only (for now)."""
-    from spark_bagging_tpu.parallel import make_mesh
+def test_stream_oob_on_mesh_matches_unsharded(cancer):
+    """SGD streams never fold the shard index into draws, so streamed
+    OOB under a mesh replays the exact fit membership."""
+    X, y = cancer
+    kw = dict(n_estimators=8, oob_score=True, seed=0)
+    m = BaggingClassifier(mesh=make_mesh(data=2), **kw).fit_stream(
+        (X, y), chunk_rows=128, n_epochs=5, lr=0.05
+    )
+    u = BaggingClassifier(**kw).fit_stream(
+        (X, y), chunk_rows=128, n_epochs=5, lr=0.05
+    )
+    assert m.oob_score_ == pytest.approx(u.oob_score_, abs=0.02)
+
+
+def test_stream_oob_tree_data_mesh_rejected(cancer):
+    """Data-sharded tree streams fold the shard index into draws — OOB
+    regeneration cannot replay them; replica-only meshes are fine."""
+    import jax
 
     X, y = cancer
-    with pytest.raises(ValueError, match="single-mesh"):
+    with pytest.raises(ValueError, match="data-sharded tree"):
         BaggingClassifier(
-            n_estimators=8, oob_score=True, mesh=make_mesh(data=2)
-        ).fit_stream((X, y), chunk_rows=128)
+            base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+            n_estimators=8, oob_score=True, mesh=make_mesh(data=2),
+        ).fit_stream((X, y), chunk_rows=128, classes=[0, 1])
+    ok = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+        n_estimators=8, oob_score=True, seed=0,
+        mesh=make_mesh(data=1, replica=4, devices=jax.devices()[:4]),
+    ).fit_stream((X, y), chunk_rows=128, classes=[0, 1])
+    ref = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=2, n_bins=16),
+        n_estimators=8, oob_score=True, seed=0,
+    ).fit_stream((X, y), chunk_rows=128, classes=[0, 1])
+    assert ok.oob_score_ == pytest.approx(ref.oob_score_, abs=1e-9)
 
 
 def test_stream_subspaces(cancer):
