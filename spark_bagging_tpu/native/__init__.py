@@ -1,0 +1,6 @@
+"""Native C++ sources (compiled on demand by utils/native.py).
+
+This package exists so ``loader.cpp`` ships with the distribution
+(``[tool.setuptools.package-data]`` maps package names, not bare
+directories).
+"""
