@@ -708,6 +708,33 @@ class _BaseBagging(ParamsMixin):
         if "n_passes" in aux:
             self.fit_report_["n_passes"] = aux["n_passes"]
 
+    @property
+    def base_learner_(self) -> BaseLearner:
+        """The fitted base learner (hyperparameters frozen at fit time;
+        the constructor's ``base_learner`` may be mutated afterwards by
+        ``set_params`` without affecting the fitted ensemble)."""
+        self._check_fitted()
+        return self._fitted_learner
+
+    def replica_params(self, i: int):
+        """The ``i``-th fitted replica as ``(params, subspace_idx)`` —
+        the analog of sklearn's ``estimators_[i]`` (here the ensemble is
+        ONE stacked pytree, so a "sub-model" is a slice of it). Score
+        it directly with the fitted base learner::
+
+            params_i, idx = clf.replica_params(3)
+            scores = clf.base_learner_.predict_scores(params_i, X[:, idx])
+        """
+        self._check_fitted()
+        if not 0 <= i < self.n_estimators_:
+            raise IndexError(
+                f"replica {i} out of range [0, {self.n_estimators_})"
+            )
+        # slice on device first: gathering the full (R, ...) stack to
+        # host per call would make a loop over replicas O(R²) transfer
+        params = jax.tree.map(lambda a: to_host(a[i]), self.ensemble_)
+        return params, to_host(self.subspaces_[i])
+
     def _stream_chunks(self, source, chunk_rows=None):
         """Validated chunk iterator for the streaming predict/score
         paths (the reference's ``transform`` over a distributed

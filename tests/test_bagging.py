@@ -469,3 +469,25 @@ def test_int_max_samples(breast_cancer):
         BaggingClassifier(max_samples=1.5).fit(X, y)
     with pytest.raises(ValueError, match="max_samples"):
         BaggingClassifier(max_samples=0).fit(X, y)
+
+
+def test_replica_params_slices_match_ensemble(breast_cancer):
+    """Per-replica access (estimators_[i] analog): averaging the
+    single-replica probabilities must reproduce soft-vote
+    predict_proba."""
+    import jax
+
+    X, y = breast_cancer
+    clf = BaggingClassifier(n_estimators=6, seed=0, max_features=0.8).fit(X, y)
+    probs = []
+    for i in range(6):
+        params_i, idx = clf.replica_params(i)
+        scores = clf.base_learner_.predict_scores(
+            params_i, jnp.asarray(X)[:, idx]
+        )
+        probs.append(np.asarray(jax.nn.softmax(scores, axis=-1)))
+    np.testing.assert_allclose(
+        np.mean(probs, axis=0), clf.predict_proba(X), rtol=1e-4, atol=1e-5
+    )
+    with pytest.raises(IndexError):
+        clf.replica_params(6)
