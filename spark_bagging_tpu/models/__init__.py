@@ -12,6 +12,7 @@ from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
 from spark_bagging_tpu.models.mlp import MLPClassifier, MLPRegressor
 from spark_bagging_tpu.models.naive_bayes import GaussianNB
+from spark_bagging_tpu.models.svm import LinearSVC
 from spark_bagging_tpu.models.tree import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -24,6 +25,7 @@ __all__ = [
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "GaussianNB",
+    "LinearSVC",
     "MLPClassifier",
     "MLPRegressor",
 ]

@@ -33,11 +33,19 @@ from __future__ import annotations
 from typing import Any, ClassVar
 
 import jax
+import jax.numpy as jnp
 
 from spark_bagging_tpu.utils.params import ParamsMixin
 
 Params = Any  # a pytree of arrays
 Aux = dict[str, jax.Array]
+
+
+def augment_bias(X: jax.Array) -> jax.Array:
+    """Append a bias column of ones — the shared convention for linear
+    learners: weights are ``(d+1, C)`` with the bias in the LAST row,
+    which ``W[:-1]``-style penalties throughout depend on."""
+    return jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
 
 
 class BaseLearner(ParamsMixin):

@@ -34,7 +34,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from spark_bagging_tpu.models.base import Aux, BaseLearner, Params
+from spark_bagging_tpu.models.base import (
+    Aux,
+    BaseLearner,
+    Params,
+    augment_bias,
+)
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _BIAS_JITTER = 1e-6  # keeps the softmax gauge direction solvable
@@ -44,11 +49,6 @@ _BIAS_JITTER = 1e-6  # keeps the softmax gauge direction solvable
 # leaves eigmin(H) ≈ 1e-6; float32 matmul noise can push it negative
 # and NaN the Cholesky — observed on TPU with small, separable bags.
 _SOLVER_DAMPING = 1e-3
-
-
-def _augment(X: jax.Array) -> jax.Array:
-    """Append a bias column of ones."""
-    return jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
 
 
 class LogisticRegression(BaseLearner):
@@ -116,7 +116,7 @@ class LogisticRegression(BaseLearner):
         return float(self.max_iter * per_iter)
 
     def predict_scores(self, params, X):
-        return _augment(X.astype(params["W"].dtype)) @ params["W"]
+        return augment_bias(X.astype(params["W"].dtype)) @ params["W"]
 
     # ------------------------------------------------------------------
 
@@ -152,7 +152,7 @@ class LogisticRegression(BaseLearner):
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
         del key, prepared  # deterministic solvers; no precomputation
-        Xb = _augment(X.astype(jnp.float32))
+        Xb = augment_bias(X.astype(jnp.float32))
         w = sample_weight.astype(jnp.float32)
         w_sum = maybe_psum(jnp.sum(w), axis_name)
         # TPU matmuls default to bfloat16 inputs; Newton's Hessian loses

@@ -1,0 +1,168 @@
+"""Weighted linear SVM (squared hinge, one-vs-rest) base learner.
+
+Spark ML ships ``LinearSVC`` as a stock Predictor, so the reference's
+plugin slot accepts it directly [B:5, SURVEY §1 L3]. The TPU-native
+learner minimizes the *squared* hinge — smooth, so a damped Newton
+solver applies — one-vs-rest over classes (Spark's LinearSVC is
+binary-only; OVR is the strict superset sklearn uses).
+
+Newton structure is friendlier than multinomial logistic: OVR decouples
+classes, so the Hessian is block-diagonal — ``C`` independent
+``(d, d)`` systems, each an indicator-weighted Gram
+``Xᵀ diag(2w·1[margin<1]) X`` (one MXU matmul per class) solved by a
+batched Cholesky. No ``(C·d)²`` coupling matrix exists at any point.
+
+``sample_weight`` carries exact Poisson multiplicities and every row
+reduction goes through ``maybe_psum``, so data-sharded fits return the
+single-device solution bit-for-bit [SURVEY §7 hard-part 2, §5 comms].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.models.base import (
+    Aux,
+    BaseLearner,
+    Params,
+    augment_bias,
+)
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+# Same rationale as logistic._SOLVER_DAMPING: solve-time Levenberg
+# damping keeps the (possibly rank-deficient, e.g. no active rows for a
+# class) per-class Gram positive definite; the gradient stays exact.
+# (It covers the bias row too — no separate bias jitter is needed.)
+_SOLVER_DAMPING = 1e-3
+# Step-halving candidates for the Newton line search. The squared hinge
+# is piecewise quadratic: a full step can overshoot the active-set
+# boundary and cycle permanently (observed: loss 0.21→21.8→0.37→0.21 on
+# a 12-row bag — exactly the small-effective-n regime Poisson bootstrap
+# produces). Trying halved steps and keeping the best, with 0 as a
+# floor, makes the iteration monotonically non-increasing.
+_STEPS = (1.0, 0.5, 0.25, 0.0)
+
+
+
+class LinearSVC(BaseLearner):
+    """L2-regularized squared-hinge linear classifier (OVR).
+
+    Parameters mirror the Spark/sklearn vocabulary: ``l2`` penalty
+    strength (sklearn's ``C`` ≈ ``1 / (l2·n)``), ``max_iter`` static
+    Newton iterations (squared hinge is piecewise quadratic — Newton
+    settles in a handful), ``precision`` the MXU matmul precision.
+    """
+
+    task = "classification"
+    streamable = True
+
+    def __init__(
+        self,
+        l2: float = 1e-3,
+        max_iter: int = 8,
+        precision: str = "high",
+    ):
+        if max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {max_iter}")
+        self.l2 = l2
+        self.max_iter = max_iter
+        self.precision = precision
+
+    def init_params(self, key, n_features, n_outputs):
+        del key  # deterministic zero start
+        return {"W": jnp.zeros((n_features + 1, n_outputs), jnp.float32)}
+
+    def predict_scores(self, params, X):
+        """OVR margins ``(n, C)`` — argmax gives the class; the vote
+        aggregator's softmax is a monotone surrogate for soft voting."""
+        return augment_bias(X.astype(params["W"].dtype)) @ params["W"]
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        n, d, C = n_rows, n_features + 1, n_outputs
+        # per iter: margins + gradient matmuls + line-search forwards,
+        # C indicator-weighted (d, d) Grams, C Cholesky solves
+        per_iter = (4 + 2 * len(_STEPS)) * n * d * C \
+            + 2 * n * d * d * C + C * d**3 / 3
+        return float(self.max_iter * per_iter)
+
+    # -- streaming contract (out-of-core engine, streaming.py) ---------
+
+    def row_loss(self, params, X, y):
+        M = self.predict_scores(params, X)
+        T = 2.0 * jax.nn.one_hot(y, M.shape[1], dtype=M.dtype) - 1.0
+        a = jax.nn.relu(1.0 - T * M)
+        return jnp.sum(a * a, axis=1)
+
+    def penalty(self, params):
+        return 0.5 * self.l2 * jnp.sum(params["W"][:-1] ** 2)
+
+    # ------------------------------------------------------------------
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del key, prepared  # deterministic solver; no precomputation
+        Xb = augment_bias(X.astype(jnp.float32))
+        w = sample_weight.astype(jnp.float32)
+        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        d = Xb.shape[1]
+        C = params["W"].shape[1]
+        # L2 on feature rows only; the bias row is conditioned by the
+        # solver damping below.
+        pen = jnp.concatenate(
+            [jnp.full((d - 1,), self.l2, jnp.float32),
+             jnp.zeros((1,), jnp.float32)]
+        )
+        T = 2.0 * jax.nn.one_hot(y, C, dtype=jnp.float32) - 1.0
+
+        with jax.default_matmul_precision(self.precision):
+
+            def objective(W):
+                a = jax.nn.relu(1.0 - T * (Xb @ W))
+                data = maybe_psum(
+                    jnp.sum(w[:, None] * a * a), axis_name
+                ) / w_sum
+                return data + 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)
+
+            def step(W, _):
+                a = jax.nn.relu(1.0 - T * (Xb @ W))     # (n, C)
+                loss = maybe_psum(
+                    jnp.sum(w[:, None] * a * a), axis_name
+                ) / w_sum + 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)
+                # gradient: d/dW Σ w·a² = Xᵀ(−2w·T·a), penalty added
+                # outside the psum (it is replicated, not sharded)
+                G = maybe_psum(
+                    Xb.T @ (-2.0 * w[:, None] * T * a), axis_name
+                ) / w_sum
+                G = G + jnp.concatenate(
+                    [self.l2 * W[:-1], jnp.zeros((1, C), W.dtype)]
+                )
+                # per-class Hessian: Xᵀ diag(2w·1[a>0]) X — C (d, d)
+                # Grams, static Python loop (C is a trace-time constant)
+                active = (a > 0).astype(jnp.float32) * (2.0 * w[:, None])
+                H = jnp.stack(
+                    [(Xb * active[:, c:c + 1]).T @ Xb for c in range(C)]
+                ) / w_sum
+                H = maybe_psum(H, axis_name)
+                H = H + jnp.diag(pen)[None] \
+                    + _SOLVER_DAMPING * jnp.eye(d, dtype=jnp.float32)[None]
+                delta = jax.vmap(
+                    lambda Hc, gc: jax.scipy.linalg.solve(
+                        Hc, gc, assume_a="pos"
+                    )
+                )(H, G.T).T                              # (d, C)
+                # Step-halving line search over _STEPS (see above): one
+                # batched forward evaluates every candidate; 0 is among
+                # them, so the loss never increases.
+                cands = jnp.stack([W - s * delta for s in _STEPS])
+                cand_loss = jax.vmap(objective)(cands)
+                W = cands[jnp.argmin(cand_loss)]
+                return W, loss
+
+            W, losses = jax.lax.scan(
+                step, params["W"], None, length=self.max_iter
+            )
+            # final loss at the returned iterate (the scan reports the
+            # loss *before* each step)
+            final = objective(W)
+        return {"W": W}, {"loss": final, "loss_curve": losses}

@@ -364,3 +364,135 @@ class TestGaussianNB:
         np.testing.assert_allclose(var, 1.0, rtol=0.35)
         pred = np.asarray(nb.predict_scores(params, jnp.asarray(X)).argmax(1))
         assert (pred == y).mean() > 0.8
+
+
+class TestLinearSVC:
+    def test_binary_matches_sklearn(self):
+        from sklearn.svm import LinearSVC as SkSVC
+
+        from spark_bagging_tpu.models import LinearSVC
+
+        Xj, yj, X, y = _breast_cancer()
+        l2 = 1e-3
+        svc = LinearSVC(l2=l2, max_iter=8)
+        params, aux = svc.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        sk = SkSVC(loss="squared_hinge", dual=False,
+                   C=1.0 / (l2 * len(y))).fit(X, y)
+        ours = np.asarray(svc.predict_scores(params, Xj).argmax(1))
+        assert (ours == sk.predict(X)).mean() > 0.98
+        assert np.isfinite(float(aux["loss"]))
+
+    def test_multiclass_ovr(self):
+        from spark_bagging_tpu.models import LinearSVC
+
+        Xj, yj, X, y = _iris()
+        svc = LinearSVC(l2=1e-3, max_iter=8)
+        params, _ = svc.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+        acc = (np.asarray(svc.predict_scores(params, Xj).argmax(1)) == y).mean()
+        assert acc > 0.9
+
+    def test_loss_curve_monotone(self):
+        from spark_bagging_tpu.models import LinearSVC
+
+        Xj, yj, _, y = _iris()
+        svc = LinearSVC(l2=1e-3, max_iter=6)
+        _, aux = svc.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
+        curve = np.asarray(aux["loss_curve"])
+        assert np.all(np.diff(curve) <= 1e-6)
+        assert float(aux["loss"]) <= curve[0] + 1e-6
+
+    def test_no_newton_cycling_on_tiny_bags(self):
+        """Full undamped Newton steps on the squared hinge can cycle
+        permanently on tiny problems (active-set flips) — the regime
+        small Poisson bootstrap bags produce. The line search must keep
+        every iterate monotone and the result independent of max_iter
+        parity."""
+        from spark_bagging_tpu.models import LinearSVC
+
+        rng = np.random.default_rng(0)
+        for trial in range(100):
+            Xs = rng.normal(0, 3, (12, 3)).astype(np.float32)
+            ys = rng.integers(0, 2, 12).astype(np.int32)
+            if len(np.unique(ys)) < 2:
+                continue
+            svc = LinearSVC(l2=1e-4, max_iter=12)
+            _, aux = svc.fit_from_init(
+                KEY, jnp.asarray(Xs), jnp.asarray(ys), jnp.ones(12), 2
+            )
+            curve = np.asarray(aux["loss_curve"])
+            assert np.all(np.diff(curve) <= 1e-5), (trial, curve)
+
+    def test_poisson_weights_equal_duplicated_rows(self):
+        from spark_bagging_tpu.models import LinearSVC
+
+        Xj, yj, X, y = _iris()
+        rng = np.random.default_rng(1)
+        k = rng.poisson(1.0, len(y))
+        k[:3] = [1, 2, 3]  # nonzero rows exist
+        svc = LinearSVC(l2=1e-3, max_iter=8)
+        pw, _ = svc.fit_from_init(
+            KEY, Xj, yj, jnp.asarray(k, jnp.float32), 3
+        )
+        pd, _ = svc.fit_from_init(
+            KEY, jnp.asarray(np.repeat(X, k, axis=0)),
+            jnp.asarray(np.repeat(y, k), jnp.int32),
+            jnp.ones(int(k.sum())), 3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pw["W"]), np.asarray(pd["W"]), rtol=1e-3, atol=1e-4
+        )
+
+    def test_vmap_over_replicas(self):
+        from spark_bagging_tpu.models import LinearSVC
+
+        Xj, yj, _, y = _iris()
+        svc = LinearSVC(max_iter=3)
+        keys = jax.random.split(KEY, 4)
+        W = jax.vmap(
+            lambda kk: svc.fit_from_init(
+                kk, Xj, yj, jnp.ones(len(y)), 3
+            )[0]["W"]
+        )(keys)
+        assert W.shape == (4, Xj.shape[1] + 1, 3)
+        assert np.isfinite(np.asarray(W)).all()
+
+    def test_in_bagging_ensemble_and_mesh(self):
+        from spark_bagging_tpu import BaggingClassifier, make_mesh
+        from spark_bagging_tpu.models import LinearSVC
+
+        Xj, yj, X, y = _breast_cancer()
+        clf = BaggingClassifier(
+            base_learner=LinearSVC(max_iter=6), n_estimators=16, seed=0,
+            oob_score=True,
+        ).fit(X, y)
+        assert clf.score(X, y) > 0.95
+        assert clf.oob_score_ > 0.9
+        mesh = make_mesh(data=8)
+        a = BaggingClassifier(
+            base_learner=LinearSVC(max_iter=6), n_estimators=1,
+            bootstrap=False, seed=0, mesh=mesh,
+        ).fit(X, y)
+        b = BaggingClassifier(
+            base_learner=LinearSVC(max_iter=6), n_estimators=1,
+            bootstrap=False, seed=0,
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            a.predict_proba(X), b.predict_proba(X), rtol=1e-4, atol=1e-5
+        )
+
+    def test_streaming_fit(self):
+        from spark_bagging_tpu import ArrayChunks, BaggingClassifier
+        from spark_bagging_tpu.models import LinearSVC
+
+        _, _, X, y = _breast_cancer()
+        src = ArrayChunks(X, y, chunk_rows=128)
+        clf = BaggingClassifier(
+            base_learner=LinearSVC(), n_estimators=8, seed=0,
+        ).fit_stream(src, classes=[0, 1], n_epochs=8, lr=0.05)
+        assert clf.score(X, y) > 0.9
+
+    def test_invalid_max_iter_raises(self):
+        from spark_bagging_tpu.models import LinearSVC
+
+        with pytest.raises(ValueError, match="max_iter"):
+            LinearSVC(max_iter=0)
