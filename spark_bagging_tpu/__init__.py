@@ -17,8 +17,13 @@ sections instead of reference file:line):
 """
 
 from spark_bagging_tpu.bagging import BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.forest import (
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
 from spark_bagging_tpu.models import (
     BaseLearner,
+    BernoulliNB,
     DecisionTreeClassifier,
     DecisionTreeRegressor,
     GaussianNB,
@@ -27,6 +32,7 @@ from spark_bagging_tpu.models import (
     LogisticRegression,
     MLPClassifier,
     MLPRegressor,
+    MultinomialNB,
 )
 from spark_bagging_tpu.parallel import make_mesh
 from spark_bagging_tpu.utils.arrow import ArrowChunks
@@ -44,12 +50,16 @@ __version__ = "0.1.0"
 __all__ = [
     "BaggingClassifier",
     "BaggingRegressor",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
+    "BernoulliNB",
     "GaussianNB",
+    "MultinomialNB",
     "LinearSVC",
     "MLPClassifier",
     "MLPRegressor",

@@ -1,0 +1,121 @@
+"""Random forests — bagged trees with per-split feature sampling.
+
+Spark ML ships ``RandomForestClassifier``/``RandomForestRegressor`` as
+stock Predictors next to the trees the reference can bag [B:5,
+SURVEY §1 L3]; upstream, a random forest IS the bagging loop with a
+``featureSubsetStrategy`` drawn per split. Here that composition is
+literal: these classes are ``Bagging*`` with the base learner fixed to
+a decision tree whose ``feature_subset`` does the per-split draw
+(models/tree.py) — every TPU path (vmap replicas, mesh sharding,
+streamed fits, OOB, checkpointing, feature importances) is inherited,
+not re-implemented.
+
+Defaults follow Spark's ``featureSubsetStrategy="auto"``: ``sqrt`` of
+the feature count for classification, a third for regression.
+"""
+
+from __future__ import annotations
+
+from spark_bagging_tpu.bagging import BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.models.tree import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+)
+
+
+class RandomForestClassifier(BaggingClassifier):
+    """Bagged Gini trees with per-split feature sampling.
+
+    Tree hyperparameters (``max_depth``, ``n_bins``, ``leaf_smoothing``,
+    ``feature_subset``, ``split_impl``) live on this estimator so
+    ``get_params``/``set_params``/``clone`` and GridSearchCV tune them
+    directly; the tree learner is built from them at fit time.
+    """
+
+    def __init__(
+        self,
+        n_estimators: int = 100,
+        max_depth: int = 5,
+        n_bins: int = 32,
+        feature_subset: str | float | int | None = "sqrt",
+        leaf_smoothing: float = 1.0,
+        split_impl: str = "auto",
+        max_samples: float | int = 1.0,
+        bootstrap: bool = True,
+        voting: str = "soft",
+        oob_score: bool = False,
+        seed: int = 0,
+        chunk_size: int | None = None,
+        mesh=None,
+        warm_start: bool = False,
+    ):
+        super().__init__(
+            base_learner=None,
+            n_estimators=n_estimators,
+            max_samples=max_samples,
+            bootstrap=bootstrap,
+            voting=voting,
+            oob_score=oob_score,
+            seed=seed,
+            chunk_size=chunk_size,
+            mesh=mesh,
+            warm_start=warm_start,
+        )
+        self.max_depth = max_depth
+        self.n_bins = n_bins
+        self.feature_subset = feature_subset
+        self.leaf_smoothing = leaf_smoothing
+        self.split_impl = split_impl
+
+    def _learner(self) -> BaseLearner:
+        return DecisionTreeClassifier(
+            max_depth=self.max_depth,
+            n_bins=self.n_bins,
+            leaf_smoothing=self.leaf_smoothing,
+            split_impl=self.split_impl,
+            feature_subset=self.feature_subset,
+        )
+
+
+class RandomForestRegressor(BaggingRegressor):
+    """Bagged variance-split trees with per-split feature sampling."""
+
+    def __init__(
+        self,
+        n_estimators: int = 100,
+        max_depth: int = 5,
+        n_bins: int = 32,
+        feature_subset: str | float | int | None = "onethird",
+        split_impl: str = "auto",
+        max_samples: float | int = 1.0,
+        bootstrap: bool = True,
+        oob_score: bool = False,
+        seed: int = 0,
+        chunk_size: int | None = None,
+        mesh=None,
+        warm_start: bool = False,
+    ):
+        super().__init__(
+            base_learner=None,
+            n_estimators=n_estimators,
+            max_samples=max_samples,
+            bootstrap=bootstrap,
+            oob_score=oob_score,
+            seed=seed,
+            chunk_size=chunk_size,
+            mesh=mesh,
+            warm_start=warm_start,
+        )
+        self.max_depth = max_depth
+        self.n_bins = n_bins
+        self.feature_subset = feature_subset
+        self.split_impl = split_impl
+
+    def _learner(self) -> BaseLearner:
+        return DecisionTreeRegressor(
+            max_depth=self.max_depth,
+            n_bins=self.n_bins,
+            split_impl=self.split_impl,
+            feature_subset=self.feature_subset,
+        )

@@ -11,7 +11,11 @@ from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
 from spark_bagging_tpu.models.mlp import MLPClassifier, MLPRegressor
-from spark_bagging_tpu.models.naive_bayes import GaussianNB
+from spark_bagging_tpu.models.naive_bayes import (
+    BernoulliNB,
+    GaussianNB,
+    MultinomialNB,
+)
 from spark_bagging_tpu.models.svm import LinearSVC
 from spark_bagging_tpu.models.tree import (
     DecisionTreeClassifier,
@@ -24,7 +28,9 @@ __all__ = [
     "LinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
+    "BernoulliNB",
     "GaussianNB",
+    "MultinomialNB",
     "LinearSVC",
     "MLPClassifier",
     "MLPRegressor",

@@ -152,7 +152,9 @@ class BaseLearner(ParamsMixin):
         prepared: Any | None = None,
     ) -> tuple[Params, Aux]:
         """Init-then-fit with a split key; one replica's whole training."""
-        init_key, fit_key = jax.random.split(key)
+        from spark_bagging_tpu.ops.bootstrap import split_init_fit
+
+        init_key, fit_key = split_init_fit(key)
         params = self.init_params(init_key, X.shape[1], n_outputs)
         kwargs = {}
         if prepared is not None:

@@ -107,3 +107,138 @@ class GaussianNB(BaseLearner):
         )
         log_norm = jnp.sum(jnp.log(var) + _LOG_2PI, axis=1)[None, :]
         return params["log_prior"][None, :] - 0.5 * (quad + log_norm)
+
+
+def _weighted_class_counts(Xc, y, w, C, axis_name):
+    """Shared count-NB statistics: per-class weight totals, the global
+    weight sum, the (C, F) weighted feature counts, and log priors."""
+    Yw = jax.nn.one_hot(y, C, dtype=jnp.float32).T * w[None, :]
+    cls_w = maybe_psum(Yw.sum(axis=1), axis_name)          # (C,)
+    w_sum = jnp.maximum(cls_w.sum(), 1e-12)
+    counts = maybe_psum(Yw @ Xc, axis_name)                # (C, F)
+    log_prior = jnp.log(jnp.maximum(cls_w, 1e-12) / w_sum)
+    return cls_w, w_sum, counts, log_prior
+
+
+def _weighted_nll(learner, params, X, y, w, w_sum, axis_name):
+    """Weighted mean NLL of the fitted model (loss curve/report)."""
+    logp = jax.nn.log_softmax(learner.predict_scores(params, X), axis=-1)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+    return maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
+
+
+class MultinomialNB(BaseLearner):
+    """Weighted multinomial naive Bayes over count features.
+
+    Spark ML's ``NaiveBayes`` default model type [B:5, SURVEY §1 L3]:
+    per-class feature-count distributions with Laplace smoothing
+    ``alpha``. The fit is ONE ``(C, n) @ (n, F)`` weighted-count matmul.
+    Features must be non-negative (counts / tf-idf); like Spark, the
+    result is undefined on negative inputs (jitted code cannot raise
+    data-dependent errors).
+    """
+
+    task = "classification"
+    streamable = False  # closed-form; one pass, no gradient stream
+
+    def __init__(self, alpha: float = 1.0):
+        if alpha < 0:
+            raise ValueError(f"alpha must be >= 0, got {alpha}")
+        self.alpha = alpha
+
+    def init_params(self, key, n_features, n_outputs):
+        del key
+        return {
+            "log_prior": jnp.zeros((n_outputs,), jnp.float32),
+            "log_theta": jnp.zeros((n_outputs, n_features), jnp.float32),
+        }
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        return float(2 * n_rows * n_features * n_outputs
+                     + 4 * n_rows * n_outputs)
+
+    def fit(self, params, X, y, sample_weight, key, *,
+            axis_name=None, prepared=None) -> tuple[Params, Aux]:
+        del key, prepared
+        C = params["log_theta"].shape[0]
+        X = X.astype(jnp.float32)
+        w = sample_weight.astype(jnp.float32)
+        _, w_sum, counts, log_prior = _weighted_class_counts(
+            X, y, w, C, axis_name
+        )
+        sm = counts + self.alpha
+        log_theta = jnp.log(sm) - jnp.log(sm.sum(axis=1))[:, None]
+        params = {"log_prior": log_prior, "log_theta": log_theta}
+        loss = _weighted_nll(self, params, X, y, w, w_sum, axis_name)
+        return params, {"loss": loss, "loss_curve": loss[None]}
+
+    def predict_scores(self, params, X):
+        return (
+            params["log_prior"][None, :]
+            + X.astype(jnp.float32) @ params["log_theta"].T
+        )
+
+
+class BernoulliNB(BaseLearner):
+    """Weighted Bernoulli naive Bayes over binarized features.
+
+    Spark ML ``NaiveBayes(modelType="bernoulli")`` [B:5]. ``binarize``
+    is the threshold mapping features to {0, 1} (sklearn convention);
+    ``alpha`` the Laplace smoothing. Closed-form weighted-count fit,
+    one matmul, exactly data-parallel through ``maybe_psum``.
+    """
+
+    task = "classification"
+    streamable = False
+
+    def __init__(self, alpha: float = 1.0, binarize: float = 0.0):
+        if alpha < 0:
+            raise ValueError(f"alpha must be >= 0, got {alpha}")
+        self.alpha = alpha
+        self.binarize = binarize
+
+    def init_params(self, key, n_features, n_outputs):
+        del key
+        return {
+            "log_prior": jnp.zeros((n_outputs,), jnp.float32),
+            "log_theta": jnp.full(
+                (n_outputs, n_features), -0.6931472, jnp.float32
+            ),
+            "log_1m_theta": jnp.full(
+                (n_outputs, n_features), -0.6931472, jnp.float32
+            ),
+        }
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        return float(2 * n_rows * n_features * n_outputs
+                     + 4 * n_rows * n_outputs)
+
+    def fit(self, params, X, y, sample_weight, key, *,
+            axis_name=None, prepared=None) -> tuple[Params, Aux]:
+        del key, prepared
+        C = params["log_theta"].shape[0]
+        Xb = (X > self.binarize).astype(jnp.float32)
+        w = sample_weight.astype(jnp.float32)
+        cls_w, w_sum, counts, log_prior = _weighted_class_counts(
+            Xb, y, w, C, axis_name
+        )
+        theta = (counts + self.alpha) / (
+            jnp.maximum(cls_w, 1e-12) + 2.0 * self.alpha
+        )[:, None]
+        params = {
+            "log_prior": log_prior,
+            "log_theta": jnp.log(theta),
+            "log_1m_theta": jnp.log1p(-theta),
+        }
+        loss = _weighted_nll(self, params, Xb, y, w, w_sum, axis_name)
+        return params, {"loss": loss, "loss_curve": loss[None]}
+
+    def predict_scores(self, params, X):
+        Xb = (X > self.binarize).astype(jnp.float32)
+        lt, l1m = params["log_theta"], params["log_1m_theta"]
+        # Σ_f x·logθ + (1−x)·log(1−θ) = Σ log(1−θ) + x·(logθ − log(1−θ))
+        return (
+            params["log_prior"][None, :]
+            + jnp.sum(l1m, axis=1)[None, :]
+            + Xb @ (lt - l1m).T
+        )

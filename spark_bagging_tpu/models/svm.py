@@ -80,10 +80,10 @@ class LinearSVC(BaseLearner):
 
     def flops_per_fit(self, n_rows, n_features, n_outputs):
         n, d, C = n_rows, n_features + 1, n_outputs
-        # per iter: margins + gradient matmuls + line-search forwards,
+        # per iter: margins + gradient + line-search delta matmuls
+        # (candidates are priced from M − s·D, no extra matmuls),
         # C indicator-weighted (d, d) Grams, C Cholesky solves
-        per_iter = (4 + 2 * len(_STEPS)) * n * d * C \
-            + 2 * n * d * d * C + C * d**3 / 3
+        per_iter = 6 * n * d * C + 2 * n * d * d * C + C * d**3 / 3
         return float(self.max_iter * per_iter)
 
     # -- streaming contract (out-of-core engine, streaming.py) ---------
@@ -117,15 +117,16 @@ class LinearSVC(BaseLearner):
 
         with jax.default_matmul_precision(self.precision):
 
-            def objective(W):
-                a = jax.nn.relu(1.0 - T * (Xb @ W))
-                data = maybe_psum(
+            def data_loss_at(M):
+                """Weighted squared-hinge mass from precomputed margins."""
+                a = jax.nn.relu(1.0 - T * M)
+                return maybe_psum(
                     jnp.sum(w[:, None] * a * a), axis_name
                 ) / w_sum
-                return data + 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)
 
             def step(W, _):
-                a = jax.nn.relu(1.0 - T * (Xb @ W))     # (n, C)
+                M = Xb @ W                               # (n, C)
+                a = jax.nn.relu(1.0 - T * M)
                 loss = maybe_psum(
                     jnp.sum(w[:, None] * a * a), axis_name
                 ) / w_sum + 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)
@@ -151,18 +152,24 @@ class LinearSVC(BaseLearner):
                         Hc, gc, assume_a="pos"
                     )
                 )(H, G.T).T                              # (d, C)
-                # Step-halving line search over _STEPS (see above): one
-                # batched forward evaluates every candidate; 0 is among
-                # them, so the loss never increases.
-                cands = jnp.stack([W - s * delta for s in _STEPS])
-                cand_loss = jax.vmap(objective)(cands)
-                W = cands[jnp.argmin(cand_loss)]
-                return W, loss
+                # Step-halving line search over _STEPS (see above):
+                # margins at W − s·delta are M − s·D, so ONE extra
+                # matmul (D) prices every candidate; 0 is among them,
+                # so the loss never increases.
+                D = Xb @ delta
+                cand_loss = jnp.stack([
+                    data_loss_at(M - s * D)
+                    + 0.5 * self.l2 * jnp.sum((W - s * delta)[:-1] ** 2)
+                    for s in _STEPS
+                ])
+                s_best = jnp.asarray(_STEPS)[jnp.argmin(cand_loss)]
+                return W - s_best * delta, loss
 
             W, losses = jax.lax.scan(
                 step, params["W"], None, length=self.max_iter
             )
             # final loss at the returned iterate (the scan reports the
             # loss *before* each step)
-            final = objective(W)
+            final = data_loss_at(Xb @ W) \
+                + 0.5 * self.l2 * jnp.sum(W[:-1] ** 2)
         return {"W": W}, {"loss": final, "loss_curve": losses}

@@ -39,10 +39,34 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _EPS = 1e-12
+
+
+def _check_feature_subset(fs):
+    """Validate a featureSubsetStrategy value; returns it unchanged."""
+    if fs is None or fs in ("all", "sqrt", "log2", "onethird"):
+        return fs
+    if isinstance(fs, bool):
+        raise ValueError(f"invalid feature_subset {fs!r}")
+    if isinstance(fs, int):
+        if fs < 1:
+            raise ValueError(f"int feature_subset must be >= 1, got {fs}")
+        return fs
+    if isinstance(fs, float):
+        if not 0.0 < fs <= 1.0:
+            raise ValueError(
+                f"float feature_subset must be in (0, 1], got {fs}"
+            )
+        return fs
+    raise ValueError(
+        "feature_subset must be None|'all'|'sqrt'|'log2'|'onethird'|"
+        f"float|int, got {fs!r}"
+    )
 
 
 def _quantile_edges(X, row_mask, n_bins):
@@ -97,6 +121,7 @@ class _TreeBase(BaseLearner):
         hist_dtype: str = "bfloat16",
         precision: str = "highest",
         split_impl: str = "auto",
+        feature_subset: str | float | int | None = None,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
@@ -106,11 +131,46 @@ class _TreeBase(BaseLearner):
             raise ValueError(
                 f"split_impl must be auto|dense|fused, got {split_impl!r}"
             )
+        _check_feature_subset(feature_subset)
         self.max_depth = max_depth
         self.n_bins = n_bins
         self.hist_dtype = hist_dtype
         self.precision = precision
         self.split_impl = split_impl
+        self.feature_subset = feature_subset
+
+    def _n_split_features(self, n_features: int) -> int | None:
+        """Candidate features per SPLIT (Spark's featureSubsetStrategy
+        [SURVEY §1 L3] / random-forest semantics): each node at each
+        level considers a fresh random feature subset. None/'all' keeps
+        every feature (plain decision tree)."""
+        fs = _check_feature_subset(self.feature_subset)
+        F = n_features
+        if fs is None or fs == "all":
+            return None
+        if fs == "sqrt":
+            k = int(np.ceil(np.sqrt(F)))
+        elif fs == "log2":
+            k = int(np.ceil(np.log2(max(F, 2))))
+        elif fs == "onethird":
+            k = int(np.ceil(F / 3))
+        elif isinstance(fs, float):
+            k = int(np.ceil(fs * F))
+        else:  # int
+            k = fs
+        k = max(1, min(int(k), F))
+        return None if k == F else k
+
+    @staticmethod
+    def _level_feat_mask(key, level, n_nodes, n_features, k):
+        """(N, F) mask with exactly k candidate features per node,
+        drawn from ``fold_in(key, level)`` — deterministic given the
+        replica fit key, so streamed fits can replay it exactly."""
+        rand = jax.random.uniform(
+            jax.random.fold_in(key, level), (n_nodes, n_features)
+        )
+        kth = jnp.sort(rand, axis=1)[:, k - 1]
+        return rand <= kth[:, None]
 
     def _resolved_impl(self, n_rows: int, n_features: int) -> str:
         if self.split_impl != "auto":
@@ -185,18 +245,25 @@ class _TreeBase(BaseLearner):
             hdt = jnp.dtype(jnp.float32)
         return hdt
 
-    def _select_splits(self, hist, edges):
+    def _select_splits(self, hist, edges, feat_mask=None):
         """One level's split choice from its left-stats table.
 
         ``hist``: ``(F, B, N, K)`` cumulative left statistics. Returns
         ``(feature, threshold, score_sum)`` for the level's N nodes —
         shared by the in-memory growth loop and the streaming fit.
+        ``feat_mask`` (N, F) restricts each node's candidate features
+        (random-forest per-split sampling); masked-out candidates score
+        +inf so the argmin never picks them.
         """
         B = self.n_bins
         N = hist.shape[2]
         total = hist[0, -1]  # edge B-1 is +inf ⇒ full-node sums
         right = total[None, None, :, :] - hist
         score = self._impurity(hist) + self._impurity(right)
+        if feat_mask is not None:
+            score = jnp.where(
+                feat_mask.T[:, None, :], score, jnp.inf
+            )
         best = jnp.argmin(score.reshape(-1, N), axis=0)
         bf = (best // B).astype(jnp.int32)
         bb = (best % B).astype(jnp.int32)
@@ -238,18 +305,25 @@ class _TreeBase(BaseLearner):
             Tf.T, R, preferred_element_type=jnp.float32
         ).reshape(F, B, N, K)
 
-    def _grow(self, X, S, prepared, axis_name):
+    def _grow(self, X, S, prepared, axis_name, key=None):
         """Level-synchronous growth; returns (feature, threshold,
         per-node gain, leaf_index_per_row, per-level impurity curve).
 
         ``S`` is the per-row statistics matrix ``(n, K)`` whose left/
         right sums drive the impurity: weighted one-hot classes for
         classification, weighted moments ``(w, w·y, w·y²)`` for
-        regression.
+        regression. ``key`` (the replica fit key) seeds the per-split
+        feature masks when ``feature_subset`` is set.
         """
         n, F = X.shape
         B, d = self.n_bins, self.max_depth
         K = S.shape[1]
+        k_split = self._n_split_features(F)
+        if k_split is not None and key is None:
+            raise ValueError(
+                "feature_subset per-split sampling needs the replica "
+                "fit key; call fit() rather than _grow() directly"
+            )
         edges = prepared["edges"]
         fused = "T" not in prepared
         hdt = self._hdt()
@@ -289,7 +363,13 @@ class _TreeBase(BaseLearner):
                         ),
                         axis_name,
                     ).reshape(F, B, N, K)
-                bf, thr, score_sum, gain = self._select_splits(hist, edges)
+                mask = (
+                    self._level_feat_mask(key, level, N, F, k_split)
+                    if k_split is not None else None
+                )
+                bf, thr, score_sum, gain = self._select_splits(
+                    hist, edges, mask
+                )
                 feats.append(bf)
                 thrs.append(thr)
                 curve.append(score_sum)
@@ -359,8 +439,12 @@ class DecisionTreeClassifier(_TreeBase):
         hist_dtype: str = "bfloat16",
         precision: str = "highest",
         split_impl: str = "auto",
+        feature_subset: str | float | int | None = None,
     ):
-        super().__init__(max_depth, n_bins, hist_dtype, precision, split_impl)
+        super().__init__(
+            max_depth, n_bins, hist_dtype, precision, split_impl,
+            feature_subset,
+        )
         self.leaf_smoothing = leaf_smoothing
 
     def init_params(self, key, n_features, n_outputs):
@@ -406,13 +490,12 @@ class DecisionTreeClassifier(_TreeBase):
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
-        del key
         if prepared is None:
             prepared = self.prepare(X, axis_name=axis_name)
         C = params["leaf_logp"].shape[1]
         S = self._row_stats(y, sample_weight.astype(jnp.float32), C)
         feature, threshold, gain, node, curve = self._grow(
-            X, S, prepared, axis_name
+            X, S, prepared, axis_name, key
         )
         counts = self._leaf_stats(node, S, axis_name)  # (L, C)
         return self._finalize_leaves(feature, threshold, gain, counts, curve)
@@ -471,12 +554,12 @@ class DecisionTreeRegressor(_TreeBase):
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
-        del params, key
+        del params
         if prepared is None:
             prepared = self.prepare(X, axis_name=axis_name)
         S = self._row_stats(y, sample_weight.astype(jnp.float32), 1)
         feature, threshold, gain, node, curve = self._grow(
-            X, S, prepared, axis_name
+            X, S, prepared, axis_name, key
         )
         m = self._leaf_stats(node, S, axis_name)  # (L, 3)
         return self._finalize_leaves(feature, threshold, gain, m, curve)

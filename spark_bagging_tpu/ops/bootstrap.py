@@ -91,6 +91,32 @@ def fit_key(key: jax.Array, replica_id: jax.Array) -> jax.Array:
     return jax.random.fold_in(jax.random.fold_in(key, _FIT_STREAM), replica_id)
 
 
+def split_init_fit(key: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Split one replica's training key into its (init, fit) pair.
+
+    Single source of truth for the schedule ``fit_from_init`` applies
+    to the key the engine hands it — kept here so replayers derive the
+    identical pair via :func:`replica_init_fit_keys`.
+    """
+    init_key, fkey = jax.random.split(key)
+    return init_key, fkey
+
+
+def replica_init_fit_keys(
+    key: jax.Array, replica_id: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """THE (init, fit) key pair of one replica's training.
+
+    Single source of truth for the per-replica key schedule:
+    ``fit_from_init`` consumes it in-memory (via :func:`split_init_fit`
+    on ``fit_key``), and the streaming engines (streaming.py init,
+    tree_stream.py per-split feature masks) replay it to reproduce
+    in-memory draws exactly. Changing the schedule here changes every
+    consumer together — never re-derive it inline.
+    """
+    return split_init_fit(fit_key(key, replica_id))
+
+
 def bootstrap_weights_one(
     key: jax.Array,
     replica_id: jax.Array,

@@ -45,7 +45,7 @@ from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.ops.bootstrap import (
     bootstrap_weights_one,
     feature_subspaces,
-    fit_key,
+    replica_init_fit_keys,
 )
 from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
 from spark_bagging_tpu.utils.io import ChunkSource
@@ -218,7 +218,7 @@ def fit_ensemble_stream(
     row_key = jax.random.fold_in(key, _CHUNK_STREAM)
 
     def init_one(rid):
-        init_key, _ = jax.random.split(fit_key(key, rid))
+        init_key, _ = replica_init_fit_keys(key, rid)
         return learner.init_params(init_key, n_subspace, n_outputs)
 
     params = jax.vmap(init_one)(ids)

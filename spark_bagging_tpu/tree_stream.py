@@ -46,6 +46,7 @@ from spark_bagging_tpu.models.tree import _TreeBase, _quantile_edges
 from spark_bagging_tpu.ops.bootstrap import (
     bootstrap_weights_one,
     feature_subspaces,
+    replica_init_fit_keys,
 )
 from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
 from spark_bagging_tpu.parallel.multihost import global_put, to_host
@@ -322,13 +323,26 @@ def fit_tree_ensemble_stream(
         )
         hist = _accumulate(_wrap_step(level_body), hist, source)
 
-        @jax.jit
-        def select(hist):
-            def one(h, idx):
-                e_r = edges if identity else edges[idx]
-                return learner._select_splits(h, e_r)
+        k_split = learner._n_split_features(n_subspace)
 
-            return jax.vmap(one)(hist, subspaces)
+        @jax.jit
+        def select(hist, _level=level, _N=N):
+            def one(h, idx, rid):
+                e_r = edges if identity else edges[idx]
+                mask = None
+                if k_split is not None:
+                    # replay the in-memory mask stream exactly: the
+                    # shared key schedule (ops/bootstrap) gives the
+                    # replica fit key, folded with the level — so
+                    # streamed and in-memory forests grow the same
+                    # trees from the same draws
+                    fkey = replica_init_fit_keys(key, rid)[1]
+                    mask = learner._level_feat_mask(
+                        fkey, _level, _N, n_subspace, k_split
+                    )
+                return learner._select_splits(h, e_r, mask)
+
+            return jax.vmap(one)(hist, subspaces, ids)
 
         bf, thr, score, gain = select(hist)
         feats_lvls = feats_lvls + (bf,)

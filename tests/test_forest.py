@@ -1,0 +1,155 @@
+"""Random forest tests: per-split feature sampling, sklearn-quality
+parity, stream/memory equality with feature_subset set [SURVEY §4]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_iris
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import (
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+from spark_bagging_tpu.models import DecisionTreeClassifier
+
+KEY = jax.random.key(0)
+
+
+def _breast_cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    X = StandardScaler().fit_transform(X).astype(np.float32)
+    return X, y
+
+
+class TestPerSplitSampling:
+    def test_mask_exact_k(self):
+        tree = DecisionTreeClassifier(feature_subset=5)
+        mask = tree._level_feat_mask(KEY, 0, 8, 20, 5)
+        assert mask.shape == (8, 20)
+        np.testing.assert_array_equal(np.asarray(mask.sum(1)), 5)
+        # distinct nodes draw distinct subsets (overwhelmingly likely)
+        assert not np.array_equal(np.asarray(mask[0]), np.asarray(mask[1]))
+
+    def test_mask_changes_per_level_and_replica(self):
+        tree = DecisionTreeClassifier(feature_subset=4)
+        m0 = np.asarray(tree._level_feat_mask(KEY, 0, 4, 16, 4))
+        m1 = np.asarray(tree._level_feat_mask(KEY, 1, 4, 16, 4))
+        assert not np.array_equal(m0, m1)
+        k2 = jax.random.key(1)
+        m2 = np.asarray(tree._level_feat_mask(k2, 0, 4, 16, 4))
+        assert not np.array_equal(m0, m2)
+
+    def test_n_split_features_resolution(self):
+        t = DecisionTreeClassifier
+        assert t(feature_subset=None)._n_split_features(30) is None
+        assert t(feature_subset="all")._n_split_features(30) is None
+        assert t(feature_subset="sqrt")._n_split_features(30) == 6
+        assert t(feature_subset="log2")._n_split_features(30) == 5
+        assert t(feature_subset="onethird")._n_split_features(30) == 10
+        assert t(feature_subset=0.5)._n_split_features(30) == 15
+        assert t(feature_subset=7)._n_split_features(30) == 7
+        assert t(feature_subset=100)._n_split_features(30) is None  # clamps
+        with pytest.raises(ValueError, match="feature_subset"):
+            t(feature_subset=0)
+        with pytest.raises(ValueError, match="feature_subset"):
+            t(feature_subset=1.5)
+        with pytest.raises(ValueError, match="feature_subset"):
+            t(feature_subset="auto")
+
+    def test_subset_tree_differs_from_full_tree(self):
+        X, y = _breast_cancer()
+        full = DecisionTreeClassifier(max_depth=3)
+        sub = DecisionTreeClassifier(max_depth=3, feature_subset=3)
+        pf, _ = full.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32),
+            jnp.ones(len(y)), 2,
+        )
+        ps, _ = sub.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32),
+            jnp.ones(len(y)), 2,
+        )
+        assert not np.array_equal(
+            np.asarray(pf["feature"]), np.asarray(ps["feature"])
+        )
+
+
+class TestRandomForestClassifier:
+    def test_accuracy_and_oob(self):
+        X, y = _breast_cancer()
+        rf = RandomForestClassifier(
+            n_estimators=32, max_depth=4, seed=0, oob_score=True,
+        ).fit(X, y)
+        assert rf.score(X, y) > 0.95
+        assert rf.oob_score_ > 0.9
+        assert rf.feature_importances_.shape == (X.shape[1],)
+        assert rf.feature_importances_.sum() == pytest.approx(1.0, abs=1e-5)
+
+    def test_multiclass_and_params_roundtrip(self):
+        X, y = load_iris(return_X_y=True)
+        X = X.astype(np.float32)
+        rf = RandomForestClassifier(n_estimators=16, max_depth=3, seed=1)
+        rf2 = rf.clone().set_params(max_depth=4)
+        assert rf2.get_params()["max_depth"] == 4
+        assert rf.get_params()["max_depth"] == 3
+        rf.fit(X, y)
+        assert rf.score(X, y) > 0.9
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        from spark_bagging_tpu import load_model, save_model
+
+        X, y = _breast_cancer()
+        rf = RandomForestClassifier(n_estimators=8, max_depth=3).fit(X, y)
+        save_model(rf, str(tmp_path / "rf"))
+        rf2 = load_model(str(tmp_path / "rf"))
+        assert isinstance(rf2, RandomForestClassifier)
+        np.testing.assert_allclose(
+            rf.predict_proba(X[:32]), rf2.predict_proba(X[:32]), rtol=1e-6
+        )
+
+    def test_mesh_fit(self):
+        from spark_bagging_tpu import make_mesh
+
+        X, y = _breast_cancer()
+        mesh = make_mesh(data=2)
+        rf = RandomForestClassifier(
+            n_estimators=16, max_depth=3, seed=0, mesh=mesh,
+        ).fit(X, y)
+        assert rf.score(X, y) > 0.9
+
+
+class TestRandomForestRegressor:
+    def test_r2(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 10)).astype(np.float32)
+        y = (np.sin(X[:, 0]) + X[:, 1] ** 2
+             + 0.1 * rng.normal(size=500)).astype(np.float32)
+        rf = RandomForestRegressor(
+            n_estimators=32, max_depth=5, seed=0, oob_score=True,
+        ).fit(X, y)
+        assert rf.score(X, y) > 0.7
+        assert np.isfinite(rf.oob_score_)
+
+    def test_stream_matches_memory_with_feature_subset(self):
+        """The streamed forest must replay the in-memory per-split
+        masks exactly — identical trees from chunked data."""
+        from spark_bagging_tpu import ArrayChunks
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(256, 8)).astype(np.float32)
+        y = (X[:, 0] - 2 * X[:, 3] + 0.1 * rng.normal(size=256)).astype(
+            np.float32
+        )
+        mem = RandomForestRegressor(
+            n_estimators=4, max_depth=3, seed=0, bootstrap=False,
+            max_samples=1.0,
+        ).fit(X, y)
+        src = ArrayChunks(X, y, chunk_rows=256)  # one chunk: same binning
+        stream = RandomForestRegressor(
+            n_estimators=4, max_depth=3, seed=0, bootstrap=False,
+            max_samples=1.0,
+        ).fit_stream(src)
+        np.testing.assert_allclose(
+            mem.predict(X), stream.predict(X), rtol=1e-5, atol=1e-5
+        )

@@ -496,3 +496,138 @@ class TestLinearSVC:
 
         with pytest.raises(ValueError, match="max_iter"):
             LinearSVC(max_iter=0)
+
+
+class TestMultinomialNB:
+    def _count_data(self):
+        rng = np.random.default_rng(0)
+        n, F, C = 600, 20, 3
+        y = rng.integers(0, C, n).astype(np.int32)
+        base = rng.dirichlet(np.ones(F), C)  # per-class topic
+        X = np.stack([
+            rng.multinomial(40, base[c]) for c in y
+        ]).astype(np.float32)
+        return X, y
+
+    def test_matches_sklearn(self):
+        from sklearn.naive_bayes import MultinomialNB as SkMNB
+
+        from spark_bagging_tpu.models import MultinomialNB
+
+        X, y = self._count_data()
+        nb = MultinomialNB(alpha=1.0)
+        params, aux = nb.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)), 3
+        )
+        sk = SkMNB(alpha=1.0).fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(params["log_theta"]), sk.feature_log_prob_,
+            rtol=1e-4, atol=1e-5,
+        )
+        ours = np.asarray(nb.predict_scores(params, jnp.asarray(X)).argmax(1))
+        assert (ours == sk.predict(X)).mean() > 0.99
+        assert np.isfinite(float(aux["loss"]))
+
+    def test_weighted_equals_duplicated(self):
+        from spark_bagging_tpu.models import MultinomialNB
+
+        X, y = self._count_data()
+        k = np.asarray([1, 2, 3] * 200)
+        nb = MultinomialNB()
+        pw, _ = nb.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y),
+            jnp.asarray(k, jnp.float32), 3,
+        )
+        pd, _ = nb.fit_from_init(
+            KEY, jnp.asarray(np.repeat(X, k, axis=0)),
+            jnp.asarray(np.repeat(y, k), jnp.int32),
+            jnp.ones(int(k.sum())), 3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pw["log_theta"]), np.asarray(pd["log_theta"]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_in_bagging_and_mesh(self):
+        from spark_bagging_tpu import BaggingClassifier, make_mesh
+        from spark_bagging_tpu.models import MultinomialNB
+
+        X, y = self._count_data()
+        clf = BaggingClassifier(
+            base_learner=MultinomialNB(), n_estimators=16, seed=0,
+        ).fit(X, y)
+        assert clf.score(X, y) > 0.9
+        mesh = make_mesh(data=8)
+        a = BaggingClassifier(
+            base_learner=MultinomialNB(), n_estimators=1,
+            bootstrap=False, seed=0, mesh=mesh,
+        ).fit(X, y)
+        b = BaggingClassifier(
+            base_learner=MultinomialNB(), n_estimators=1,
+            bootstrap=False, seed=0,
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            a.predict_proba(X), b.predict_proba(X), rtol=1e-4, atol=1e-5
+        )
+
+    def test_invalid_alpha_raises(self):
+        from spark_bagging_tpu.models import MultinomialNB
+
+        with pytest.raises(ValueError, match="alpha"):
+            MultinomialNB(alpha=-1.0)
+
+
+class TestBernoulliNB:
+    def test_matches_sklearn(self):
+        from sklearn.naive_bayes import BernoulliNB as SkBNB
+
+        from spark_bagging_tpu.models import BernoulliNB
+
+        Xj, yj, X, y = _breast_cancer()
+        nb = BernoulliNB(alpha=1.0, binarize=0.0)
+        params, _ = nb.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 2)
+        sk = SkBNB(alpha=1.0, binarize=0.0).fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(params["log_theta"]), sk.feature_log_prob_,
+            rtol=1e-4, atol=1e-5,
+        )
+        ours = np.asarray(nb.predict_scores(params, Xj).argmax(1))
+        assert (ours == sk.predict(X)).mean() > 0.99
+
+    def test_weighted_equals_duplicated(self):
+        from spark_bagging_tpu.models import BernoulliNB
+
+        Xj, yj, X, y = _breast_cancer()
+        rng = np.random.default_rng(2)
+        k = rng.poisson(1.0, len(y))
+        k[0] = 1
+        nb = BernoulliNB()
+        pw, _ = nb.fit_from_init(
+            KEY, Xj, yj, jnp.asarray(k, jnp.float32), 2
+        )
+        pd, _ = nb.fit_from_init(
+            KEY, jnp.asarray(np.repeat(X, k, axis=0)),
+            jnp.asarray(np.repeat(y, k), jnp.int32),
+            jnp.ones(int(k.sum())), 2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pw["log_theta"]), np.asarray(pd["log_theta"]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_in_bagging_and_checkpoint(self, tmp_path):
+        from spark_bagging_tpu import BaggingClassifier, load_model, save_model
+        from spark_bagging_tpu.models import BernoulliNB
+
+        Xj, yj, X, y = _breast_cancer()
+        clf = BaggingClassifier(
+            base_learner=BernoulliNB(), n_estimators=16, seed=0,
+            max_features=0.7,
+        ).fit(X, y)
+        assert clf.score(X, y) > 0.85
+        save_model(clf, str(tmp_path / "bnb"))
+        clf2 = load_model(str(tmp_path / "bnb"))
+        np.testing.assert_allclose(
+            clf.predict_proba(X[:64]), clf2.predict_proba(X[:64]),
+            rtol=1e-6,
+        )
