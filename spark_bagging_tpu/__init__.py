@@ -27,6 +27,7 @@ from spark_bagging_tpu.models import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
     GaussianNB,
+    GeneralizedLinearRegression,
     LinearRegression,
     LinearSVC,
     LogisticRegression,
@@ -55,6 +56,7 @@ __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "GeneralizedLinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "BernoulliNB",

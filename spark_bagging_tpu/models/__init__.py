@@ -8,6 +8,7 @@ function of (params, X, y, sample_weight, key) so the ensemble engine can
 """
 
 from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.models.glm import GeneralizedLinearRegression
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
 from spark_bagging_tpu.models.mlp import MLPClassifier, MLPRegressor
@@ -26,6 +27,7 @@ __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "GeneralizedLinearRegression",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "BernoulliNB",

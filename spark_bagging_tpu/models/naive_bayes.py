@@ -166,7 +166,10 @@ class MultinomialNB(BaseLearner):
         _, w_sum, counts, log_prior = _weighted_class_counts(
             X, y, w, C, axis_name
         )
-        sm = counts + self.alpha
+        # alpha=0 with a zero (class, feature) count would give
+        # log(0) = -inf and then 0 * -inf = NaN in the score matmul;
+        # the floor keeps the cell finite (huge-negative, as intended)
+        sm = jnp.maximum(counts + self.alpha, 1e-12)
         log_theta = jnp.log(sm) - jnp.log(sm.sum(axis=1))[:, None]
         params = {"log_prior": log_prior, "log_theta": log_theta}
         loss = _weighted_nll(self, params, X, y, w, w_sum, axis_name)
@@ -225,20 +228,36 @@ class BernoulliNB(BaseLearner):
         theta = (counts + self.alpha) / (
             jnp.maximum(cls_w, 1e-12) + 2.0 * self.alpha
         )[:, None]
+        # alpha=0 can put theta at exactly 0 or 1; log/log1p would be
+        # -inf and poison scores with 0 * -inf = NaN. The margin must
+        # survive float32: 1 - 1e-12 rounds back to exactly 1.0f
+        # (nextafter(1, 0) is 1 - 6e-8), so clip a float32-wide 1e-6
+        theta = jnp.clip(theta, 1e-6, 1.0 - 1e-6)
         params = {
             "log_prior": log_prior,
             "log_theta": jnp.log(theta),
             "log_1m_theta": jnp.log1p(-theta),
         }
-        loss = _weighted_nll(self, params, Xb, y, w, w_sum, axis_name)
+        # score Xb directly — routing through predict_scores would
+        # re-binarize the already-binary matrix, corrupting the
+        # reported loss whenever binarize is outside [0, 1)
+        logp = jax.nn.log_softmax(self._scores_from_binary(params, Xb),
+                                  axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        loss = maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
         return params, {"loss": loss, "loss_curve": loss[None]}
 
-    def predict_scores(self, params, X):
-        Xb = (X > self.binarize).astype(jnp.float32)
+    @staticmethod
+    def _scores_from_binary(params, Xb):
         lt, l1m = params["log_theta"], params["log_1m_theta"]
         # Σ_f x·logθ + (1−x)·log(1−θ) = Σ log(1−θ) + x·(logθ − log(1−θ))
         return (
             params["log_prior"][None, :]
             + jnp.sum(l1m, axis=1)[None, :]
             + Xb @ (lt - l1m).T
+        )
+
+    def predict_scores(self, params, X):
+        return self._scores_from_binary(
+            params, (X > self.binarize).astype(jnp.float32)
         )

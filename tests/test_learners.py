@@ -631,3 +631,40 @@ class TestBernoulliNB:
             clf.predict_proba(X[:64]), clf2.predict_proba(X[:64]),
             rtol=1e-6,
         )
+
+
+def test_count_nb_alpha_zero_finite():
+    """alpha=0 with a zero (class, feature) count must stay finite —
+    a huge-negative log score, never NaN from 0·(−inf)."""
+    from spark_bagging_tpu.models import BernoulliNB, MultinomialNB
+
+    X = np.array([[3.0, 0.0], [2.0, 0.0], [0.0, 4.0]], np.float32)
+    y = np.array([0, 0, 1], np.int32)
+    for nb in (MultinomialNB(alpha=0.0), BernoulliNB(alpha=0.0)):
+        params, aux = nb.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(3), 2
+        )
+        scores = np.asarray(nb.predict_scores(params, jnp.asarray(X)))
+        assert np.isfinite(scores).all(), type(nb).__name__
+        assert np.isfinite(float(aux["loss"])), type(nb).__name__
+        assert (scores.argmax(1) == y).all(), type(nb).__name__
+
+
+def test_bernoulli_nb_negative_binarize_loss_sane():
+    """The reported fit loss must come from the once-binarized matrix;
+    re-binarizing {0,1} against a negative threshold corrupted it."""
+    from spark_bagging_tpu.models import BernoulliNB
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    nb = BernoulliNB(binarize=-0.5)
+    params, aux = nb.fit_from_init(
+        KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(200), 2
+    )
+    # loss should match an explicit NLL on the binarized matrix
+    Xb = (X > -0.5).astype(np.float32)
+    scores = np.asarray(nb._scores_from_binary(params, jnp.asarray(Xb)))
+    logp = scores - np.log(np.exp(scores).sum(1, keepdims=True))
+    nll = -logp[np.arange(200), y].mean()
+    assert float(aux["loss"]) == pytest.approx(nll, rel=1e-4)
