@@ -26,6 +26,8 @@ from spark_bagging_tpu.models import (
     BernoulliNB,
     DecisionTreeClassifier,
     DecisionTreeRegressor,
+    FMClassifier,
+    FMRegressor,
     GaussianNB,
     GeneralizedLinearRegression,
     LinearRegression,
@@ -57,6 +59,8 @@ __all__ = [
     "LogisticRegression",
     "LinearRegression",
     "GeneralizedLinearRegression",
+    "FMClassifier",
+    "FMRegressor",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "BernoulliNB",

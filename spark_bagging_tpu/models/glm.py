@@ -19,12 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from spark_bagging_tpu.models.base import (
-    Aux,
-    BaseLearner,
-    Params,
-    augment_bias,
-)
+from spark_bagging_tpu.models.base import BaseLearner, augment_bias
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _SOLVER_DAMPING = 1e-3
