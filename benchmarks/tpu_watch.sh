@@ -1,0 +1,21 @@
+#!/bin/bash
+# TPU-window watcher: poll backend liveness; when the tunnel revives,
+# run (1) the headline chunk sweep, (2) bench.py with tuned defaults,
+# (3) the full-scale five-config suite. Results land in benchmarks/.
+cd /root/repo
+log=benchmarks/tpu_watch.log
+echo "watch start $(date -u +%H:%M:%S)" >> $log
+while true; do
+  if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; (jnp.ones((256,256))@jnp.ones((256,256))).block_until_ready()" 2>/dev/null; then
+    echo "TPU alive $(date -u +%H:%M:%S)" >> $log
+    timeout 1800 python benchmarks/tune_headline.py >> benchmarks/tune_headline.out 2>&1
+    echo "tune done rc=$? $(date -u +%H:%M:%S)" >> $log
+    timeout 1200 python bench.py > benchmarks/bench_latest.json 2>/dev/null
+    echo "bench done rc=$? $(date -u +%H:%M:%S)" >> $log
+    timeout 3600 python benchmarks/run_configs.py --scale full --json-out benchmarks/results_full.json > benchmarks/run_full.out 2>&1
+    echo "full configs done rc=$? $(date -u +%H:%M:%S)" >> $log
+    break
+  fi
+  echo "tpu down $(date -u +%H:%M:%S)" >> $log
+  sleep 120
+done

@@ -1,0 +1,34 @@
+"""Headline tuning sweep on the real chip: blocked Hessian, chunk size
+and row-tile grid, 2 reps each (first rep pays warmup), steady-state
+fits/sec per cell. Writes benchmarks/tune_headline.json."""
+import json, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+from spark_bagging_tpu.utils.datasets import synthetic_covtype
+
+X, y = synthetic_covtype(581_012)
+mu, sigma = X.mean(0), X.std(0) + 1e-8
+X = ((X - mu) / sigma).astype(np.float32)
+results = []
+for chunk, row_tile in [(200, None), (100, None), (300, None),
+                        (400, 65536), (500, 65536)]:
+    learner = LogisticRegression(l2=1e-3, max_iter=3, precision="high",
+                                 row_tile=row_tile, hessian_impl="blocked")
+    clf = BaggingClassifier(base_learner=learner, n_estimators=1000,
+                            chunk_size=chunk, seed=0)
+    cell = {"chunk": chunk, "row_tile": row_tile, "fps": None}
+    try:
+        best = None
+        for r in range(2):
+            clf.fit(X, y)
+            rep = clf.fit_report_
+            best = min(best or 1e9, rep["fit_seconds"])
+        cell["fps"] = round(1000 / best, 1)
+        cell["acc"] = round(float(clf.score(X[:100_000], y[:100_000])), 4)
+    except Exception as e:
+        cell["error"] = f"{type(e).__name__}: {e}"[:200]
+    results.append(cell)
+    print(json.dumps(cell), flush=True)
+    with open("/root/repo/benchmarks/tune_headline.json", "w") as f:
+        json.dump(results, f, indent=1)

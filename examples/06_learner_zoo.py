@@ -1,0 +1,86 @@
+"""Every base-learner family in one run — the L3 plugin slot tour.
+
+The reference accepts any Spark ML Predictor as its base learner
+[B:5, SURVEY §1 L3]; this example fits a small bagged ensemble of each
+TPU-native family on the same data and prints train/OOB scores.
+
+Run:  python examples/06_learner_zoo.py
+"""
+
+import numpy as np
+from sklearn.datasets import load_breast_cancer, load_diabetes
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    BernoulliNB,
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    FMClassifier,
+    FMRegressor,
+    GaussianNB,
+    GeneralizedLinearRegression,
+    LinearRegression,
+    LinearSVC,
+    LogisticRegression,
+    MLPClassifier,
+    MLPRegressor,
+    MultinomialNB,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+X, y = load_breast_cancer(return_X_y=True)
+Xs = StandardScaler().fit_transform(X).astype(np.float32)
+
+print("== classification (breast-cancer, 16 bags) ==")
+classifiers = [
+    LogisticRegression(max_iter=8),
+    LinearSVC(max_iter=6),
+    DecisionTreeClassifier(max_depth=4),
+    MLPClassifier(hidden=32, max_iter=150),
+    GaussianNB(),
+    BernoulliNB(),                      # binarizes at 0 (standardized)
+    MultinomialNB(),                    # needs nonnegative features
+    FMClassifier(factor_size=4, max_iter=150, lr=0.05),
+]
+for learner in classifiers:
+    Xin = np.abs(Xs) if isinstance(learner, MultinomialNB) else Xs
+    clf = BaggingClassifier(
+        base_learner=learner, n_estimators=16, seed=0, oob_score=True
+    ).fit(Xin, y)
+    print(f"  {type(learner).__name__:<22} "
+          f"train={clf.score(Xin, y):.3f}  oob={clf.oob_score_:.3f}")
+
+rf = RandomForestClassifier(n_estimators=32, max_depth=4, oob_score=True,
+                            seed=0).fit(Xs, y)
+print(f"  {'RandomForestClassifier':<22} train={rf.score(Xs, y):.3f}  "
+      f"oob={rf.oob_score_:.3f}")
+
+Xd, yd = load_diabetes(return_X_y=True)
+Xd = StandardScaler().fit_transform(Xd).astype(np.float32)
+# gradient learners (MLP/FM) want O(1) targets; GLM-poisson wants a
+# positive mean near 1 — same standard practice as any framework
+yz = ((yd - yd.mean()) / yd.std()).astype(np.float32)
+yp = (yd / yd.mean()).astype(np.float32)
+yd = yd.astype(np.float32)
+
+print("== regression (diabetes, 16 bags) ==")
+regressors = [
+    (LinearRegression(), yd),
+    (GeneralizedLinearRegression(family="gaussian"), yd),
+    (GeneralizedLinearRegression(family="poisson", max_iter=20), yp),
+    (DecisionTreeRegressor(max_depth=4), yd),
+    (MLPRegressor(hidden=32, max_iter=300), yz),
+    (FMRegressor(factor_size=4, max_iter=300, lr=0.03), yz),
+]
+for learner, target in regressors:
+    reg = BaggingRegressor(
+        base_learner=learner, n_estimators=16, seed=0
+    ).fit(Xd, target)
+    print(f"  {type(learner).__name__:<28} "
+          f"({getattr(learner, 'family', ''):<8}) r2={reg.score(Xd, target):.3f}")
+
+rfr = RandomForestRegressor(n_estimators=32, max_depth=4, seed=0).fit(Xd, yd)
+print(f"  {'RandomForestRegressor':<28} {'':<10} r2={rfr.score(Xd, yd):.3f}")

@@ -28,6 +28,8 @@ from spark_bagging_tpu.models import (
     DecisionTreeRegressor,
     FMClassifier,
     FMRegressor,
+    GBTClassifier,
+    GBTRegressor,
     GaussianNB,
     GeneralizedLinearRegression,
     LinearRegression,
@@ -61,6 +63,8 @@ __all__ = [
     "GeneralizedLinearRegression",
     "FMClassifier",
     "FMRegressor",
+    "GBTClassifier",
+    "GBTRegressor",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "BernoulliNB",

@@ -628,6 +628,7 @@ class _BaseBagging(ParamsMixin):
                 )
             if (
                 isinstance(learner, _TreeBase)
+                and learner.tree_streamable
                 and self.mesh.shape.get(DATA_AXIS, 1) > 1
             ):
                 raise ValueError(
@@ -638,7 +639,7 @@ class _BaseBagging(ParamsMixin):
         n_subspace = self._n_subspace(source.n_features)
         key = jax.random.key(self.seed)
         t0 = time.perf_counter()
-        if isinstance(learner, _TreeBase):
+        if isinstance(learner, _TreeBase) and learner.tree_streamable:
             # structure-search learners stream through the multi-pass
             # level-synchronous engine (tree_stream.py), not SGD
             from spark_bagging_tpu.tree_stream import (

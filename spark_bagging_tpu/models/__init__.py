@@ -9,6 +9,7 @@ function of (params, X, y, sample_weight, key) so the ensemble engine can
 
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.fm import FMClassifier, FMRegressor
+from spark_bagging_tpu.models.gbt import GBTClassifier, GBTRegressor
 from spark_bagging_tpu.models.glm import GeneralizedLinearRegression
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
@@ -31,6 +32,8 @@ __all__ = [
     "GeneralizedLinearRegression",
     "FMClassifier",
     "FMRegressor",
+    "GBTClassifier",
+    "GBTRegressor",
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "BernoulliNB",

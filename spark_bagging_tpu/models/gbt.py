@@ -1,0 +1,225 @@
+"""Gradient-boosted trees — Spark ML ``GBTClassifier``/``GBTRegressor``.
+
+Spark ships GBTs as stock Predictors the reference can bag [B:5,
+SURVEY §1 L3]. The TPU-native formulation is Newton boosting over the
+existing static-shape tree machinery (models/tree.py): every round
+grows one depth-bounded tree on the current pseudo-residuals, and the
+whole boosting loop is a ``lax.scan`` — one traced round body, M
+iterations, no Python-side dynamism — so a full GBT fit jits and
+``vmap``s over bagging replicas like any other learner.
+
+The reduction to the existing tree engine is exact: Newton boosting
+fits each tree to targets ``z = −g/h`` under row weights ``h`` (the
+per-row loss Hessian). The regression tree's weighted-SSE split
+criterion on ``(h, h·z)`` is then precisely the XGBoost-style gain
+``G_L²/H_L + G_R²/H_R`` (the ``Σ g²/h`` term is split-invariant), and
+the weighted-mean leaf value is the Newton step ``−G/H``. Quantile bin
+edges are computed ONCE (`prepare`) and shared by all rounds and all
+replicas — the histogram-GBT standard.
+
+Per-round FLOPs are the tree's level contractions (MXU matmuls / the
+Pallas fused kernel); sample weights carry exact Poisson bootstrap
+multiplicities through ``h``; every row reduction rides ``maybe_psum``
+[SURVEY §7 hard-part 2, §5 comms].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.models.tree import DecisionTreeRegressor, _EPS
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+_HESS_FLOOR = 1e-6  # saturated sigmoid ⇒ h→0; floor keeps z=−g/h finite
+
+
+class _GBTBase(DecisionTreeRegressor):
+    """Shared boosting engine (see module docstring).
+
+    Parameters mirror Spark's: ``n_rounds`` (maxIter), ``lr``
+    (stepSize), ``max_depth``, plus the tree engine's ``n_bins`` /
+    ``split_impl`` / ``feature_subset`` knobs.
+    """
+
+    streamable = False  # structure search per round, like the trees
+    # NOT tree-streamable: fitted params are R stacked trees + f0, not
+    # the single tree the tree-stream engine grows — routing there
+    # would fit the wrong model and crash predict (params mismatch)
+    tree_streamable = False
+
+    def __init__(
+        self,
+        n_rounds: int = 20,
+        max_depth: int = 5,
+        lr: float = 0.1,
+        n_bins: int = 32,
+        hist_dtype: str = "bfloat16",
+        precision: str = "highest",
+        split_impl: str = "auto",
+        feature_subset: str | float | int | None = None,
+    ):
+        super().__init__(
+            max_depth, n_bins, hist_dtype, precision, split_impl,
+            feature_subset,
+        )
+        if n_rounds < 1:
+            raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
+        self.n_rounds = n_rounds
+        self.lr = lr
+
+    # -- per-task hooks -------------------------------------------------
+
+    def _init_margin(self, y, w, w_sum, axis_name):
+        raise NotImplementedError
+
+    def _pseudo(self, y, F, w):
+        """(h, z): Newton row weights and targets at margin F."""
+        raise NotImplementedError
+
+    def _round_loss(self, y, F, w, w_sum, axis_name):
+        raise NotImplementedError
+
+    # -- BaseLearner contract ------------------------------------------
+
+    def init_params(self, key, n_features, n_outputs):
+        del key, n_outputs
+        M = 2**self.max_depth - 1
+        L = 2**self.max_depth
+        R = self.n_rounds
+        return {
+            "f0": jnp.zeros((), jnp.float32),
+            # flat (R·M,) so the bagging-level feature_importances_
+            # reads gains/features exactly as it does for single trees
+            "feature": jnp.zeros((R * M,), jnp.int32),
+            "threshold": jnp.zeros((R * M,), jnp.float32),
+            "gain": jnp.zeros((R * M,), jnp.float32),
+            "leaf": jnp.zeros((R, L), jnp.float32),
+        }
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        del n_outputs
+        # every round contracts K=3 moment stats (h, h·z, h·z²)
+        # regardless of task — the inherited tree model would undercount
+        # the classifier by K=2/3
+        nodes_total = 2**self.max_depth - 1
+        one_tree = 2 * n_rows * n_features * self.n_bins * 3 * nodes_total
+        return float(self.n_rounds * one_tree)
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del params
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        yf = y.astype(jnp.float32)
+        w = sample_weight.astype(jnp.float32)
+        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        f0 = self._init_margin(yf, w, w_sum, axis_name)
+        n = X.shape[0]
+
+        def round_body(F, m):
+            h, z = self._pseudo(yf, F, w)
+            S = jnp.stack([h, h * z, h * z * z], axis=1)
+            key_m = (
+                jax.random.fold_in(key, m) if key is not None else None
+            )
+            feat, thr, gain, node, _curve = self._grow(
+                X, S, prepared, axis_name, key_m
+            )
+            stats = self._leaf_stats(node, S, axis_name)   # (L, 3)
+            # Newton leaf step −G/H == weighted mean of z under h;
+            # empty leaves emit 0 (no update), not a global fallback
+            leaf = jnp.where(
+                stats[:, 0] > 0,
+                stats[:, 1] / jnp.maximum(stats[:, 0], _EPS),
+                0.0,
+            )
+            F = F + self.lr * leaf[node]
+            loss = self._round_loss(yf, F, w, w_sum, axis_name)
+            return F, (feat, thr, gain, leaf, loss)
+
+        F0 = jnp.full((n,), f0, jnp.float32)
+        _, (feats, thrs, gains, leaves, losses) = jax.lax.scan(
+            round_body, F0, jnp.arange(self.n_rounds)
+        )
+        new = {
+            "f0": f0,
+            "feature": feats.reshape(-1),
+            "threshold": thrs.reshape(-1),
+            "gain": gains.reshape(-1).astype(jnp.float32),
+            "leaf": leaves.astype(jnp.float32),
+        }
+        return new, {"loss": losses[-1], "loss_curve": losses}
+
+    def _margin(self, params, X):
+        """Σ_m lr·leaf_m[route_m(x)] + f0 via a scan over rounds."""
+        M = 2**self.max_depth - 1
+        R = self.n_rounds
+        feats = params["feature"].reshape(R, M)
+        thrs = params["threshold"].reshape(R, M)
+        leaves = params["leaf"]
+
+        def one_round(acc, xs):
+            f, t, lv = xs
+            rel = self._route({"feature": f, "threshold": t}, X)
+            return acc + self.lr * lv[rel], None
+
+        acc0 = jnp.full((X.shape[0],), params["f0"], jnp.float32)
+        total, _ = jax.lax.scan(one_round, acc0, (feats, thrs, leaves))
+        return total
+
+
+class GBTRegressor(_GBTBase):
+    """Least-squares Newton boosting (h = w, z = residual)."""
+
+    task = "regression"
+
+    def _init_margin(self, y, w, w_sum, axis_name):
+        return maybe_psum(jnp.sum(w * y), axis_name) / w_sum
+
+    def _pseudo(self, y, F, w):
+        return w, y - F
+
+    def _round_loss(self, y, F, w, w_sum, axis_name):
+        return maybe_psum(jnp.sum(w * (y - F) ** 2), axis_name) / w_sum
+
+    def predict_scores(self, params, X):
+        return self._margin(params, X)
+
+
+class GBTClassifier(_GBTBase):
+    """Binary logistic Newton boosting (Spark GBTClassifier is also
+    binary-only). ``predict_scores`` returns ``(n, 2)`` logits
+    ``[0, margin]`` so softmax reproduces the sigmoid probabilities
+    for the ensemble's soft voting."""
+
+    task = "classification"
+
+    def init_params(self, key, n_features, n_outputs):
+        if n_outputs != 2:
+            raise ValueError(
+                f"GBTClassifier is binary-only (got {n_outputs} "
+                "classes), matching Spark ML's GBTClassifier"
+            )
+        return super().init_params(key, n_features, n_outputs)
+
+    def _init_margin(self, y, w, w_sum, axis_name):
+        p = jnp.clip(
+            maybe_psum(jnp.sum(w * y), axis_name) / w_sum, 1e-6, 1 - 1e-6
+        )
+        return jnp.log(p / (1.0 - p))
+
+    def _pseudo(self, y, F, w):
+        p = jax.nn.sigmoid(F)
+        h_unit = jnp.maximum(p * (1.0 - p), _HESS_FLOOR)
+        return w * h_unit, (y - p) / h_unit
+
+    def _round_loss(self, y, F, w, w_sum, axis_name):
+        # weighted mean logistic loss: softplus(F) − y·F
+        return maybe_psum(
+            jnp.sum(w * (jax.nn.softplus(F) - y * F)), axis_name
+        ) / w_sum
+
+    def predict_scores(self, params, X):
+        m = self._margin(params, X)
+        return jnp.stack([jnp.zeros_like(m), m], axis=1)

@@ -114,6 +114,12 @@ class _TreeBase(BaseLearner):
       ~256 MB, else ``"dense"``.
     """
 
+    # single trees stream through the multi-pass level-synchronous
+    # engine (tree_stream.py); subclasses whose fitted params are NOT
+    # one tree (boosting) must opt out or fit_stream would grow a
+    # single tree and predict would read garbage
+    tree_streamable = True
+
     def __init__(
         self,
         max_depth: int = 5,
