@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Benchmark CLI: run the five BASELINE eval configs [B:7-11, SURVEY §7
-step 9] and emit the BASELINE.md results table.
+step 9] plus two beyond-BASELINE rows (random forest, bagged GBT) and
+emit the BASELINE.md results table.
 
 Usage::
 
@@ -243,17 +244,104 @@ def config_5(scale: str) -> dict:
     }
 
 
-CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+def config_6(scale: str) -> dict:
+    """RandomForestClassifier (per-split feature sampling), covtype
+    signature — beyond-BASELINE row showing the forest path end to end."""
+    from spark_bagging_tpu import RandomForestClassifier
+    from spark_bagging_tpu.utils.datasets import synthetic_covtype
+
+    n_rows = 581_012 if scale == "full" else 20_000
+    n_estimators = 128 if scale == "full" else 16
+    chunk = 32 if scale == "full" else None
+    X, y = synthetic_covtype(n_rows)
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    clf = RandomForestClassifier(
+        n_estimators=n_estimators, max_depth=5, feature_subset="sqrt",
+        chunk_size=chunk, seed=0,
+    )
+    clf.fit(Xtr, ytr)
+    acc = clf.score(Xte, yte)
+    rep = clf.fit_report_
+    return {
+        "config": 6,
+        "name": f"rf_d5_bag{n_estimators}_covtype{n_rows // 1000}k",
+        "metric": "accuracy",
+        "value": round(acc, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+    }
+
+
+def config_7(scale: str) -> dict:
+    """Bagged GBTClassifier on a HIGGS-signature binary task —
+    beyond-BASELINE row: boosting inside the bagging loop."""
+    from spark_bagging_tpu import BaggingClassifier, GBTClassifier
+    from spark_bagging_tpu.utils.datasets import synthetic_higgs
+    from spark_bagging_tpu.utils.metrics import roc_auc
+
+    n_rows = 1_000_000 if scale == "full" else 20_000
+    n_estimators = 32 if scale == "full" else 4
+    n_rounds = 30 if scale == "full" else 10
+    chunk = 4 if scale == "full" else None
+    X, y = synthetic_higgs(n_rows)
+    X = _standardize(X)
+    Xtr, ytr, Xte, yte = _split(X, y)
+
+    clf = BaggingClassifier(
+        base_learner=GBTClassifier(n_rounds=n_rounds, max_depth=4),
+        n_estimators=n_estimators, chunk_size=chunk, seed=0,
+    )
+    clf.fit(Xtr, ytr)
+    auc = roc_auc(yte, clf.predict_proba(Xte)[:, 1])
+    rep = clf.fit_report_
+    return {
+        "config": 7,
+        "name": f"gbt{n_rounds}_bag{n_estimators}_higgs{n_rows // 1000}k",
+        "metric": "auc",
+        "value": round(auc, 4),
+        "fits_per_sec": round(rep["fits_per_sec"], 2),
+        "fit_seconds": round(rep["fit_seconds"], 4),
+        "compile_seconds": round(rep["compile_seconds"], 2),
+    }
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4,
+           5: config_5, 6: config_6, 7: config_7}
 
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--configs", default="1,2,3,4,5")
+    p.add_argument("--configs", default="1,2,3,4,5,6,7")
     p.add_argument("--scale", choices=["smoke", "full"], default="smoke")
     p.add_argument("--json-out", default=None)
+    p.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu' when the TPU is down)",
+    )
+    p.add_argument("--probe-timeout", type=float, default=120.0)
     args = p.parse_args()
 
+    # The ambient TPU plugin can block FOREVER in client init when the
+    # tunnel is down (bench.py's probe protocol [VERDICT r1 weak#1]);
+    # probe in a subprocess first and fail fast with a JSON error.
+    from bench import probe_backend
+
+    backend, reason = probe_backend(
+        args.probe_timeout, platform=args.platform
+    )
+    if backend is None:
+        print(json.dumps({
+            "error": f"jax backend unavailable — {reason}",
+        }))
+        sys.exit(1)
+
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     wanted = [int(c) for c in args.configs.split(",")]
     out = args.json_out or os.path.join(

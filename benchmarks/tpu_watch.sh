@@ -1,7 +1,7 @@
 #!/bin/bash
 # TPU-window watcher: poll backend liveness; when the tunnel revives,
 # run (1) the headline chunk sweep, (2) bench.py with tuned defaults,
-# (3) the full-scale five-config suite. Results land in benchmarks/.
+# (3) all-7-config smoke suite, (4) the full-scale suite.
 cd /root/repo
 log=benchmarks/tpu_watch.log
 echo "watch start $(date -u +%H:%M:%S)" >> $log
@@ -12,7 +12,9 @@ while true; do
     echo "tune done rc=$? $(date -u +%H:%M:%S)" >> $log
     timeout 1200 python bench.py > benchmarks/bench_latest.json 2>/dev/null
     echo "bench done rc=$? $(date -u +%H:%M:%S)" >> $log
-    timeout 3600 python benchmarks/run_configs.py --scale full --json-out benchmarks/results_full.json > benchmarks/run_full.out 2>&1
+    timeout 1800 python benchmarks/run_configs.py --scale smoke > benchmarks/run_smoke.out 2>&1
+    echo "smoke configs done rc=$? $(date -u +%H:%M:%S)" >> $log
+    timeout 5400 python benchmarks/run_configs.py --scale full --json-out benchmarks/results_full.json > benchmarks/run_full.out 2>&1
     echo "full configs done rc=$? $(date -u +%H:%M:%S)" >> $log
     break
   fi

@@ -1,8 +1,9 @@
 """Headline tuning sweep on the real chip: blocked Hessian, chunk size
 and row-tile grid, 2 reps each (first rep pays warmup), steady-state
 fits/sec per cell. Writes benchmarks/tune_headline.json."""
-import json, sys, time
-sys.path.insert(0, "/root/repo")
+import json, os, sys
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 import numpy as np
 from spark_bagging_tpu import BaggingClassifier, LogisticRegression
 from spark_bagging_tpu.utils.datasets import synthetic_covtype
@@ -30,5 +31,5 @@ for chunk, row_tile in [(200, None), (100, None), (300, None),
         cell["error"] = f"{type(e).__name__}: {e}"[:200]
     results.append(cell)
     print(json.dumps(cell), flush=True)
-    with open("/root/repo/benchmarks/tune_headline.json", "w") as f:
+    with open(os.path.join(REPO, "benchmarks", "tune_headline.json"), "w") as f:
         json.dump(results, f, indent=1)

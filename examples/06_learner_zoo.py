@@ -19,6 +19,8 @@ from spark_bagging_tpu import (
     DecisionTreeRegressor,
     FMClassifier,
     FMRegressor,
+    GBTClassifier,
+    GBTRegressor,
     GaussianNB,
     GeneralizedLinearRegression,
     LinearRegression,
@@ -44,6 +46,7 @@ classifiers = [
     BernoulliNB(),                      # binarizes at 0 (standardized)
     MultinomialNB(),                    # needs nonnegative features
     FMClassifier(factor_size=4, max_iter=150, lr=0.05),
+    GBTClassifier(n_rounds=15, max_depth=3),
 ]
 for learner in classifiers:
     Xin = np.abs(Xs) if isinstance(learner, MultinomialNB) else Xs
@@ -74,6 +77,7 @@ regressors = [
     (DecisionTreeRegressor(max_depth=4), yd),
     (MLPRegressor(hidden=32, max_iter=300), yz),
     (FMRegressor(factor_size=4, max_iter=300, lr=0.03), yz),
+    (GBTRegressor(n_rounds=20, max_depth=3), yd),
 ]
 for learner, target in regressors:
     reg = BaggingRegressor(

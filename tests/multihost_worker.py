@@ -61,6 +61,16 @@ def main() -> None:
     sclf.fit_stream(ArrayChunks(X, y, chunk_rows=128), n_epochs=8, lr=0.05)
     stream_acc = float(sclf.score(X, y))
 
+    # tree-structured learner across processes: quantile prepare()
+    # psums per-shard bin edges over the process-spanning data axis,
+    # per-split feature masks draw from replica fit keys
+    from spark_bagging_tpu import RandomForestClassifier
+
+    rf = RandomForestClassifier(
+        n_estimators=4, max_depth=3, seed=1, mesh=mesh,
+    ).fit(X, y)
+    rf_acc = float(rf.score(X, y))
+
     with open(f"{out_path}.{pid}", "w") as f:
         json.dump({
             "process_id": pid,
@@ -70,6 +80,7 @@ def main() -> None:
             "proba_head": np.asarray(proba[:16]).tolist(),
             "losses_mean": float(np.mean(clf.fit_report_["loss_mean"])),
             "stream_accuracy": stream_acc,
+            "rf_accuracy": rf_acc,
         }, f)
 
 

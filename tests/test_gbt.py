@@ -172,9 +172,7 @@ class TestGBTClassifier:
             rtol=1e-6,
         )
 
-    def test_param_validation(self):
-        with pytest.raises(ValueError, match="n_rounds"):
-            GBTRegressor(n_rounds=0)
+
 
 
     def test_fit_stream_rejected_cleanly(self):
@@ -191,3 +189,12 @@ class TestGBTClassifier:
         )
         with pytest.raises(TypeError, match="stream"):
             reg.fit_stream(src)
+
+
+def test_n_rounds_validation():
+    """Shared _GBTBase validation, outside either task's test class so
+    class-filtered runs still cover it."""
+    with pytest.raises(ValueError, match="n_rounds"):
+        GBTRegressor(n_rounds=0)
+    with pytest.raises(ValueError, match="n_rounds"):
+        GBTClassifier(n_rounds=-1)

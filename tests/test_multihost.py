@@ -113,3 +113,11 @@ def test_multihost_stream_fit(worker_results):
         r1["stream_accuracy"], abs=1e-9
     )
     assert r0["stream_accuracy"] > 0.9
+
+
+def test_multihost_forest_fit(worker_results):
+    """Tree growth (quantile prepare + per-split masks) over the
+    2-process mesh trains to quality and both processes agree."""
+    a, b = worker_results
+    assert a["rf_accuracy"] == pytest.approx(b["rf_accuracy"], abs=1e-6)
+    assert a["rf_accuracy"] > 0.9
