@@ -38,8 +38,11 @@ class _GBTBase(DecisionTreeRegressor):
     """Shared boosting engine (see module docstring).
 
     Parameters mirror Spark's: ``n_rounds`` (maxIter), ``lr``
-    (stepSize), ``max_depth``, plus the tree engine's ``n_bins`` /
-    ``split_impl`` / ``feature_subset`` knobs.
+    (stepSize), ``max_depth``, ``subsample`` (subsamplingRate — each
+    round trains on an independent Bernoulli row subset drawn from the
+    round key, the stochastic-gradient-boosting regularizer), plus the
+    tree engine's ``n_bins`` / ``split_impl`` / ``feature_subset``
+    knobs.
     """
 
     streamable = False  # structure search per round, like the trees
@@ -53,6 +56,7 @@ class _GBTBase(DecisionTreeRegressor):
         n_rounds: int = 20,
         max_depth: int = 5,
         lr: float = 0.1,
+        subsample: float = 1.0,
         n_bins: int = 32,
         hist_dtype: str = "bfloat16",
         precision: str = "highest",
@@ -65,8 +69,13 @@ class _GBTBase(DecisionTreeRegressor):
         )
         if n_rounds < 1:
             raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
+        if not 0.0 < subsample <= 1.0:
+            raise ValueError(
+                f"subsample must be in (0, 1], got {subsample}"
+            )
         self.n_rounds = n_rounds
         self.lr = lr
+        self.subsample = subsample
 
     # -- per-task hooks -------------------------------------------------
 
@@ -109,6 +118,11 @@ class _GBTBase(DecisionTreeRegressor):
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
         del params
+        if self.subsample < 1.0 and key is None:
+            raise ValueError(
+                "subsample < 1 draws per-round row subsets from the "
+                "replica fit key; fit was called with key=None"
+            )
         if prepared is None:
             prepared = self.prepare(X, axis_name=axis_name)
         yf = y.astype(jnp.float32)
@@ -119,10 +133,28 @@ class _GBTBase(DecisionTreeRegressor):
 
         def round_body(F, m):
             h, z = self._pseudo(yf, F, w)
-            S = jnp.stack([h, h * z, h * z * z], axis=1)
             key_m = (
                 jax.random.fold_in(key, m) if key is not None else None
             )
+            if self.subsample < 1.0:
+                # stochastic GBT: this round sees an independent
+                # Bernoulli row subset; dropped rows carry zero weight
+                # through every split statistic and leaf sum
+                mask_key = jax.random.fold_in(key_m, 0x5B)
+                if axis_name is not None:
+                    # per-row sharded draws must decorrelate shards
+                    # (the ensemble.py/tree_stream.py convention) —
+                    # every shard holds different rows, so an identical
+                    # local keep pattern would bias the subset
+                    mask_key = jax.random.fold_in(
+                        mask_key, jax.lax.axis_index(axis_name)
+                    )
+                keep = (
+                    jax.random.uniform(mask_key, (h.shape[0],))
+                    < self.subsample
+                ).astype(jnp.float32)
+                h = h * keep
+            S = jnp.stack([h, h * z, h * z * z], axis=1)
             feat, thr, gain, node, _curve = self._grow(
                 X, S, prepared, axis_name, key_m
             )

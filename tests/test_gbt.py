@@ -198,3 +198,53 @@ def test_n_rounds_validation():
         GBTRegressor(n_rounds=0)
     with pytest.raises(ValueError, match="n_rounds"):
         GBTClassifier(n_rounds=-1)
+
+
+def test_subsample_stochastic_rounds():
+    """subsample<1 draws an independent Bernoulli row subset per round:
+    the fit must differ from the deterministic one, stay finite, and
+    still train well; subsample outside (0,1] is rejected."""
+    X, y = _friedman(n=400)
+    full = GBTRegressor(n_rounds=20, max_depth=3, lr=0.2)
+    sub = GBTRegressor(n_rounds=20, max_depth=3, lr=0.2, subsample=0.6)
+    pf, _ = full.fit_from_init(
+        KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)), 1
+    )
+    ps, _ = sub.fit_from_init(
+        KEY, jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)), 1
+    )
+    a = np.asarray(full.predict_scores(pf, jnp.asarray(X)))
+    b = np.asarray(sub.predict_scores(ps, jnp.asarray(X)))
+    assert not np.allclose(a, b)
+    r2 = 1 - np.var(b - y) / np.var(y)
+    assert r2 > 0.85
+    with pytest.raises(ValueError, match="subsample"):
+        GBTRegressor(subsample=0.0)
+    with pytest.raises(ValueError, match="subsample"):
+        GBTRegressor(subsample=1.5)
+
+
+def test_subsample_keyless_fit_rejected():
+    X, y = _friedman(n=64)
+    gbt = GBTRegressor(n_rounds=2, max_depth=2, subsample=0.5)
+    p0 = gbt.init_params(KEY, X.shape[1], 1)
+    with pytest.raises(ValueError, match="key"):
+        gbt.fit(p0, jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)),
+                None)
+
+
+def test_subsample_sharded_decorrelated():
+    """Each data shard must draw its own keep mask (sharded fit would
+    otherwise bias the round subsets by local row position)."""
+    from spark_bagging_tpu import BaggingRegressor, make_mesh
+
+    X, y = _friedman(n=256)
+    mesh = make_mesh(data=8)
+    reg = BaggingRegressor(
+        base_learner=GBTRegressor(n_rounds=20, max_depth=2, subsample=0.5),
+        n_estimators=1, bootstrap=False, seed=0, mesh=mesh,
+    ).fit(X, y)
+    pred = reg.predict(X)
+    assert np.isfinite(pred).all()
+    r2 = 1 - np.var(pred - y) / np.var(y)
+    assert r2 > 0.5
