@@ -18,6 +18,10 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -57,6 +61,41 @@ struct LineReader {
     while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = 0;
     return buf;
   }
+};
+
+// CRC-32 (IEEE 802.3), bit-identical to Python's zlib.crc32(data, crc):
+// the hashed-CSV reader must produce the same slots/signs as the Python
+// FeatureHasher (utils/hashing.py) or native and fallback ingestion
+// would silently train on different features.
+inline uint32_t crc32_update(uint32_t crc, const char* buf, size_t len) {
+  static const auto table = [] {
+    std::vector<uint32_t> t(256);
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i)
+    crc = table[(crc ^ static_cast<uint8_t>(buf[i])) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// per-categorical-column memo: value -> (slot, sign); size-capped like
+// the Python FeatureHasher (Criteo columns reach 10M+ uniques)
+constexpr size_t kMemoCap = 1u << 20;
+
+struct HashedSpec {
+  std::vector<int64_t> numeric, categorical;
+  int64_t n_hash = 0;
+  uint32_t seed = 0;
+  char delim = ',';
+  int64_t max_col = 0;
+  std::vector<std::unordered_map<std::string, std::pair<int64_t, float>>>
+      memo;
 };
 
 // does the line hold anything besides whitespace/comment?
@@ -108,14 +147,96 @@ inline int csv_parse_line(const char* line, float* dst, int64_t n_cols) {
 
 struct Reader {
   LineReader lr;
-  int fmt;  // 0 = libsvm, 1 = csv
+  int fmt;  // 0 = libsvm, 1 = csv, 2 = hashed csv
   int64_t n_features = 0;
   int64_t n_cols = 0;  // csv: total columns incl. label
   int64_t label_col = -1;
   int zero_based = 0;
+  HashedSpec* hspec = nullptr;
 
   Reader(const char* path, int fmt_) : lr(path), fmt(fmt_) {}
+  ~Reader() { delete hspec; }
 };
+
+// split a line on spec.delim into (start, len) fields
+inline void split_fields(const char* line, char delim,
+                         std::vector<std::pair<const char*, size_t>>* out) {
+  out->clear();
+  const char* start = line;
+  const char* p = line;
+  for (;; ++p) {
+    if (*p == delim || *p == 0) {
+      out->emplace_back(start, static_cast<size_t>(p - start));
+      if (*p == 0) break;
+      start = p + 1;
+    }
+  }
+}
+
+// float() parity with the Python fallback: surrounding whitespace ok,
+// anything else trailing is an error; empty field -> 0 handled by the
+// caller. strtof extensions Python rejects are rejected here too
+// (C99 hex floats); underscored literals are rejected on BOTH paths
+// (the fallback mirrors this) so native and Python never diverge.
+inline bool parse_field_float(const char* s, size_t len, float* out) {
+  std::string tmp(s, len);  // NUL-terminate for strtof
+  for (char ch : tmp)
+    if (ch == 'x' || ch == 'X' || ch == '_') return false;
+  const char* p = tmp.c_str();
+  char* end = nullptr;
+  *out = strtof(p, &end);
+  if (end == p) return false;
+  while (*end == ' ' || *end == '\t') ++end;
+  return *end == 0;
+}
+
+// one hashed-CSV row: numeric passthrough + signed-hash accumulation.
+// xrow must be zeroed by the caller (signs ACCUMULATE into slots).
+inline int hashed_parse_row(
+    HashedSpec* h,
+    const std::vector<std::pair<const char*, size_t>>& fields,
+    int64_t label_col, float* xrow, float* y) {
+  if (static_cast<int64_t>(fields.size()) <= h->max_col) return kErrParse;
+  auto [lp, ll] = fields[label_col];
+  if (ll == 0) {
+    *y = 0.0f;
+  } else if (!parse_field_float(lp, ll, y)) {
+    return kErrParse;
+  }
+  for (size_t j = 0; j < h->numeric.size(); ++j) {
+    auto [fp, fl] = fields[h->numeric[j]];
+    if (fl == 0) {
+      xrow[j] = 0.0f;  // empty field -> 0, the Criteo convention
+    } else if (!parse_field_float(fp, fl, &xrow[j])) {
+      return kErrParse;
+    }
+  }
+  float* hash_base = xrow + h->numeric.size();
+  for (size_t j = 0; j < h->categorical.size(); ++j) {
+    auto [fp, fl] = fields[h->categorical[j]];
+    std::string value(fp, fl);
+    auto& memo = h->memo[j];
+    auto it = memo.find(value);
+    int64_t slot;
+    float sign;
+    if (it != memo.end()) {
+      slot = it->second.first;
+      sign = it->second.second;
+    } else {
+      // token layout matches utils/hashing.py: "<j>=<value>" where j
+      // is the position within the categorical list
+      std::string token = std::to_string(j) + "=" + value;
+      slot = crc32_update(h->seed, token.data(), token.size()) % h->n_hash;
+      token.push_back('#');
+      sign = (crc32_update(h->seed, token.data(), token.size()) & 1)
+                 ? 1.0f : -1.0f;
+      if (memo.size() < kMemoCap) memo.emplace(std::move(value),
+                                               std::make_pair(slot, sign));
+    }
+    hash_base[slot] += sign;
+  }
+  return 0;
+}
 
 }  // namespace
 
@@ -170,6 +291,26 @@ int svm_fill(const char* path, int zero_based, int64_t n_rows,
     ++i;
   }
   return i == n_rows ? 0 : kErrParse;
+}
+
+// non-blank data-line count (hashed-CSV n_rows pass; no float parsing,
+// so categorical columns are fine)
+int64_t csv_count_rows(const char* path, int skip_header) {
+  LineReader lr(path);
+  if (!lr.ok()) return kErrOpen;
+  int64_t n = 0;
+  bool skipped = !skip_header;
+  while (const char* line = lr.next()) {
+    const char* p = line;
+    skip_ws(p);
+    if (*p == 0) continue;
+    if (!skipped) {
+      skipped = true;
+      continue;
+    }
+    ++n;
+  }
+  return n;
 }
 
 // ---- whole-file csv ----------------------------------------------------
@@ -283,8 +424,50 @@ void* reader_open_csv(const char* path, int64_t n_cols, int64_t label_col,
   return r;
 }
 
+void* reader_open_csv_hashed(const char* path, int64_t label_col,
+                             const int64_t* numeric, int64_t n_numeric,
+                             const int64_t* categorical, int64_t n_cat,
+                             int64_t n_hash, int64_t seed, char delim,
+                             int skip_header) {
+  if (label_col < 0 || n_hash < 2 || (n_numeric <= 0 && n_cat <= 0))
+    return nullptr;
+  Reader* r = new Reader(path, 2);
+  if (!r->lr.ok()) {
+    delete r;
+    return nullptr;
+  }
+  auto* h = new HashedSpec;
+  h->numeric.assign(numeric, numeric + n_numeric);
+  h->categorical.assign(categorical, categorical + n_cat);
+  h->n_hash = n_hash;
+  h->seed = static_cast<uint32_t>(seed);
+  h->delim = delim;
+  h->max_col = label_col;
+  for (int64_t c : h->numeric) {
+    if (c < 0) { delete h; delete r; return nullptr; }
+    if (c > h->max_col) h->max_col = c;
+  }
+  for (int64_t c : h->categorical) {
+    if (c < 0) { delete h; delete r; return nullptr; }
+    if (c > h->max_col) h->max_col = c;
+  }
+  h->memo.resize(h->categorical.size());
+  r->hspec = h;
+  r->label_col = label_col;
+  r->n_features = n_numeric + (n_cat > 0 ? n_hash : 0);
+  if (skip_header) {
+    while (const char* line = r->lr.next()) {
+      const char* p = line;
+      skip_ws(p);
+      if (*p != 0) break;
+    }
+  }
+  return r;
+}
+
 // reads up to max_rows rows into X (max_rows * n_features, caller-zeroed
-// for libsvm) and y; returns rows read (0 at EOF) or a negative error
+// for libsvm and hashed csv) and y; returns rows read (0 at EOF) or a
+// negative error
 int64_t reader_next(void* handle, int64_t max_rows, float* X, float* y) {
   Reader* r = static_cast<Reader*>(handle);
   if (!r || !X || !y) return kErrArg;
@@ -304,6 +487,13 @@ int64_t reader_next(void* handle, int64_t max_rows, float* X, float* y) {
       if (!svm_line_nonempty(line)) continue;
       int rc = svm_parse_line(line, &y[i], &X[i * r->n_features],
                               r->n_features, r->zero_based);
+      if (rc != 0) return rc;
+    } else if (r->fmt == 2) {
+      static thread_local std::vector<std::pair<const char*, size_t>>
+          fields;
+      split_fields(line, r->hspec->delim, &fields);
+      int rc = hashed_parse_row(r->hspec, fields, r->label_col,
+                                &X[i * r->n_features], &y[i]);
       if (rc != 0) return rc;
     } else {
       int rc = csv_parse_line(line, tmp, r->n_cols);

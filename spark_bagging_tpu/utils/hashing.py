@@ -146,6 +146,15 @@ class HashedCSVChunks(ChunkSource):
         self.n_rows = self._count_rows() if n_rows is None else int(n_rows)
 
     def _count_rows(self) -> int:
+        from spark_bagging_tpu.utils.native import get_lib
+
+        lib = get_lib()
+        if lib is not None:
+            n = lib.csv_count_rows(
+                self._path.encode(), int(self._skip_header)
+            )
+            if n >= 0:
+                return int(n)
         n = 0
         with open(self._path, "rb") as f:
             skipped = not self._skip_header
@@ -158,15 +167,25 @@ class HashedCSVChunks(ChunkSource):
                 n += 1
         return n
 
+    @staticmethod
+    def _field_float(field: str) -> float:
+        """float() with empty→0 and underscores rejected — Python's
+        float accepts "1_0" but C strtof (the native reader) does not;
+        rejecting on both paths keeps them bit-identical."""
+        if not field:
+            return 0.0
+        if "_" in field:
+            raise ValueError(f"invalid numeric field {field!r}")
+        return float(field)
+
     def _encode(self, rows: list[list[str]]):
         n = len(rows)
         y = np.empty((n,), np.float32)
         num = np.zeros((n, len(self._numeric)), np.float32)
         for i, parts in enumerate(rows):
-            y[i] = float(parts[self._label_col] or 0.0)
+            y[i] = self._field_float(parts[self._label_col])
             for j, c in enumerate(self._numeric):
-                field = parts[c]
-                num[i, j] = float(field) if field else 0.0
+                num[i, j] = self._field_float(parts[c])
         cats = [
             np.array([r[c] for r in rows], dtype=object)
             for c in self._categorical
@@ -181,17 +200,46 @@ class HashedCSVChunks(ChunkSource):
 
     def _iter_raw(self):
         """Deterministic line order (required by the chunk-keyed weight
-        streams); the base class buffers and pads to fixed shape."""
+        streams); the base class buffers and pads to fixed shape.
+
+        Uses the native C++ reader when available (same crc32 token
+        stream — differential-tested); the pure-Python path below is
+        the portable fallback.
+        """
+        from spark_bagging_tpu.utils.native import NativeReader
+
+        try:
+            reader = NativeReader.open_csv_hashed(
+                self._path, self.chunk_rows,
+                label_col=self._label_col,
+                numeric_cols=self._numeric,
+                categorical_cols=self._categorical,
+                n_hash=self._hasher.n_features,
+                seed=self._hasher.seed,
+                delimiter=self._delim,
+                skip_header=self._skip_header,
+            )
+        except OSError:
+            reader = None
+        if reader is not None:
+            yield from reader
+            return
+        # binary read, LF line split: the same framing as the native
+        # getline reader and _count_rows — a lone-\r (classic-Mac)
+        # file is NOT treated as multi-line on any path (text-mode
+        # universal newlines would, silently desyncing n_rows from the
+        # chunk stream). LF and CRLF files are the supported formats.
         buf: list[list[str]] = []
-        with open(self._path, "r") as f:
+        with open(self._path, "rb") as f:
             skipped = not self._skip_header
-            for line in f:
-                if not line.strip():
+            for raw in f:
+                if not raw.strip():
                     continue
                 if not skipped:
                     skipped = True
                     continue
-                buf.append(line.rstrip("\r\n").split(self._delim))
+                line = raw.decode("utf-8").rstrip("\r\n")
+                buf.append(line.split(self._delim))
                 if len(buf) == self.chunk_rows:
                     yield self._encode(buf)
                     buf = []

@@ -78,6 +78,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.reader_open_svm.restype = ctypes.c_void_p
     lib.reader_open_csv.argtypes = [ctypes.c_char_p, i64, i64, ctypes.c_int]
     lib.reader_open_csv.restype = ctypes.c_void_p
+    lib.reader_open_csv_hashed.argtypes = [
+        ctypes.c_char_p, i64, i64p, i64, i64p, i64, i64, i64,
+        ctypes.c_char, ctypes.c_int,
+    ]
+    lib.reader_open_csv_hashed.restype = ctypes.c_void_p
+    lib.csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.csv_count_rows.restype = i64
     lib.reader_next.argtypes = [ctypes.c_void_p, i64, f32p, f32p]
     lib.reader_next.restype = i64
     lib.reader_close.argtypes = [ctypes.c_void_p]
@@ -190,6 +197,43 @@ class NativeReader:
         h = lib.reader_open_svm(path.encode(), n_features, int(zero_based))
         if not h:
             raise OSError(f"cannot open {path}")
+        return cls(h, n_features, block_rows)
+
+    @classmethod
+    def open_csv_hashed(
+        cls, path: str, block_rows: int,
+        *, label_col: int, numeric_cols: list[int],
+        categorical_cols: list[int], n_hash: int, seed: int = 0,
+        delimiter: str = ",", skip_header: bool = False,
+    ) -> "NativeReader | None":
+        """Streaming hashed-CSV reader (fmt 2 in loader.cpp): numeric
+        passthrough + signed feature hashing, bit-identical to the
+        Python FeatureHasher (same crc32 tokens). Returns None when the
+        native library is unavailable OR the spec needs the Python path
+        (multi-char delimiter, negative column indices)."""
+        if (
+            len(delimiter.encode()) != 1  # byte count: ctypes.c_char
+            or label_col < 0
+            or any(c < 0 for c in numeric_cols + categorical_cols)
+        ):
+            return None
+        lib = get_lib()
+        if lib is None:
+            return None
+        num = (ctypes.c_int64 * max(1, len(numeric_cols)))(*numeric_cols)
+        cat = (ctypes.c_int64 * max(1, len(categorical_cols)))(
+            *categorical_cols
+        )
+        h = lib.reader_open_csv_hashed(
+            path.encode(), label_col, num, len(numeric_cols), cat,
+            len(categorical_cols), n_hash, seed,
+            delimiter.encode(), int(skip_header),
+        )
+        if not h:
+            raise OSError(f"cannot open {path} (or invalid hashed spec)")
+        n_features = len(numeric_cols) + (
+            n_hash if categorical_cols else 0
+        )
         return cls(h, n_features, block_rows)
 
     @classmethod

@@ -163,3 +163,125 @@ def test_crlf_and_n_rows_override(tmp_path):
     )
     (X2, _, _), = list(src2.chunks())
     np.testing.assert_array_equal(X, X2)
+
+
+class TestNativeHashedReader:
+    def _roundtrip(self, tmp_path, text, name, **kw):
+        """Chunks via the native reader vs the forced-Python fallback
+        must be bit-identical (same crc32 token stream)."""
+        from spark_bagging_tpu.utils import native
+
+        path = str(tmp_path / name)
+        with open(path, "w") as f:
+            f.write(text)
+        mk = lambda: HashedCSVChunks(path, **kw)
+        if native.get_lib() is None:
+            pytest.skip("native toolchain unavailable")
+        src_native = mk()
+        got_native = [
+            (X.copy(), y.copy(), nv) for X, y, nv in src_native.chunks()
+        ]
+        orig = native.NativeReader.open_csv_hashed
+        try:
+            native.NativeReader.open_csv_hashed = classmethod(
+                lambda cls, *a, **k: None
+            )
+            src_py = mk()
+            got_py = [
+                (X.copy(), y.copy(), nv) for X, y, nv in src_py.chunks()
+            ]
+        finally:
+            native.NativeReader.open_csv_hashed = orig
+        assert len(got_native) == len(got_py)
+        for (Xa, ya, na), (Xb, yb, nb) in zip(got_native, got_py):
+            assert na == nb
+            np.testing.assert_array_equal(Xa, Xb)
+            np.testing.assert_array_equal(ya, yb)
+        return got_native
+
+    def test_differential_basic(self, tmp_path):
+        rng = np.random.default_rng(0)
+        lines = ["label,n1,n2,c1,c2\n"]
+        for i in range(257):  # crosses a chunk boundary
+            c1 = rng.choice([f"{v:08x}" for v in range(30)])
+            c2 = rng.choice(["ios", "android", "web", ""])
+            n1 = "" if i % 7 == 0 else f"{rng.normal():.4f}"
+            lines.append(f"{i % 2},{n1},{rng.normal():.2f},{c1},{c2}\n")
+        got = self._roundtrip(
+            tmp_path, "".join(lines), "diff.csv", chunk_rows=64,
+            label_col=0, numeric_cols=[1, 2], categorical_cols=[3, 4],
+            n_hash=128, skip_header=True,
+        )
+        assert sum(nv for _, _, nv in got) == 257
+
+    def test_differential_edge_cases(self, tmp_path):
+        """Blank lines, empty label, surrounding whitespace in
+        numerics, unicode category values."""
+        text = "1,3.5,α\n\n,,-\n0, 2 ,x\n"
+        self._roundtrip(
+            tmp_path, text, "edge.csv", chunk_rows=2, label_col=0,
+            numeric_cols=[1], categorical_cols=[2], n_hash=32,
+        )
+
+    def test_differential_tab_delimiter(self, tmp_path):
+        text = "1\t3.5\ta\n0\t4.5\tb\n"
+        self._roundtrip(
+            tmp_path, text, "tab.csv", chunk_rows=2, label_col=0,
+            numeric_cols=[1], categorical_cols=[2], n_hash=16,
+            delimiter="\t",
+        )
+
+    def test_non_ascii_delimiter_falls_back(self, tmp_path):
+        """A single-CHAR multi-BYTE delimiter cannot reach ctypes.c_char;
+        the native opener must return None (Python fallback), not crash."""
+        from spark_bagging_tpu.utils import native
+
+        path = str(tmp_path / "sect.csv")
+        with open(path, "w") as f:
+            f.write("1\u00a72.5\u00a7a\n0\u00a73.5\u00a7b\n")
+        assert native.NativeReader.open_csv_hashed(
+            path, 2, label_col=0, numeric_cols=[1],
+            categorical_cols=[2], n_hash=16, delimiter="\u00a7",
+        ) is None
+        src = HashedCSVChunks(
+            path, chunk_rows=2, label_col=0, numeric_cols=[1],
+            categorical_cols=[2], n_hash=16, delimiter="\u00a7",
+        )
+        (X, y, nv), = list(src.chunks())
+        assert nv == 2 and X[0, 0] == 2.5
+
+    def test_hex_and_underscore_numerics_rejected_both_paths(self, tmp_path):
+        """strtof accepts hex floats Python rejects, Python accepts
+        underscores strtof rejects — both are errors on both paths."""
+        for bad in ("0x10", "1_0"):
+            path = str(tmp_path / f"bad_{bad[:2]}.csv")
+            with open(path, "w") as f:
+                f.write(f"1,{bad},a\n")
+            src = HashedCSVChunks(
+                path, chunk_rows=1, label_col=0, numeric_cols=[1],
+                categorical_cols=[2], n_hash=16,
+            )
+            with pytest.raises(ValueError):
+                list(src.chunks())
+
+    def test_lone_cr_file_counts_match_stream(self, tmp_path):
+        """Classic-Mac lone-\r files are ONE line on every path (the
+        binary LF framing) — n_rows must equal the yielded rows."""
+        path = str(tmp_path / "mac.csv")
+        with open(path, "wb") as f:
+            f.write(b"1,2.5,a\r0,3.5,b\r")
+        src = HashedCSVChunks(
+            path, chunk_rows=4, label_col=0, numeric_cols=[1],
+            categorical_cols=[2], n_hash=16,
+        )
+        total = sum(nv for _, _, nv in src.chunks())
+        assert src.n_rows == total == 1
+
+    def test_differential_categorical_only(self, tmp_path):
+        text = "1,a\n0,b\n1,a\n"
+        got = self._roundtrip(
+            tmp_path, text, "cat.csv", chunk_rows=3, label_col=0,
+            categorical_cols=[1], n_hash=16,
+        )
+        X, y, nv = got[0]
+        assert X.shape[1] == 16 and nv == 3
