@@ -599,11 +599,18 @@ class _BaseBagging(ParamsMixin):
 
     def _fit_stream_engine(
         self, source, n_outputs: int, *, n_epochs: int,
-        steps_per_chunk: int, lr: float, checkpoint_dir=None,
-        checkpoint_every: int = 0, resume_from=None,
+        steps_per_chunk: int, lr: float, prefetch: int = 0,
+        checkpoint_dir=None, checkpoint_every: int = 0, resume_from=None,
     ):
         """Out-of-core fit over a ChunkSource [SURVEY §7 step 8]."""
         from spark_bagging_tpu.streaming import fit_ensemble_stream
+
+        if prefetch:
+            # outermost wrap — ingestion (parse, hashing, label encode)
+            # runs on a background thread while the device steps
+            from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+
+            source = PrefetchChunks(source, prefetch)
 
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
@@ -887,6 +894,7 @@ class BaggingClassifier(_BaseBagging):
         steps_per_chunk: int = 1,
         lr: float = 0.01,
         chunk_rows: int | None = None,
+        prefetch: int = 2,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         resume_from: str | None = None,
@@ -900,6 +908,10 @@ class BaggingClassifier(_BaseBagging):
         ``n_epochs``; tree learners stream through the multi-pass
         level-synchronous engine (``max_depth + 2`` passes; the SGD
         knobs ``n_epochs``/``steps_per_chunk``/``lr`` don't apply).
+
+        ``prefetch`` chunks are produced on a background thread so
+        host ingestion (CSV parse, hashing, label encode) overlaps the
+        device steps — the Spark executor-thread analog; 0 disables.
 
         ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot the fit
         state every N chunk-steps (tree learners instead snapshot at
@@ -929,6 +941,7 @@ class BaggingClassifier(_BaseBagging):
         self._fit_stream_engine(
             enc, self.n_classes_,
             n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
+            prefetch=prefetch,
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             resume_from=resume_from,
@@ -1062,6 +1075,7 @@ class BaggingRegressor(_BaseBagging):
         steps_per_chunk: int = 1,
         lr: float = 0.01,
         chunk_rows: int | None = None,
+        prefetch: int = 2,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         resume_from: str | None = None,
@@ -1073,6 +1087,7 @@ class BaggingRegressor(_BaseBagging):
         source = as_chunk_source(source, chunk_rows)
         self._fit_stream_engine(source, 1, n_epochs=n_epochs,
                                 steps_per_chunk=steps_per_chunk, lr=lr,
+                                prefetch=prefetch,
                                 checkpoint_dir=checkpoint_dir,
                                 checkpoint_every=checkpoint_every,
                                 resume_from=resume_from)

@@ -1,0 +1,99 @@
+"""Background chunk prefetching — IO/compute overlap for the streams.
+
+The reference's host platform overlaps ingestion with compute for free
+(Spark executors read partitions on their own threads while tasks run
+[SURVEY §1 L1]). The TPU-native streaming engines iterate a
+ChunkSource inline, so without this wrapper every device step waits
+for the next chunk's disk read + parse + hash. ``PrefetchChunks`` runs
+the source iterator on a daemon thread with a small bounded queue: the
+host prepares chunk ``c+1`` (native CSV parse, feature hashing …)
+while the device fits chunk ``c`` — the classic double-buffer, bounded
+at ``depth`` chunks of host memory.
+
+Semantics are preserved exactly: chunk ORDER is unchanged (the
+chunk-keyed bootstrap weight streams depend on it [streaming.py]),
+producer exceptions re-raise at the consuming ``next()``, and
+abandoning the iterator mid-epoch (early ``break``, error) stops the
+producer thread promptly instead of leaking it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+from spark_bagging_tpu.utils.io import ChunkSource
+
+_DONE = object()
+
+
+class PrefetchChunks(ChunkSource):
+    """Wrap a ChunkSource so ``chunks()`` is produced on a background
+    thread, ``depth`` chunks ahead. Metadata proxies the inner source.
+    Wrapping an already-wrapped source unwraps the inner layer first —
+    one level of prefetch is the useful amount, so double-wrapping
+    never stacks threads/queues.
+    """
+
+    def __init__(self, inner: ChunkSource, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if isinstance(inner, PrefetchChunks):
+            inner = inner._inner
+        self._inner = inner
+        self._depth = depth
+        self.n_features = inner.n_features
+        self.n_rows = inner.n_rows
+        self.chunk_rows = inner.chunk_rows
+
+    @property
+    def n_chunks(self) -> int:
+        return self._inner.n_chunks
+
+    def chunks(self):
+        q: queue.Queue[Any] = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+
+        def put_or_stop(item) -> bool:
+            """Bounded put that notices consumer abandonment; returns
+            False when the consumer is gone. Every terminal message
+            (_DONE, exception) MUST go through this too: a plain
+            timed put could drop it while the consumer sits inside a
+            long device step (first-chunk XLA compile takes many
+            seconds), leaving the consumer blocked forever."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            try:
+                for item in self._inner.chunks():
+                    if not put_or_stop(item):
+                        return
+                put_or_stop(_DONE)
+            except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+                put_or_stop(e)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain one slot so a producer blocked in put() can exit
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)

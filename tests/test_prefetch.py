@@ -1,0 +1,140 @@
+"""Prefetching chunk pipeline: order preservation, exception
+propagation, thread cleanup, engine equivalence [SURVEY §1 L1 analog]."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import ArrayChunks, BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+
+
+def _threads():
+    return {t.name for t in threading.enumerate() if t.is_alive()}
+
+
+class TestPrefetchChunks:
+    def test_chunks_identical_and_ordered(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 4)).astype(np.float32)
+        y = rng.integers(0, 2, 500).astype(np.float32)
+        src = ArrayChunks(X, y, chunk_rows=64)
+        pf = PrefetchChunks(src, depth=3)
+        assert pf.n_chunks == src.n_chunks
+        assert pf.n_features == src.n_features
+        a = [(Xc.copy(), yc.copy(), nv) for Xc, yc, nv in src.chunks()]
+        b = [(Xc.copy(), yc.copy(), nv) for Xc, yc, nv in pf.chunks()]
+        assert len(a) == len(b)
+        for (Xa, ya, na), (Xb, yb, nb) in zip(a, b):
+            np.testing.assert_array_equal(Xa, Xb)
+            np.testing.assert_array_equal(ya, yb)
+            assert na == nb
+
+    def test_multiple_epochs(self):
+        X = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = np.zeros(20, np.float32)
+        pf = PrefetchChunks(ArrayChunks(X, y, chunk_rows=8), depth=2)
+        e1 = [Xc.copy() for Xc, _, _ in pf.chunks()]
+        e2 = [Xc.copy() for Xc, _, _ in pf.chunks()]
+        for a, b in zip(e1, e2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_producer_exception_propagates(self):
+        class Boom(ArrayChunks):
+            def chunks(self):
+                yield from super().chunks()
+                raise RuntimeError("disk on fire")
+
+        X = np.zeros((16, 2), np.float32)
+        y = np.zeros(16, np.float32)
+        pf = PrefetchChunks(Boom(X, y, chunk_rows=8), depth=2)
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            list(pf.chunks())
+
+    def test_abandoned_iterator_stops_producer(self):
+        class Slow(ArrayChunks):
+            def chunks(self):
+                for item in super().chunks():
+                    time.sleep(0.01)
+                    yield item
+
+        X = np.zeros((10_000, 2), np.float32)
+        y = np.zeros(10_000, np.float32)
+        pf = PrefetchChunks(Slow(X, y, chunk_rows=16), depth=2)
+        before = len(_threads())
+        it = pf.chunks()
+        next(it)
+        it.close()  # abandon mid-epoch
+        time.sleep(0.5)
+        assert len(_threads()) <= before + 1  # producer exited
+
+    def test_depth_validation(self):
+        X = np.zeros((4, 2), np.float32)
+        with pytest.raises(ValueError, match="depth"):
+            PrefetchChunks(ArrayChunks(X, np.zeros(4), chunk_rows=2), 0)
+
+
+class TestEngineEquivalence:
+    def test_fit_stream_prefetch_matches_no_prefetch(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(600, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        kw = dict(classes=[0, 1], n_epochs=4, lr=0.1)
+        a = BaggingClassifier(n_estimators=8, seed=0).fit_stream(
+            ArrayChunks(X, y, chunk_rows=128), prefetch=0, **kw
+        )
+        b = BaggingClassifier(n_estimators=8, seed=0).fit_stream(
+            ArrayChunks(X, y, chunk_rows=128), prefetch=2, **kw
+        )
+        np.testing.assert_allclose(
+            a.predict_proba(X), b.predict_proba(X), rtol=1e-6
+        )
+
+    def test_regressor_and_tree_stream_with_prefetch(self):
+        from spark_bagging_tpu.models import DecisionTreeRegressor
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(512, 5)).astype(np.float32)
+        y = (X[:, 0] - X[:, 2] + 0.1 * rng.normal(size=512)).astype(
+            np.float32
+        )
+        reg = BaggingRegressor(n_estimators=4, seed=0).fit_stream(
+            ArrayChunks(X, y, chunk_rows=128), n_epochs=6, lr=0.05
+        )
+        assert np.isfinite(reg.predict(X)).all()
+        # multi-pass tree engine re-opens chunks() once per pass — each
+        # pass gets its own producer thread
+        tr = BaggingRegressor(
+            base_learner=DecisionTreeRegressor(max_depth=3),
+            n_estimators=4, seed=0,
+        ).fit_stream(ArrayChunks(X, y, chunk_rows=128))
+        assert tr.score(X, y) > 0.5
+
+
+def test_double_wrap_unwraps():
+    X = np.zeros((8, 2), np.float32)
+    src = ArrayChunks(X, np.zeros(8), chunk_rows=4)
+    pf = PrefetchChunks(PrefetchChunks(src, 2), 3)
+    assert pf._inner is src
+
+
+def test_exception_not_lost_when_queue_full():
+    """The terminal exception must survive a full queue + slow consumer
+    (the first-chunk-compile scenario) instead of hanging the stream."""
+    class BoomEarly(ArrayChunks):
+        def chunks(self):
+            it = super().chunks()
+            yield next(it)
+            yield next(it)
+            yield next(it)
+            raise RuntimeError("io error after buffer fill")
+
+    X = np.zeros((64, 2), np.float32)
+    pf = PrefetchChunks(BoomEarly(X, np.zeros(64), chunk_rows=8), depth=1)
+    it = pf.chunks()
+    next(it)
+    time.sleep(1.5)  # producer has raised while the queue was full
+    with pytest.raises(RuntimeError, match="io error"):
+        list(it)
