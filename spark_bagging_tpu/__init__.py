@@ -51,7 +51,7 @@ from spark_bagging_tpu.utils.io import (
     SyntheticChunks,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "BaggingClassifier",

@@ -748,21 +748,29 @@ class _BaseBagging(ParamsMixin):
         params = jax.tree.map(lambda a: to_host(a[i]), self.ensemble_)
         return params, to_host(self.subspaces_[i])
 
-    def _stream_chunks(self, source, chunk_rows=None):
+    def _stream_chunks(self, source, chunk_rows=None, prefetch: int = 2):
         """Validated chunk iterator for the streaming predict/score
         paths (the reference's ``transform`` over a distributed
         DataFrame [SURVEY §3.2] — here any ChunkSource / (X, y) pair;
         labels ride along and are ignored where not needed)."""
         from spark_bagging_tpu.utils.io import as_chunk_source
 
+        from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+
         self._check_fitted()
+        already_wrapped = isinstance(source, PrefetchChunks)
         source = as_chunk_source(source, chunk_rows)
         if source.n_features != self.n_features_in_:
             raise ValueError(
                 f"source has {source.n_features} features; the ensemble "
                 f"was fitted on {self.n_features_in_}"
             )
-        return source
+        # scoring passes overlap ingestion with the device forward the
+        # same way streamed fits do; an explicitly-wrapped source keeps
+        # its configured depth, prefetch=0 disables
+        if already_wrapped or not prefetch:
+            return source
+        return PrefetchChunks(source, prefetch)
 
     def _oob_scores_stream(self, source, n_classes: int | None):
         """Streamed OOB: one extra pass regenerating each replica's
@@ -988,25 +996,34 @@ class BaggingClassifier(_BaseBagging):
             return proba[:, 1] - proba[:, 0]
         return proba
 
-    def predict_proba_stream(self, source, chunk_rows=None) -> np.ndarray:
+    def predict_proba_stream(self, source, chunk_rows=None, *,
+                             prefetch: int = 2) -> np.ndarray:
         """Out-of-core ``predict_proba``: aggregate chunk by chunk —
         only one chunk is ever resident on device."""
         out = [
             self.predict_proba(Xc[:n])
-            for Xc, _, n in self._stream_chunks(source, chunk_rows).chunks()
+            for Xc, _, n in self._stream_chunks(
+                source, chunk_rows, prefetch
+            ).chunks()
         ]
         if not out:
             raise ValueError("source yielded no chunks")
         return np.concatenate(out)
 
-    def predict_stream(self, source, chunk_rows=None) -> np.ndarray:
-        proba = self.predict_proba_stream(source, chunk_rows)
+    def predict_stream(self, source, chunk_rows=None, *,
+                       prefetch: int = 2) -> np.ndarray:
+        proba = self.predict_proba_stream(
+            source, chunk_rows, prefetch=prefetch
+        )
         return self.classes_[proba.argmax(axis=1)]
 
-    def score_stream(self, source, chunk_rows=None) -> float:
+    def score_stream(self, source, chunk_rows=None, *,
+                     prefetch: int = 2) -> float:
         """Out-of-core accuracy over a labeled ChunkSource."""
         correct = total = 0
-        for Xc, yc, n in self._stream_chunks(source, chunk_rows).chunks():
+        for Xc, yc, n in self._stream_chunks(
+            source, chunk_rows, prefetch
+        ).chunks():
             pred = self.predict(Xc[:n])
             correct += int((np.asarray(yc[:n]) == pred).sum())
             total += int(n)
@@ -1114,24 +1131,30 @@ class BaggingRegressor(_BaseBagging):
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(pred)
 
-    def predict_stream(self, source, chunk_rows=None) -> np.ndarray:
+    def predict_stream(self, source, chunk_rows=None, *,
+                       prefetch: int = 2) -> np.ndarray:
         """Out-of-core ``predict``: one chunk resident at a time."""
         out = [
             self.predict(Xc[:n])
-            for Xc, _, n in self._stream_chunks(source, chunk_rows).chunks()
+            for Xc, _, n in self._stream_chunks(
+                source, chunk_rows, prefetch
+            ).chunks()
         ]
         if not out:
             raise ValueError("source yielded no chunks")
         return np.concatenate(out)
 
-    def score_stream(self, source, chunk_rows=None) -> float:
+    def score_stream(self, source, chunk_rows=None, *,
+                     prefetch: int = 2) -> float:
         """Out-of-core R² from one-pass accumulated moments, shifted
         by the first chunk's target mean — raw Σy² − (Σy)²/n cancels
         catastrophically for large-mean targets."""
         n_tot = 0
         shift = None
         s_yd = s_yd2 = s_res = 0.0
-        for Xc, yc, n in self._stream_chunks(source, chunk_rows).chunks():
+        for Xc, yc, n in self._stream_chunks(
+            source, chunk_rows, prefetch
+        ).chunks():
             yv = np.asarray(yc[:n], np.float64)
             pred = np.asarray(self.predict(Xc[:n]), np.float64)
             if shift is None:

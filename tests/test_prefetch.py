@@ -138,3 +138,21 @@ def test_exception_not_lost_when_queue_full():
     time.sleep(1.5)  # producer has raised while the queue was full
     with pytest.raises(RuntimeError, match="io error"):
         list(it)
+
+
+def test_scoring_stream_prefetch_knob():
+    """Scoring streams: prefetch=0 disables wrapping; an explicitly
+    wrapped source keeps its configured depth."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    src = ArrayChunks(X, y, chunk_rows=64)
+    a = clf.predict_proba_stream(src)
+    b = clf.predict_proba_stream(src, prefetch=0)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    wrapped = PrefetchChunks(src, depth=5)
+    out = clf._stream_chunks(wrapped)
+    assert out is wrapped and out._depth == 5
+    acc = clf.score_stream(src, prefetch=0)
+    assert acc == clf.score_stream(src)
