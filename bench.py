@@ -131,9 +131,12 @@ def main() -> None:
     p.add_argument("--row-tile", type=int, default=None)
     # "blocked" emits C²/2 (d, d)-output matmuls — at d=55 the MXU's
     # 128x128 output tiles run ~18% full; "fused" emits one
-    # (C·d, n)@(n, C·d) matmul whose 385-wide output tiles far better.
+    # (C·d, n)@(n, C·d) matmul whose 385-wide output tiles far better
+    # (but 1.75x the FLOPs); "packed" keeps blocked's FLOPs while
+    # concatenating the scaled copies into one (d, n)@(n, P·d) matmul
+    # (~43% fill) — needs --row-tile.
     p.add_argument("--hessian-impl", default="auto",
-                   choices=["auto", "blocked", "fused"])
+                   choices=["auto", "blocked", "fused", "packed"])
     p.add_argument("--max-iter", type=int, default=3)
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")

@@ -12,13 +12,20 @@ X, y = synthetic_covtype(581_012)
 mu, sigma = X.mean(0), X.std(0) + 1e-8
 X = ((X - mu) / sigma).astype(np.float32)
 results = []
-for chunk, row_tile in [(200, None), (100, None), (300, None),
-                        (400, 65536), (500, 65536)]:
+for impl, chunk, row_tile in [
+    ("blocked", 200, None), ("blocked", 100, None), ("blocked", 300, None),
+    ("blocked", 400, 65536), ("blocked", 500, 65536),
+    # packed: blocked FLOPs at ~2.4x the MXU output-tile fill; temp is
+    # O(tile*P*d) so it needs row tiling and a smaller replica chunk
+    ("packed", 50, 16384), ("packed", 100, 8192), ("packed", 200, 4096),
+    ("packed", 100, 16384),
+]:
     learner = LogisticRegression(l2=1e-3, max_iter=3, precision="high",
-                                 row_tile=row_tile, hessian_impl="blocked")
+                                 row_tile=row_tile, hessian_impl=impl)
     clf = BaggingClassifier(base_learner=learner, n_estimators=1000,
                             chunk_size=chunk, seed=0)
-    cell = {"chunk": chunk, "row_tile": row_tile, "fps": None}
+    cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
+            "fps": None}
     try:
         best = None
         for r in range(2):

@@ -599,7 +599,7 @@ class _BaseBagging(ParamsMixin):
 
     def _fit_stream_engine(
         self, source, n_outputs: int, *, n_epochs: int,
-        steps_per_chunk: int, lr: float, prefetch: int = 0,
+        steps_per_chunk: int, lr: float, prefetch: int = 2,
         checkpoint_dir=None, checkpoint_every: int = 0, resume_from=None,
     ):
         """Out-of-core fit over a ChunkSource [SURVEY §7 step 8]."""

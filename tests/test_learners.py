@@ -668,3 +668,43 @@ def test_bernoulli_nb_negative_binarize_loss_sane():
     logp = scores - np.log(np.exp(scores).sum(1, keepdims=True))
     nll = -logp[np.arange(200), y].mean()
     assert float(aux["loss"]) == pytest.approx(nll, rel=1e-4)
+
+
+def test_packed_hessian_matches_blocked():
+    """'packed' concatenates the blocked scaled copies into one wide
+    matmul — identical math, so fits must agree to fp tolerance, with
+    and without row tiling."""
+    Xj, yj, _, y = _iris()
+    w = jnp.asarray(np.random.default_rng(0).poisson(1.0, len(y)),
+                    jnp.float32)
+    base = LogisticRegression(max_iter=4, hessian_impl="blocked")
+    pb, ab = base.fit_from_init(KEY, Xj, yj, w, 3)
+    for rt in (None, 64):
+        packed = LogisticRegression(max_iter=4, hessian_impl="packed",
+                                    row_tile=rt)
+        pp, ap = packed.fit_from_init(KEY, Xj, yj, w, 3)
+        np.testing.assert_allclose(
+            np.asarray(pp["W"]), np.asarray(pb["W"]), rtol=2e-4,
+            atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            float(ap["loss"]), float(ab["loss"]), rtol=1e-5
+        )
+
+
+def test_packed_hessian_in_ensemble_and_sharded():
+    from spark_bagging_tpu import BaggingClassifier, make_mesh
+
+    Xj, yj, X, y = _breast_cancer()
+    lr = LogisticRegression(max_iter=5, hessian_impl="packed")
+    clf = BaggingClassifier(base_learner=lr, n_estimators=8, seed=0)
+    clf.fit(X, y)
+    assert clf.score(X, y) > 0.95
+    mesh = make_mesh(data=8)
+    a = BaggingClassifier(base_learner=lr, n_estimators=1,
+                          bootstrap=False, seed=0, mesh=mesh).fit(X, y)
+    b = BaggingClassifier(base_learner=lr, n_estimators=1,
+                          bootstrap=False, seed=0).fit(X, y)
+    np.testing.assert_allclose(
+        a.predict_proba(X), b.predict_proba(X), rtol=1e-4, atol=1e-5
+    )
