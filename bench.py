@@ -136,7 +136,7 @@ def main() -> None:
     # concatenating the scaled copies into one (d, n)@(n, P·d) matmul
     # (~43% fill) — needs --row-tile.
     p.add_argument("--hessian-impl", default="auto",
-                   choices=["auto", "blocked", "fused", "packed"])
+                   choices=["auto", "blocked", "fused", "packed", "pallas"])
     p.add_argument("--max-iter", type=int, default=3)
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")

@@ -19,6 +19,8 @@ for impl, chunk, row_tile in [
     # O(tile*P*d) so it needs row tiling and a smaller replica chunk
     ("packed", 50, 16384), ("packed", 100, 8192), ("packed", 200, 4096),
     ("packed", 100, 16384),
+    # pallas: packed math, wide operand built in VMEM (no HBM temp)
+    ("pallas", 100, None), ("pallas", 200, None), ("pallas", 400, None),
 ]:
     learner = LogisticRegression(l2=1e-3, max_iter=3, precision="high",
                                  row_tile=row_tile, hessian_impl=impl)

@@ -84,10 +84,11 @@ class LogisticRegression(BaseLearner):
         self.solver = solver
         self.lr = lr
         self.precision = precision
-        if hessian_impl not in ("auto", "blocked", "fused", "packed"):
+        if hessian_impl not in ("auto", "blocked", "fused", "packed",
+                                "pallas"):
             raise ValueError(
-                f"hessian_impl must be auto|blocked|fused|packed, got "
-                f"{hessian_impl!r}"
+                "hessian_impl must be auto|blocked|fused|packed|pallas, "
+                f"got {hessian_impl!r}"
             )
         # Newton Hessian assembly: "blocked" emits C²/2 small (d, d)
         # matmuls (peak temp O(n·d), but program size grows O(C²));
@@ -174,11 +175,12 @@ class LogisticRegression(BaseLearner):
     # -- Newton --------------------------------------------------------
 
     def _resolved_hessian(self, C: int) -> str:
-        if self.hessian_impl not in ("auto", "blocked", "fused", "packed"):
+        if self.hessian_impl not in ("auto", "blocked", "fused", "packed",
+                                     "pallas"):
             # re-validate: set_params() bypasses __init__
             raise ValueError(
-                f"hessian_impl must be auto|blocked|fused|packed, got "
-                f"{self.hessian_impl!r}"
+                "hessian_impl must be auto|blocked|fused|packed|pallas, "
+                f"got {self.hessian_impl!r}"
             )
         if self.hessian_impl != "auto":
             return self.hessian_impl
@@ -195,7 +197,8 @@ class LogisticRegression(BaseLearner):
         Y = jax.nn.one_hot(yt, C, dtype=jnp.float32)
         G = Xt.T @ ((P - Y) * wt[:, None])
         # Hessian H_cc' = X^T diag(w·p_c·(δ_cc' − p_c')) X.
-        if self._resolved_hessian(C) == "fused":
+        impl = self._resolved_hessian(C)
+        if impl == "fused":
             # w·p_c·p_c' = (√w·p_c)(√w·p_c'): the cross term is one
             # rank-factorized matmul over V[n, (c,i)] = √w_n p_nc X_ni,
             # and the δ term is the block diagonal of per-class
@@ -210,7 +213,7 @@ class LogisticRegression(BaseLearner):
                 "cE,cij->ciEj", jnp.eye(C, dtype=Xt.dtype), D
             ).reshape(Cd, Cd)
             return loss_sum, G, H
-        if self._resolved_hessian(C) == "packed":
+        if impl in ("packed", "pallas"):
             # Packed: the SAME C(C+1)/2 upper-triangle blocks as
             # "blocked", but their scaled-X copies concatenated along
             # columns so ONE (d, n)@(n, P·d) matmul computes them all —
@@ -225,13 +228,30 @@ class LogisticRegression(BaseLearner):
             cpi_a = jnp.asarray(cpi)
             delta = (ci_a == cpi_a).astype(jnp.float32)
             S = wt[:, None] * P[:, ci_a] * (delta[None, :] - P[:, cpi_a])
-            RHS = (Xt[:, None, :] * S[:, :, None]).reshape(
-                Xt.shape[0], -1
-            )
-            out = (Xt.T @ RHS).reshape(d, len(ci), d)     # (d, P, d)
+            if impl == "pallas":
+                # same packed math, but the wide scaled operand is
+                # built in VMEM by the kernel (ops/gram.py) — no
+                # (tile, P·d) HBM temp at all
+                from spark_bagging_tpu.ops.gram import scaled_grams
+
+                grams = scaled_grams(
+                    Xt, S,
+                    op_dtype=(
+                        "bfloat16" if self.precision in
+                        ("default", "bfloat16") else "float32"
+                    ),
+                    interpret=jax.default_backend() != "tpu",
+                )                                          # (P, d, d)
+            else:
+                RHS = (Xt[:, None, :] * S[:, :, None]).reshape(
+                    Xt.shape[0], -1
+                )
+                grams = (Xt.T @ RHS).reshape(d, len(ci), d).transpose(
+                    1, 0, 2
+                )                                          # (P, d, d)
             blocks = [[None] * C for _ in range(C)]
             for k, (c, cp) in enumerate(zip(ci, cpi)):
-                Hb = out[:, k, :]
+                Hb = grams[k]
                 blocks[c][cp] = Hb
                 if cp != c:
                     blocks[cp][c] = Hb
@@ -250,7 +270,15 @@ class LogisticRegression(BaseLearner):
 
     def _row_tiles(self, Xb, y, w):
         """Reshape rows into (n_tiles, tile, ·), zero-padding the tail
-        (w=0 rows contribute nothing to any weighted statistic)."""
+        (w=0 rows contribute nothing to any weighted statistic).
+
+        The pallas Hessian manages its own row tiling in VMEM — an
+        outer scan would zero-pad every small tile up to the kernel's
+        512-row grid tile (8x wasted MXU work at row_tile=64), so it
+        ignores row_tile.
+        """
+        if self.hessian_impl == "pallas":  # "auto" never resolves here
+            return None
         tile = self.row_tile
         n, d = Xb.shape
         if tile is None or n <= tile:

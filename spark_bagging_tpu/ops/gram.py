@@ -1,0 +1,106 @@
+"""Pallas TPU kernel: multi-scaled Gram matrices in one MXU pass.
+
+The Newton Hessian of multinomial logistic regression is C(C+1)/2
+scaled Grams ``H_p = Xᵀ diag(S[:, p]) X`` sharing one X
+(models/logistic.py). The XLA "packed" impl concatenates the scaled
+copies into a single wide matmul — best MXU output-tile fill — but
+must materialize the ``(tile, P·d)`` scaled operand in HBM per row
+tile. This kernel builds that operand **in VMEM** per grid step
+(``pltpu.repeat`` along lanes + per-pair lane broadcasts — the same
+expansion trick as ops/hist.py), feeds the MXU directly, and
+accumulates the ``(d, P·d)`` output in f32: HBM traffic is X and S
+once, the wide operand never exists off-chip.
+
+``op_dtype`` selects the matmul operand dtype: ``"float32"`` (exact,
+matches the blocked path bit-for-bit up to reduction order) or
+``"bfloat16"`` (3x MXU rate; the solve-time damping in logistic.py
+absorbs the rounding — parity-gated in bench.py). Single-replica
+signature; the ensemble engine ``vmap``s it (pallas_call extends the
+grid). Non-TPU backends run in interpreter mode [SURVEY §4].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ROW_TILE = 512
+
+
+def _scaled_gram_kernel(x_ref, s_ref, out_ref, *, n_pairs, op_dtype):
+    """One row-tile grid step; accumulates (d, P·d) in [p][d] order."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(0)
+    x = x_ref[:]                                 # (rows, d) f32
+    rows, d = x.shape
+    xrep = pltpu.repeat(x, n_pairs, axis=1)      # (rows, P·d) [p][d]
+    s = s_ref[:]                                 # (rows, P)
+    s_rep = jnp.concatenate(
+        [
+            jax.lax.broadcast_in_dim(
+                s[:, p : p + 1], (rows, d), (0, 1)
+            )
+            for p in range(n_pairs)
+        ],
+        axis=1,
+    )                                            # (rows, P·d) [p][d]
+    rhs = (xrep * s_rep).astype(op_dtype)
+    acc = jax.lax.dot_general(
+        x.astype(op_dtype), rhs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                            # (d, P·d)
+
+    @pl.when(r == 0)
+    def _():
+        out_ref[:] = acc
+
+    @pl.when(r > 0)
+    def _():
+        out_ref[:] = out_ref[:] + acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("op_dtype", "interpret")
+)
+def scaled_grams(
+    X: jax.Array,
+    S: jax.Array,
+    *,
+    op_dtype: str = "float32",
+    interpret: bool = False,
+) -> jax.Array:
+    """``(P, d, d)`` stack of ``Xᵀ diag(S[:, p]) X`` Grams.
+
+    ``X (n, d)`` rows, ``S (n, P)`` per-row scale factors (zero rows
+    are inert, so padding is free).
+    """
+    n, d = X.shape
+    P = S.shape[1]
+    dt = jnp.dtype(op_dtype)
+    if interpret and dt == jnp.bfloat16:
+        # CPU interpreter lacks fast bf16 dots; operands are cast for
+        # numerics only on TPU
+        dt = jnp.dtype(jnp.float32)
+    pad = (-n) % _ROW_TILE
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+        S = jnp.pad(S, ((0, pad), (0, 0)))
+    n_pad = X.shape[0]
+    out = pl.pallas_call(
+        functools.partial(
+            _scaled_gram_kernel, n_pairs=P, op_dtype=dt
+        ),
+        grid=(n_pad // _ROW_TILE,),
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, d), lambda r: (r, 0)),
+            pl.BlockSpec((_ROW_TILE, P), lambda r: (r, 0)),
+        ],
+        out_specs=pl.BlockSpec((d, P * d), lambda r: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((d, P * d), jnp.float32),
+        interpret=interpret,
+    )(X.astype(jnp.float32), S.astype(jnp.float32))
+    return out.reshape(d, P, d).transpose(1, 0, 2)
