@@ -77,6 +77,35 @@ class _GBTBase(DecisionTreeRegressor):
         self.lr = lr
         self.subsample = subsample
 
+    # -- shared round machinery ----------------------------------------
+
+    def _validate_fit_key(self, key) -> None:
+        if self.subsample < 1.0 and key is None:
+            raise ValueError(
+                "subsample < 1 draws per-round row subsets from the "
+                "replica fit key; fit was called with key=None"
+            )
+
+    def _round_row_mask(self, key_m, n, axis_name):
+        """Stochastic-GBT keep mask for one round (None when
+        subsample == 1). THE single home of the draw schedule: the
+        0x5B fold and the per-shard axis_index decorrelation — binary
+        and multiclass fits must never diverge here."""
+        if self.subsample >= 1.0:
+            return None
+        mask_key = jax.random.fold_in(key_m, 0x5B)
+        if axis_name is not None:
+            # per-row sharded draws must decorrelate shards
+            # (the ensemble.py/tree_stream.py convention) — every
+            # shard holds different rows, so an identical local keep
+            # pattern would bias the subset
+            mask_key = jax.random.fold_in(
+                mask_key, jax.lax.axis_index(axis_name)
+            )
+        return (
+            jax.random.uniform(mask_key, (n,)) < self.subsample
+        ).astype(jnp.float32)
+
     # -- per-task hooks -------------------------------------------------
 
     def _init_margin(self, y, w, w_sum, axis_name):
@@ -118,11 +147,7 @@ class _GBTBase(DecisionTreeRegressor):
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
         del params
-        if self.subsample < 1.0 and key is None:
-            raise ValueError(
-                "subsample < 1 draws per-round row subsets from the "
-                "replica fit key; fit was called with key=None"
-            )
+        self._validate_fit_key(key)
         if prepared is None:
             prepared = self.prepare(X, axis_name=axis_name)
         yf = y.astype(jnp.float32)
@@ -136,23 +161,11 @@ class _GBTBase(DecisionTreeRegressor):
             key_m = (
                 jax.random.fold_in(key, m) if key is not None else None
             )
-            if self.subsample < 1.0:
+            keep = self._round_row_mask(key_m, h.shape[0], axis_name)
+            if keep is not None:
                 # stochastic GBT: this round sees an independent
                 # Bernoulli row subset; dropped rows carry zero weight
                 # through every split statistic and leaf sum
-                mask_key = jax.random.fold_in(key_m, 0x5B)
-                if axis_name is not None:
-                    # per-row sharded draws must decorrelate shards
-                    # (the ensemble.py/tree_stream.py convention) —
-                    # every shard holds different rows, so an identical
-                    # local keep pattern would bias the subset
-                    mask_key = jax.random.fold_in(
-                        mask_key, jax.lax.axis_index(axis_name)
-                    )
-                keep = (
-                    jax.random.uniform(mask_key, (h.shape[0],))
-                    < self.subsample
-                ).astype(jnp.float32)
                 h = h * keep
             S = jnp.stack([h, h * z, h * z * z], axis=1)
             feat, thr, gain, node, _curve = self._grow(
@@ -220,20 +233,156 @@ class GBTRegressor(_GBTBase):
 
 
 class GBTClassifier(_GBTBase):
-    """Binary logistic Newton boosting (Spark GBTClassifier is also
-    binary-only). ``predict_scores`` returns ``(n, 2)`` logits
-    ``[0, margin]`` so softmax reproduces the sigmoid probabilities
-    for the ensemble's soft voting."""
+    """Logistic / multinomial Newton boosting.
+
+    Binary problems use one margin tree per round (Spark GBTClassifier
+    semantics; ``predict_scores`` returns ``(n, 2)`` logits
+    ``[0, margin]`` so softmax reproduces the sigmoid). Multiclass
+    problems — beyond Spark's binary-only GBT — grow C trees per round
+    (diagonal-Newton multinomial boosting), batched over classes with
+    ``vmap`` so a round is still one traced program."""
 
     task = "classification"
 
     def init_params(self, key, n_features, n_outputs):
-        if n_outputs != 2:
+        del key
+        if n_outputs < 2:
             raise ValueError(
-                f"GBTClassifier is binary-only (got {n_outputs} "
-                "classes), matching Spark ML's GBTClassifier"
+                f"GBTClassifier needs >= 2 classes, got {n_outputs} "
+                "(a 1-class softmax would silently train a constant)"
             )
-        return super().init_params(key, n_features, n_outputs)
+        if n_outputs == 2:
+            return super().init_params(None, n_features, n_outputs)
+        M = 2**self.max_depth - 1
+        L = 2**self.max_depth
+        R, C = self.n_rounds, n_outputs
+        return {
+            "f0": jnp.zeros((C,), jnp.float32),
+            # flat (R·C·M,): feature_importances_ reads it unchanged
+            "feature": jnp.zeros((R * C * M,), jnp.int32),
+            "threshold": jnp.zeros((R * C * M,), jnp.float32),
+            "gain": jnp.zeros((R * C * M,), jnp.float32),
+            "leaf": jnp.zeros((R, C, L), jnp.float32),
+        }
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        one = super().flops_per_fit(n_rows, n_features, n_outputs)
+        return one * (1 if n_outputs == 2 else n_outputs)
+
+    # -- multiclass engine (C trees per round, vmapped over classes) ---
+
+    def _fit_multiclass(self, params, X, y, w, key, axis_name, prepared):
+        C = params["leaf"].shape[1]
+        yf32 = jax.nn.one_hot(y, C, dtype=jnp.float32)       # (n, C)
+        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        prior = jnp.clip(
+            maybe_psum(w @ yf32, axis_name) / w_sum, 1e-6, 1.0
+        )
+        f0 = jnp.log(prior)                                  # (C,)
+        n = X.shape[0]
+
+        def round_body(F, m):
+            p = jax.nn.softmax(F, axis=-1)                   # (n, C)
+            h_unit = jnp.maximum(p * (1.0 - p), _HESS_FLOOR)
+            key_m = (
+                jax.random.fold_in(key, m) if key is not None else None
+            )
+            keep = self._round_row_mask(key_m, n, axis_name)
+            wr = w if keep is None else w * keep
+            h = wr[:, None] * h_unit                         # (n, C)
+            z = (yf32 - p) / h_unit
+
+            def grow_one(hc, zc, key_c):
+                S = jnp.stack([hc, hc * zc, hc * zc * zc], axis=1)
+                feat, thr, gain, node, _curve = self._grow(
+                    X, S, prepared, axis_name, key_c
+                )
+                stats = self._leaf_stats(node, S, axis_name)
+                leaf = jnp.where(
+                    stats[:, 0] > 0,
+                    stats[:, 1] / jnp.maximum(stats[:, 0], _EPS),
+                    0.0,
+                )
+                return feat, thr, gain, leaf, leaf[node]
+
+            keys_c = (
+                jax.vmap(lambda c: jax.random.fold_in(key_m, c))(
+                    jnp.arange(C)
+                )
+                if key_m is not None
+                # placeholder keys — only reachable with
+                # feature_subset unset (guarded in fit below), where
+                # _grow never consumes its key
+                else jnp.zeros((C,), jnp.uint32)
+            )
+            feat, thr, gain, leaf, upd = jax.vmap(grow_one)(
+                h.T, z.T, keys_c
+            )                                                # (C, ...)
+            F = F + self.lr * upd.T
+            logp = jax.nn.log_softmax(F, axis=-1)
+            nll = -jnp.sum(yf32 * logp, axis=1)
+            loss = maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
+            return F, (feat, thr, gain, leaf, loss)
+
+        F0 = jnp.broadcast_to(f0[None, :], (n, C))
+        _, (feats, thrs, gains, leaves, losses) = jax.lax.scan(
+            round_body, F0, jnp.arange(self.n_rounds)
+        )
+        new = {
+            "f0": f0,
+            "feature": feats.reshape(-1),
+            "threshold": thrs.reshape(-1),
+            "gain": gains.reshape(-1).astype(jnp.float32),
+            "leaf": leaves.astype(jnp.float32),
+        }
+        return new, {"loss": losses[-1], "loss_curve": losses}
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        if params["leaf"].ndim == 2:  # binary: scalar-margin engine
+            return super().fit(
+                params, X, y, sample_weight, key,
+                axis_name=axis_name, prepared=prepared,
+            )
+        self._validate_fit_key(key)
+        if key is None and self._n_split_features(X.shape[1]) is not None:
+            # mirror _grow's guard BEFORE the vmap substitutes
+            # placeholder keys: a zeros key would silently give every
+            # class tree identical feature-subset draws
+            raise ValueError(
+                "feature_subset per-split sampling needs the replica "
+                "fit key; fit was called with key=None"
+            )
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        return self._fit_multiclass(
+            params, X, y.astype(jnp.int32),
+            sample_weight.astype(jnp.float32), key, axis_name, prepared,
+        )
+
+    def _margin_multiclass(self, params, X):
+        M = 2**self.max_depth - 1
+        R = self.n_rounds
+        C = params["leaf"].shape[1]
+        feats = params["feature"].reshape(R, C, M)
+        thrs = params["threshold"].reshape(R, C, M)
+        leaves = params["leaf"]                              # (R, C, L)
+
+        def one_round(acc, xs):
+            f, t, lv = xs
+
+            def route_c(fc, tc, lc):
+                rel = self._route({"feature": fc, "threshold": tc}, X)
+                return lc[rel]
+
+            upd = jax.vmap(route_c)(f, t, lv)                # (C, n)
+            return acc + self.lr * upd.T, None
+
+        acc0 = jnp.broadcast_to(
+            params["f0"][None, :], (X.shape[0], C)
+        )
+        total, _ = jax.lax.scan(one_round, acc0, (feats, thrs, leaves))
+        return total
 
     def _init_margin(self, y, w, w_sum, axis_name):
         p = jnp.clip(
@@ -253,5 +402,7 @@ class GBTClassifier(_GBTBase):
         ) / w_sum
 
     def predict_scores(self, params, X):
+        if params["leaf"].ndim == 3:
+            return self._margin_multiclass(params, X)
         m = self._margin(params, X)
         return jnp.stack([jnp.zeros_like(m), m], axis=1)

@@ -106,7 +106,7 @@ class TestGBTRegressor:
 
 
 class TestGBTClassifier:
-    def test_accuracy_and_binary_guard(self):
+    def test_accuracy_and_param_layouts(self):
         X, y = load_breast_cancer(return_X_y=True)
         X = StandardScaler().fit_transform(X).astype(np.float32)
         gbt = GBTClassifier(n_rounds=30, max_depth=3, lr=0.2)
@@ -119,8 +119,9 @@ class TestGBTClassifier:
         assert (scores.argmax(1) == y).mean() > 0.97
         curve = np.asarray(aux["loss_curve"])
         assert np.all(np.diff(curve) <= 1e-5)
-        with pytest.raises(ValueError, match="binary-only"):
-            gbt.init_params(KEY, 5, 3)
+        # 3-class init allocates the multiclass (R, C, L) layout
+        p3 = gbt.init_params(KEY, 5, 3)
+        assert p3["leaf"].shape == (30, 3, 8)
 
     def test_bagged_gbt_and_importances(self):
         X, y = load_breast_cancer(return_X_y=True)
@@ -248,3 +249,88 @@ def test_subsample_sharded_decorrelated():
     assert np.isfinite(pred).all()
     r2 = 1 - np.var(pred - y) / np.var(y)
     assert r2 > 0.5
+
+
+class TestGBTMulticlass:
+    def test_iris_accuracy_and_loss(self):
+        from sklearn.datasets import load_iris
+
+        X, y = load_iris(return_X_y=True)
+        X = StandardScaler().fit_transform(X).astype(np.float32)
+        gbt = GBTClassifier(n_rounds=25, max_depth=3, lr=0.2)
+        params, aux = gbt.fit_from_init(
+            KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32),
+            jnp.ones(len(y)), 3,
+        )
+        scores = np.asarray(gbt.predict_scores(params, jnp.asarray(X)))
+        assert scores.shape == (len(y), 3)
+        assert (scores.argmax(1) == y).mean() > 0.95
+        curve = np.asarray(aux["loss_curve"])
+        assert np.all(np.diff(curve) <= 1e-5)
+
+    def test_bagged_multiclass_with_importances(self):
+        from sklearn.datasets import load_iris
+
+        X, y = load_iris(return_X_y=True)
+        X = X.astype(np.float32)
+        clf = BaggingClassifier(
+            base_learner=GBTClassifier(n_rounds=10, max_depth=2),
+            n_estimators=8, seed=0, oob_score=True,
+        ).fit(X, y)
+        assert clf.score(X, y) > 0.9
+        assert np.isfinite(clf.oob_score_)
+        imp = clf.feature_importances_
+        assert imp.shape == (4,)
+        assert imp.sum() == pytest.approx(1.0, abs=1e-5)
+        # petal features dominate iris
+        assert imp[2] + imp[3] > 0.5
+
+    def test_multiclass_subsample_and_checkpoint(self, tmp_path):
+        from sklearn.datasets import load_iris
+
+        from spark_bagging_tpu import load_model, save_model
+
+        X, y = load_iris(return_X_y=True)
+        X = X.astype(np.float32)
+        clf = BaggingClassifier(
+            base_learner=GBTClassifier(n_rounds=8, max_depth=2,
+                                       subsample=0.7),
+            n_estimators=4, seed=0,
+        ).fit(X, y)
+        assert clf.score(X, y) > 0.85
+        save_model(clf, str(tmp_path / "mc"))
+        clf2 = load_model(str(tmp_path / "mc"))
+        np.testing.assert_allclose(
+            clf.predict_proba(X[:32]), clf2.predict_proba(X[:32]),
+            rtol=1e-6,
+        )
+
+
+def test_multiclass_guards():
+    gbt = GBTClassifier(n_rounds=2, max_depth=2)
+    with pytest.raises(ValueError, match="2 classes"):
+        gbt.init_params(KEY, 4, 1)
+    # keyless multiclass fit with feature_subset must refuse (a zeros
+    # placeholder key would give every class tree identical draws)
+    fs = GBTClassifier(n_rounds=2, max_depth=2, feature_subset=2)
+    X = np.random.default_rng(0).normal(size=(30, 4)).astype(np.float32)
+    y = np.arange(30) % 3
+    p0 = fs.init_params(KEY, 4, 3)
+    with pytest.raises(ValueError, match="fit key"):
+        fs.fit(p0, jnp.asarray(X), jnp.asarray(y, jnp.int32),
+               jnp.ones(30), None)
+
+
+def test_multiclass_feature_subset_trees_differ():
+    """With a real key, per-class trees draw DIFFERENT feature masks."""
+    from sklearn.datasets import load_iris
+
+    X, y = load_iris(return_X_y=True)
+    X = X.astype(np.float32)
+    gbt = GBTClassifier(n_rounds=4, max_depth=2, feature_subset=2)
+    params, _ = gbt.fit_from_init(
+        KEY, jnp.asarray(X), jnp.asarray(y, jnp.int32),
+        jnp.ones(len(y)), 3,
+    )
+    feats = np.asarray(params["feature"]).reshape(4, 3, 3)
+    assert not (feats[:, 0] == feats[:, 1]).all()
