@@ -86,6 +86,17 @@ class _GBTBase(DecisionTreeRegressor):
                 "replica fit key; fit was called with key=None"
             )
 
+    @staticmethod
+    def _newton_leaf(stats):
+        """Leaf Newton step −G/H == weighted mean of z under h; empty
+        leaves emit 0 (no update). THE single home of the leaf policy —
+        binary and multiclass engines must never diverge here."""
+        return jnp.where(
+            stats[:, 0] > 0,
+            stats[:, 1] / jnp.maximum(stats[:, 0], _EPS),
+            0.0,
+        )
+
     def _round_row_mask(self, key_m, n, axis_name):
         """Stochastic-GBT keep mask for one round (None when
         subsample == 1). THE single home of the draw schedule: the
@@ -172,13 +183,7 @@ class _GBTBase(DecisionTreeRegressor):
                 X, S, prepared, axis_name, key_m
             )
             stats = self._leaf_stats(node, S, axis_name)   # (L, 3)
-            # Newton leaf step −G/H == weighted mean of z under h;
-            # empty leaves emit 0 (no update), not a global fallback
-            leaf = jnp.where(
-                stats[:, 0] > 0,
-                stats[:, 1] / jnp.maximum(stats[:, 0], _EPS),
-                0.0,
-            )
+            leaf = self._newton_leaf(stats)
             F = F + self.lr * leaf[node]
             loss = self._round_loss(yf, F, w, w_sum, axis_name)
             return F, (feat, thr, gain, leaf, loss)
@@ -298,17 +303,17 @@ class GBTClassifier(_GBTBase):
                     X, S, prepared, axis_name, key_c
                 )
                 stats = self._leaf_stats(node, S, axis_name)
-                leaf = jnp.where(
-                    stats[:, 0] > 0,
-                    stats[:, 1] / jnp.maximum(stats[:, 0], _EPS),
-                    0.0,
-                )
+                leaf = self._newton_leaf(stats)
                 return feat, thr, gain, leaf, leaf[node]
 
+            # class keys live under their own tag so the class index
+            # can never collide with the row-mask fold (0x5B) at C>=92
             keys_c = (
-                jax.vmap(lambda c: jax.random.fold_in(key_m, c))(
-                    jnp.arange(C)
-                )
+                jax.vmap(
+                    lambda c: jax.random.fold_in(
+                        jax.random.fold_in(key_m, 0x7EEE), c
+                    )
+                )(jnp.arange(C))
                 if key_m is not None
                 # placeholder keys — only reachable with
                 # feature_subset unset (guarded in fit below), where
