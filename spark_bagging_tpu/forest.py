@@ -41,6 +41,9 @@ class RandomForestClassifier(BaggingClassifier):
         feature_subset: str | float | int | None = "sqrt",
         leaf_smoothing: float = 1.0,
         split_impl: str = "auto",
+        criterion: str = "gini",
+        min_info_gain: float = 0.0,
+        min_instances_per_node: float = 0.0,
         max_samples: float | int = 1.0,
         bootstrap: bool = True,
         voting: str = "soft",
@@ -67,6 +70,9 @@ class RandomForestClassifier(BaggingClassifier):
         self.feature_subset = feature_subset
         self.leaf_smoothing = leaf_smoothing
         self.split_impl = split_impl
+        self.criterion = criterion
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
 
     def _learner(self) -> BaseLearner:
         return DecisionTreeClassifier(
@@ -75,6 +81,9 @@ class RandomForestClassifier(BaggingClassifier):
             leaf_smoothing=self.leaf_smoothing,
             split_impl=self.split_impl,
             feature_subset=self.feature_subset,
+            criterion=self.criterion,
+            min_info_gain=self.min_info_gain,
+            min_instances_per_node=self.min_instances_per_node,
         )
 
 
@@ -88,6 +97,8 @@ class RandomForestRegressor(BaggingRegressor):
         n_bins: int = 32,
         feature_subset: str | float | int | None = "onethird",
         split_impl: str = "auto",
+        min_info_gain: float = 0.0,
+        min_instances_per_node: float = 0.0,
         max_samples: float | int = 1.0,
         bootstrap: bool = True,
         oob_score: bool = False,
@@ -111,6 +122,8 @@ class RandomForestRegressor(BaggingRegressor):
         self.n_bins = n_bins
         self.feature_subset = feature_subset
         self.split_impl = split_impl
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
 
     def _learner(self) -> BaseLearner:
         return DecisionTreeRegressor(
@@ -118,4 +131,6 @@ class RandomForestRegressor(BaggingRegressor):
             n_bins=self.n_bins,
             split_impl=self.split_impl,
             feature_subset=self.feature_subset,
+            min_info_gain=self.min_info_gain,
+            min_instances_per_node=self.min_instances_per_node,
         )

@@ -66,6 +66,12 @@ class _GBTBase(DecisionTreeRegressor):
         super().__init__(
             max_depth, n_bins, hist_dtype, precision, split_impl,
             feature_subset,
+            # pre-pruning gates stay OFF for boosting: GBT split stats
+            # carry Newton Hessian mass (h = w·p(1−p), near the 1e-6
+            # floor for confident rounds), not row counts — a mass
+            # threshold would silently leaf-ify live nodes
+            min_info_gain=0.0,
+            min_instances_per_node=0.0,
         )
         if n_rounds < 1:
             raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")

@@ -128,6 +128,8 @@ class _TreeBase(BaseLearner):
         precision: str = "highest",
         split_impl: str = "auto",
         feature_subset: str | float | int | None = None,
+        min_info_gain: float = 0.0,
+        min_instances_per_node: float = 0.0,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
@@ -138,12 +140,23 @@ class _TreeBase(BaseLearner):
                 f"split_impl must be auto|dense|fused, got {split_impl!r}"
             )
         _check_feature_subset(feature_subset)
+        if min_info_gain < 0:
+            raise ValueError(
+                f"min_info_gain must be >= 0, got {min_info_gain}"
+            )
+        if min_instances_per_node < 0:
+            raise ValueError(
+                "min_instances_per_node must be >= 0, got "
+                f"{min_instances_per_node}"
+            )
         self.max_depth = max_depth
         self.n_bins = n_bins
         self.hist_dtype = hist_dtype
         self.precision = precision
         self.split_impl = split_impl
         self.feature_subset = feature_subset
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
 
     def _n_split_features(self, n_features: int) -> int | None:
         """Candidate features per SPLIT (Spark's featureSubsetStrategy
@@ -260,6 +273,18 @@ class _TreeBase(BaseLearner):
         ``feat_mask`` (N, F) restricts each node's candidate features
         (random-forest per-split sampling); masked-out candidates score
         +inf so the argmin never picks them.
+
+        Spark's pre-pruning regularizers [SURVEY §1 L3 param parity]
+        live here so the streamed fit inherits them: candidates whose
+        left or right side holds fewer than ``min_instances_per_node``
+        WEIGHTED rows score +inf (with integer Poisson bootstrap
+        weights that is an instance count in Spark's sense; with
+        fractional user sample_weight it is weight mass — scale the
+        threshold accordingly, which is why the gate defaults OFF at
+        0.0), and a node whose best decrease falls under
+        ``min_info_gain`` (or with no valid candidate at all) becomes
+        a leaf — its threshold is +inf, which routes every row left,
+        leaving the right subtree empty.
         """
         B = self.n_bins
         N = hist.shape[2]
@@ -270,6 +295,12 @@ class _TreeBase(BaseLearner):
             score = jnp.where(
                 feat_mask.T[:, None, :], score, jnp.inf
             )
+        if self.min_instances_per_node > 0:
+            ok = (
+                (self._row_count(hist) >= self.min_instances_per_node)
+                & (self._row_count(right) >= self.min_instances_per_node)
+            )
+            score = jnp.where(ok, score, jnp.inf)
         best = jnp.argmin(score.reshape(-1, N), axis=0)
         bf = (best // B).astype(jnp.int32)
         bb = (best % B).astype(jnp.int32)
@@ -280,7 +311,18 @@ class _TreeBase(BaseLearner):
         # per-node impurity decrease — the MDI numerator for
         # ``feature_importances_`` (Spark ML featureImportances analog)
         gain = jnp.maximum(self._impurity(total) - child, 0.0)
+        # leaf-ification: no valid candidate, or decrease under the
+        # floor — keep the node whole (leaf stats absorb its rows)
+        keep = jnp.isfinite(child) & (gain >= self.min_info_gain)
+        thr = jnp.where(keep, thr, jnp.inf)
+        gain = jnp.where(keep, gain, 0.0)
+        child = jnp.where(keep, child, self._impurity(total))
         return bf, thr, jnp.sum(child), gain
+
+    def _row_count(self, stats):
+        """Weighted row mass per candidate side (pre-pruning counts);
+        stats ``(..., K)``. Regression stats carry it in moment 0."""
+        return stats[..., 0]
 
     def _chunk_level_hist(self, Xs, S, edges, node, N):
         """Left-stats table ``(F, B, N, K)`` for one row block, with the
@@ -446,12 +488,20 @@ class DecisionTreeClassifier(_TreeBase):
         precision: str = "highest",
         split_impl: str = "auto",
         feature_subset: str | float | int | None = None,
+        min_info_gain: float = 0.0,
+        min_instances_per_node: float = 0.0,
+        criterion: str = "gini",
     ):
         super().__init__(
             max_depth, n_bins, hist_dtype, precision, split_impl,
-            feature_subset,
+            feature_subset, min_info_gain, min_instances_per_node,
         )
+        if criterion not in ("gini", "entropy"):
+            raise ValueError(
+                f"criterion must be gini|entropy, got {criterion!r}"
+            )
         self.leaf_smoothing = leaf_smoothing
+        self.criterion = criterion
 
     def init_params(self, key, n_features, n_outputs):
         del key
@@ -464,10 +514,20 @@ class DecisionTreeClassifier(_TreeBase):
         }
 
     def _impurity(self, stats):
-        """Weighted Gini mass: ``|side| · (1 − Σ_c p_c²)`` per
-        (feature, bin, node); stats is class counts ``(F, B, N, C)``."""
+        """Weighted impurity mass per (feature, bin, node) side; stats
+        is class counts ``(F, B, N, C)``. Gini: ``|side|·(1 − Σp²)``.
+        Entropy (Spark's other impurity): ``|side|·H = −Σ c·log(c/w)``
+        in nats."""
         w = stats.sum(-1)
+        if self.criterion == "entropy":
+            frac = stats / jnp.maximum(w, _EPS)[..., None]
+            return -jnp.sum(
+                stats * jnp.log(jnp.maximum(frac, _EPS)), axis=-1
+            )
         return w - (stats**2).sum(-1) / jnp.maximum(w, _EPS)
+
+    def _row_count(self, stats):
+        return stats.sum(-1)
 
     def _row_stats(self, y, w, n_outputs):
         """Per-row split statistics: weighted one-hot class counts."""

@@ -261,7 +261,11 @@ class TestTreeBagging:
         )
         clf.fit(X, y)
         thr = np.asarray(clf.ensemble_["threshold"])
-        assert np.isfinite(thr).all()
+        # padding-only shards must not poison the averaged edges with
+        # NaN; +inf entries are legitimate leaf-ified nodes (a 5-row
+        # fit cannot satisfy min_instances_per_node at every depth)
+        assert not np.isnan(thr).any()
+        assert np.isfinite(thr[:, 0]).all()  # the root always splits here
 
     def test_sharded_tree_fit_on_mesh(self):
         from spark_bagging_tpu import make_mesh
@@ -341,3 +345,128 @@ def test_feature_importances_requires_tree():
     clf = BaggingClassifier(n_estimators=2, seed=0).fit(X, y)
     with pytest.raises(AttributeError, match="tree base learner"):
         _ = clf.feature_importances_
+
+
+class TestPrePruning:
+    """Spark's minInfoGain / minInstancesPerNode / impurity params."""
+
+    def _data(self, n=400, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        return X, y
+
+    def test_entropy_criterion_trains(self):
+        X, y = self._data()
+        a = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=3,
+                                                criterion="entropy"),
+            n_estimators=4, seed=0,
+        ).fit(X, y)
+        assert a.score(X, y) > 0.9
+        b = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=3),
+            n_estimators=4, seed=0,
+        ).fit(X, y)
+        # different impurity, (generally) different thresholds
+        assert np.isfinite(np.asarray(a.ensemble_["threshold"])).any()
+        assert a.score(X, y) == pytest.approx(b.score(X, y), abs=0.05)
+        with pytest.raises(ValueError, match="criterion"):
+            DecisionTreeClassifier(criterion="logloss")
+
+    def test_min_info_gain_prunes_to_stump(self):
+        X, y = self._data()
+        # an absurd floor: no split clears it, so the tree is a single
+        # leaf (thresholds all +inf route everything left) predicting
+        # the majority class
+        tree = DecisionTreeClassifier(max_depth=3, min_info_gain=1e9)
+        import jax
+        import jax.numpy as jnp
+
+        params, _ = tree.fit_from_init(
+            jax.random.key(0), jnp.asarray(X),
+            jnp.asarray(y, jnp.int32), jnp.ones(len(y)), 2,
+        )
+        assert np.isinf(np.asarray(params["threshold"])).all()
+        assert np.asarray(params["gain"]).sum() == 0.0
+        pred = np.asarray(
+            tree.predict_scores(params, jnp.asarray(X)).argmax(1)
+        )
+        assert len(np.unique(pred)) == 1
+
+    def test_min_instances_blocks_tiny_splits(self):
+        """With a floor of 40% of rows per side, only near-median
+        splits are allowed at the root; deeper nodes (each holding
+        < 80% of rows... < 2x40%) become leaves."""
+        X, y = self._data(n=200)
+        import jax
+        import jax.numpy as jnp
+
+        tree = DecisionTreeClassifier(
+            max_depth=3, min_instances_per_node=80,
+        )
+        params, _ = tree.fit_from_init(
+            jax.random.key(0), jnp.asarray(X),
+            jnp.asarray(y, jnp.int32), jnp.ones(len(y)), 2,
+        )
+        thr = np.asarray(params["threshold"])
+        # root may split (100/100-ish sides); level-2+ nodes hold
+        # ~100 rows -> an 80-per-side split is impossible -> leaves
+        assert np.isfinite(thr[0])
+        assert np.isinf(thr[3:]).all()
+        with pytest.raises(ValueError, match="min_instances"):
+            DecisionTreeClassifier(min_instances_per_node=-1)
+        with pytest.raises(ValueError, match="min_info_gain"):
+            DecisionTreeClassifier(min_info_gain=-0.1)
+
+    def test_streamed_fit_inherits_pruning(self):
+        from spark_bagging_tpu import ArrayChunks, BaggingClassifier
+
+        X, y = self._data()
+        clf = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=3,
+                                                min_info_gain=1e9),
+            n_estimators=2, seed=0,
+        ).fit_stream(ArrayChunks(X, y, chunk_rows=100), classes=[0, 1])
+        assert np.isinf(np.asarray(clf.ensemble_["threshold"])).all()
+
+    def test_forest_exposes_knobs(self):
+        from spark_bagging_tpu import RandomForestClassifier
+
+        X, y = self._data()
+        rf = RandomForestClassifier(
+            n_estimators=8, max_depth=3, criterion="entropy",
+            min_instances_per_node=5, seed=0,
+        ).fit(X, y)
+        assert rf.score(X, y) > 0.9
+        assert rf.get_params()["criterion"] == "entropy"
+
+
+def test_fractional_weights_unaffected_by_default_gate():
+    """The instance gate defaults OFF: normalized fractional
+    sample_weight (mass << 1 per side) must fit normal trees, and GBTs
+    (whose stats carry Hessian mass, not counts) must keep splitting."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bagging_tpu import BaggingClassifier, GBTClassifier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    w = np.full(300, 1.0 / 300, np.float32)  # sums to 1
+    clf = BaggingClassifier(
+        base_learner=DecisionTreeClassifier(max_depth=3),
+        n_estimators=2, seed=0, bootstrap=False,
+    ).fit(X, y, sample_weight=w)
+    thr = np.asarray(clf.ensemble_["threshold"])
+    assert np.isfinite(thr[:, 0]).all()  # root split happened
+    assert clf.score(X, y) > 0.9
+    gbt = GBTClassifier(n_rounds=10, max_depth=2, lr=0.5)
+    params, _ = gbt.fit_from_init(
+        jax.random.key(0), jnp.asarray(X), jnp.asarray(y, jnp.int32),
+        jnp.ones(300), 2,
+    )
+    # late, confident rounds still split (Hessian mass << 1)
+    late = np.asarray(params["threshold"]).reshape(10, -1)[-1]
+    assert np.isfinite(late[0])
