@@ -79,7 +79,9 @@ class PrefetchChunks(ChunkSource):
             except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
                 put_or_stop(e)
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(
+            target=produce, daemon=True, name="prefetch-producer"
+        )
         t.start()
         try:
             while True:

@@ -11,8 +11,11 @@ from spark_bagging_tpu import ArrayChunks, BaggingClassifier, BaggingRegressor
 from spark_bagging_tpu.utils.prefetch import PrefetchChunks
 
 
-def _threads():
-    return {t.name for t in threading.enumerate() if t.is_alive()}
+def _producer_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.is_alive() and t.name == "prefetch-producer"
+    ]
 
 
 class TestPrefetchChunks:
@@ -63,12 +66,12 @@ class TestPrefetchChunks:
         X = np.zeros((10_000, 2), np.float32)
         y = np.zeros(10_000, np.float32)
         pf = PrefetchChunks(Slow(X, y, chunk_rows=16), depth=2)
-        before = len(_threads())
+        before = len(_producer_threads())
         it = pf.chunks()
         next(it)
-        it.close()  # abandon mid-epoch
-        time.sleep(0.5)
-        assert len(_threads()) <= before + 1  # producer exited
+        assert len(_producer_threads()) == before + 1
+        it.close()  # abandon mid-epoch (close() joins the producer)
+        assert len(_producer_threads()) == before  # producer exited
 
     def test_depth_validation(self):
         X = np.zeros((4, 2), np.float32)
