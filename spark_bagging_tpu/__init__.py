@@ -32,6 +32,7 @@ from spark_bagging_tpu.models import (
     GBTRegressor,
     GaussianNB,
     GeneralizedLinearRegression,
+    IsotonicRegression,
     LinearRegression,
     LinearSVC,
     LogisticRegression,
@@ -61,6 +62,7 @@ __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "IsotonicRegression",
     "GeneralizedLinearRegression",
     "FMClassifier",
     "FMRegressor",

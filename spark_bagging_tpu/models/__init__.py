@@ -11,6 +11,7 @@ from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.fm import FMClassifier, FMRegressor
 from spark_bagging_tpu.models.gbt import GBTClassifier, GBTRegressor
 from spark_bagging_tpu.models.glm import GeneralizedLinearRegression
+from spark_bagging_tpu.models.isotonic import IsotonicRegression
 from spark_bagging_tpu.models.linear import LinearRegression
 from spark_bagging_tpu.models.logistic import LogisticRegression
 from spark_bagging_tpu.models.mlp import MLPClassifier, MLPRegressor
@@ -29,6 +30,7 @@ __all__ = [
     "BaseLearner",
     "LogisticRegression",
     "LinearRegression",
+    "IsotonicRegression",
     "GeneralizedLinearRegression",
     "FMClassifier",
     "FMRegressor",

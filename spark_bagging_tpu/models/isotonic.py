@@ -1,0 +1,168 @@
+"""Isotonic regression — Spark ML's ``IsotonicRegression`` analog.
+
+Spark ships single-feature isotonic regression as a stock Predictor
+[B:5, SURVEY §1 L3], fit by pool-adjacent-violators. PAV is inherently
+sequential — it cannot jit or ``vmap`` as a static-shape program, which
+is why this family was initially a documented non-goal. The TPU-native
+formulation sidesteps PAV entirely:
+
+1. **Quantile-bin x** into ``n_bins`` buckets (the tree engine's
+   binning philosophy); accumulate weighted (Σw, Σw·y) per bin as ONE
+   ``(B, n) @ (n, 2)`` matmul.
+2. **Closed-form minimax**: the isotonic fit at bin i is
+   ``max_{j≤i} min_{k≥i} mean(y_j..y_k)`` — an O(B²) table of span
+   means from prefix sums, a reversed cummin over k, a cummax over j.
+   Every step is a dense vectorized op on a (B, B) array (64 KB at
+   B=128): static shapes, jit-clean, trivially ``vmap``-able over
+   replicas.
+
+Exactness: identical to PAV whenever every distinct x value occupies
+its own bin — guaranteed when each value holds at least ``n/n_bins``
+rows (balanced duplicates), and in particular whenever
+``n ≤ n_bins``. Quantile edges stride by ``n/n_bins`` ROWS, so a rare
+value inside a skewed distribution can share a bin with its neighbor;
+then the fit is isotonic regression on the binned means — the same
+binning approximation the tree engine makes, and the bagging ensemble
+averages over replicas anyway.
+Prediction interpolates linearly between bin centers (Spark's
+prediction semantics). ``increasing=False`` fits the antitonic case by
+sign-flipping y. Weighted fits treat Poisson counts as exact
+multiplicities via the bin accumulators [SURVEY §7 hard-part 2]; row
+reductions ride ``maybe_psum``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.models.tree import _quantile_edges
+from spark_bagging_tpu.ops.reduce import maybe_psum
+
+_EPS = 1e-12
+
+
+class IsotonicRegression(BaseLearner):
+    """Monotone single-feature regression (uses column 0 of X, like
+    Spark's featuresCol + featureIndex convention)."""
+
+    task = "regression"
+    streamable = False  # closed-form over bins; no gradient stream
+
+    def __init__(self, n_bins: int = 128, increasing: bool = True):
+        if n_bins < 2:
+            raise ValueError(f"n_bins must be >= 2, got {n_bins}")
+        self.n_bins = n_bins
+        self.increasing = increasing
+
+    def init_params(self, key, n_features, n_outputs):
+        del key, n_features, n_outputs
+        B = self.n_bins
+        return {
+            "centers": jnp.zeros((B,), jnp.float32),
+            "values": jnp.zeros((B,), jnp.float32),
+        }
+
+    # -- replica-invariant binning (computed ONCE via the prepare
+    #    hook, not per replica under vmap; subspace draws slice it) ---
+
+    def prepare(self, X, *, axis_name=None, row_mask=None):
+        interior, n_valid = _quantile_edges(X, row_mask, self.n_bins)
+        if axis_name is not None:
+            # masked per-shard averaging, the tree prepare convention:
+            # padding-only shards must not poison the edges
+            has = (n_valid > 0).astype(interior.dtype)
+            num = maybe_psum(
+                jnp.where(jnp.isfinite(interior), interior, 0.0) * has,
+                axis_name,
+            )
+            den = jnp.maximum(maybe_psum(has, axis_name), 1.0)
+            interior = num / den
+        return {"interior": interior}  # (F, B-1)
+
+    def gather_subspace(self, prepared, idx):
+        return {"interior": prepared["interior"][idx]}
+
+    def flops_per_fit(self, n_rows, n_features, n_outputs):
+        del n_features, n_outputs
+        B = self.n_bins
+        # binning one-hot matmul + the O(B²) minimax table
+        return float(4 * n_rows * B + 6 * B * B)
+
+    def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
+            prepared=None):
+        del params
+        del key
+        B = self.n_bins
+        x = X[:, 0].astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        if not self.increasing:
+            yf = -yf
+        w = sample_weight.astype(jnp.float32)
+
+        # bin GEOMETRY may ignore weights, the STATISTICS must not —
+        # the tree convention; edges come from the prepare() hook so
+        # replicas share ONE binning pass
+        if prepared is None:
+            prepared = self.prepare(X, axis_name=axis_name)
+        interior = prepared["interior"][0]               # (B-1,)
+        idx = jnp.searchsorted(interior, x, side="right")  # (n,) in [0,B)
+
+        onehot = jax.nn.one_hot(idx, B, dtype=jnp.float32)  # (n, B)
+        stats = maybe_psum(
+            onehot.T @ jnp.stack([w, w * yf, w * x], axis=1), axis_name
+        )                                                  # (B, 3)
+        W = stats[:, 0]
+        Swy = stats[:, 1]
+        # bin centers = weighted mean x per bin; empty bins fall back
+        # to the midpoint of their edges (predict interpolation anchor)
+        lo = jnp.concatenate([interior[:1], interior])
+        hi = jnp.concatenate([interior, interior[-1:]])
+        centers = jnp.where(
+            W > 0, stats[:, 2] / jnp.maximum(W, _EPS), 0.5 * (lo + hi)
+        )
+
+        # minimax isotonic fit over bins from prefix sums:
+        # A[j, k] = mean(y over bins j..k); empty spans -> +inf so the
+        # min step skips them, rows that stay +inf -> -inf so the max
+        # step skips those
+        cW = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(W)])
+        cS = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(Swy)])
+        Wspan = cW[None, 1:] - cW[:-1, None]             # (B, B) j,k
+        Sspan = cS[None, 1:] - cS[:-1, None]
+        valid = Wspan > 0
+        A = jnp.where(valid, Sspan / jnp.maximum(Wspan, _EPS), jnp.inf)
+        # min over k >= i: reversed cumulative min along k
+        Mink = jnp.flip(
+            jax.lax.cummin(jnp.flip(A, axis=1), axis=1), axis=1
+        )                                                # (B, B) j,i
+        R = jnp.where(jnp.isfinite(Mink), Mink, -jnp.inf)
+        # max over j <= i: cumulative max along j
+        iso = jax.lax.cummax(R, axis=0)                  # (B, B) j,i
+        values = jnp.diagonal(iso)                       # (B,)
+        # regions with no data anywhere reachable: global mean
+        gmean = jnp.sum(Swy) / jnp.maximum(jnp.sum(W), _EPS)
+        values = jnp.where(jnp.isfinite(values), values, gmean)
+        if not self.increasing:
+            values = -values
+
+        # weighted mean squared error for the report
+        pred = jnp.interp(x, centers, values)
+        target = y.astype(jnp.float32)
+        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        mse = maybe_psum(
+            jnp.sum(w * (pred - target) ** 2), axis_name
+        ) / jnp.maximum(w_sum, _EPS)
+        return (
+            {"centers": centers, "values": values},
+            {"loss": mse, "loss_curve": mse[None]},
+        )
+
+    def predict_scores(self, params, X):
+        """Linear interpolation between bin centers (Spark prediction
+        semantics); constant extrapolation beyond the data range."""
+        return jnp.interp(
+            X[:, 0].astype(jnp.float32),
+            params["centers"], params["values"],
+        )

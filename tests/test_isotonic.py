@@ -1,0 +1,139 @@
+"""IsotonicRegression: sklearn-PAV exactness on <=B distinct values,
+antitonic fits, weighted exactness, bagging integration [SURVEY §4]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import BaggingRegressor, IsotonicRegression
+
+KEY = jax.random.key(0)
+
+
+def _fit(iso, x, y, w=None):
+    n = len(y)
+    w = np.ones(n, np.float32) if w is None else w
+    X = np.asarray(x, np.float32)[:, None]
+    params, aux = iso.fit_from_init(
+        KEY, jnp.asarray(X), jnp.asarray(y, np.float32),
+        jnp.asarray(w, jnp.float32), 1,
+    )
+    return params, aux
+
+
+class TestExactness:
+    def test_matches_sklearn_pav_on_distinct_values(self):
+        """<= n_bins distinct x values: each gets its own bin, so the
+        minimax formula IS exact PAV — predictions at the training
+        points must match sklearn's to fp tolerance."""
+        from sklearn.isotonic import IsotonicRegression as SkIso
+
+        rng = np.random.default_rng(0)
+        xvals = np.sort(rng.choice(1000, 60, replace=False)).astype(
+            np.float32
+        )
+        x = np.repeat(xvals, 3)
+        y = (0.01 * x + rng.normal(0, 0.5, len(x))).astype(np.float32)
+        iso = IsotonicRegression(n_bins=128)
+        params, _ = _fit(iso, x, y)
+        ours = np.asarray(
+            iso.predict_scores(params, jnp.asarray(x[:, None]))
+        )
+        sk = SkIso().fit(x, y).predict(x)
+        np.testing.assert_allclose(ours, sk, rtol=1e-4, atol=1e-4)
+
+    def test_output_is_monotone(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=500).astype(np.float32)
+        y = (np.tanh(x) + 0.3 * rng.normal(size=500)).astype(np.float32)
+        iso = IsotonicRegression(n_bins=64)
+        params, _ = _fit(iso, x, y)
+        grid = np.linspace(x.min(), x.max(), 400, dtype=np.float32)
+        pred = np.asarray(
+            iso.predict_scores(params, jnp.asarray(grid[:, None]))
+        )
+        assert np.all(np.diff(pred) >= -1e-5)
+
+    def test_antitonic(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=400).astype(np.float32)
+        y = (-x + 0.2 * rng.normal(size=400)).astype(np.float32)
+        iso = IsotonicRegression(n_bins=64, increasing=False)
+        params, _ = _fit(iso, x, y)
+        grid = np.linspace(-2, 2, 200, dtype=np.float32)
+        pred = np.asarray(
+            iso.predict_scores(params, jnp.asarray(grid[:, None]))
+        )
+        assert np.all(np.diff(pred) <= 1e-5)
+        assert np.corrcoef(pred, -grid)[0, 1] > 0.99
+
+    def test_weighted_equals_duplicated(self):
+        rng = np.random.default_rng(3)
+        xvals = np.arange(40, dtype=np.float32)
+        y = (xvals * 0.1 + rng.normal(0, 0.3, 40)).astype(np.float32)
+        k = rng.poisson(1.0, 40) + 1
+        # n_bins >= the duplicated row count: every distinct value gets
+        # its own bin in BOTH fits (edge positions are unweighted order
+        # statistics, the documented binning semantic), isolating the
+        # weighted-statistics exactness being tested
+        iso = IsotonicRegression(n_bins=256)
+        pw, _ = _fit(iso, xvals, y, k.astype(np.float32))
+        pd, _ = _fit(
+            iso, np.repeat(xvals, k), np.repeat(y, k)
+        )
+        grid = jnp.asarray(xvals[:, None])
+        np.testing.assert_allclose(
+            np.asarray(iso.predict_scores(pw, grid)),
+            np.asarray(iso.predict_scores(pd, grid)),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n_bins"):
+            IsotonicRegression(n_bins=1)
+
+
+class TestIntegration:
+    def test_bagged_isotonic(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(600, 1)).astype(np.float32)
+        y = (np.tanh(2 * X[:, 0]) + 0.3 * rng.normal(size=600)).astype(
+            np.float32
+        )
+        reg = BaggingRegressor(
+            base_learner=IsotonicRegression(n_bins=64),
+            n_estimators=16, seed=0, oob_score=True,
+        ).fit(X, y)
+        assert reg.score(X, y) > 0.7
+        assert np.isfinite(reg.oob_score_)
+
+    def test_vmap_over_replicas(self):
+        rng = np.random.default_rng(5)
+        X = jnp.asarray(rng.normal(size=(100, 1)).astype(np.float32))
+        y = jnp.asarray(X[:, 0] * 2)
+        iso = IsotonicRegression(n_bins=32)
+        keys = jax.random.split(KEY, 4)
+        vals = jax.vmap(
+            lambda kk: iso.fit_from_init(
+                kk, X, y, jnp.ones(100), 1
+            )[0]["values"]
+        )(keys)
+        assert vals.shape == (4, 32)
+        assert np.isfinite(np.asarray(vals)).all()
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        from spark_bagging_tpu import load_model, save_model
+
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(200, 1)).astype(np.float32)
+        y = np.abs(X[:, 0]).astype(np.float32)
+        reg = BaggingRegressor(
+            base_learner=IsotonicRegression(n_bins=32),
+            n_estimators=4, seed=0,
+        ).fit(X, y)
+        save_model(reg, str(tmp_path / "iso"))
+        reg2 = load_model(str(tmp_path / "iso"))
+        np.testing.assert_allclose(
+            reg.predict(X[:50]), reg2.predict(X[:50]), rtol=1e-6
+        )
