@@ -23,6 +23,7 @@ from spark_bagging_tpu import (
     GBTRegressor,
     GaussianNB,
     GeneralizedLinearRegression,
+    IsotonicRegression,
     LinearRegression,
     LinearSVC,
     LogisticRegression,
@@ -78,6 +79,7 @@ regressors = [
     (MLPRegressor(hidden=32, max_iter=300), yz),
     (FMRegressor(factor_size=4, max_iter=300, lr=0.03), yz),
     (GBTRegressor(n_rounds=20, max_depth=3), yd),
+    (IsotonicRegression(n_bins=64), yd),  # single-feature (column 0)
 ]
 for learner, target in regressors:
     reg = BaggingRegressor(

@@ -37,7 +37,10 @@ import jax
 import jax.numpy as jnp
 
 from spark_bagging_tpu.models.base import BaseLearner
-from spark_bagging_tpu.models.tree import _quantile_edges
+from spark_bagging_tpu.models.tree import (
+    _psum_average_edges,
+    _quantile_edges,
+)
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _EPS = 1e-12
@@ -69,17 +72,9 @@ class IsotonicRegression(BaseLearner):
 
     def prepare(self, X, *, axis_name=None, row_mask=None):
         interior, n_valid = _quantile_edges(X, row_mask, self.n_bins)
-        if axis_name is not None:
-            # masked per-shard averaging, the tree prepare convention:
-            # padding-only shards must not poison the edges
-            has = (n_valid > 0).astype(interior.dtype)
-            num = maybe_psum(
-                jnp.where(jnp.isfinite(interior), interior, 0.0) * has,
-                axis_name,
-            )
-            den = jnp.maximum(maybe_psum(has, axis_name), 1.0)
-            interior = num / den
-        return {"interior": interior}  # (F, B-1)
+        return {
+            "interior": _psum_average_edges(interior, n_valid, axis_name)
+        }  # (F, B-1)
 
     def gather_subspace(self, prepared, idx):
         return {"interior": prepared["interior"][idx]}
@@ -109,9 +104,15 @@ class IsotonicRegression(BaseLearner):
         interior = prepared["interior"][0]               # (B-1,)
         idx = jnp.searchsorted(interior, x, side="right")  # (n,) in [0,B)
 
-        onehot = jax.nn.one_hot(idx, B, dtype=jnp.float32)  # (n, B)
+        # segment_sum, not a dense (n, B) one-hot: bin accumulation
+        # stays O(n + B) memory at any row count (a 45M-row f32
+        # one-hot would be ~23 GB)
         stats = maybe_psum(
-            onehot.T @ jnp.stack([w, w * yf, w * x], axis=1), axis_name
+            jax.ops.segment_sum(
+                jnp.stack([w, w * yf, w * x], axis=1), idx,
+                num_segments=B,
+            ),
+            axis_name,
         )                                                  # (B, 3)
         W = stats[:, 0]
         Swy = stats[:, 1]
@@ -134,9 +135,7 @@ class IsotonicRegression(BaseLearner):
         valid = Wspan > 0
         A = jnp.where(valid, Sspan / jnp.maximum(Wspan, _EPS), jnp.inf)
         # min over k >= i: reversed cumulative min along k
-        Mink = jnp.flip(
-            jax.lax.cummin(jnp.flip(A, axis=1), axis=1), axis=1
-        )                                                # (B, B) j,i
+        Mink = jax.lax.cummin(A, axis=1, reverse=True)   # (B, B) j,i
         R = jnp.where(jnp.isfinite(Mink), Mink, -jnp.inf)
         # max over j <= i: cumulative max along j
         iso = jax.lax.cummax(R, axis=0)                  # (B, B) j,i

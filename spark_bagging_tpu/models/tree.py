@@ -99,6 +99,22 @@ def _quantile_edges(X, row_mask, n_bins):
     return Xs[:, pos], n_valid  # (F, n_bins - 1)
 
 
+def _psum_average_edges(interior, n_valid, axis_name):
+    """Masked cross-shard averaging of quantile edges: shards holding
+    at least one valid row contribute; padding-only shards (whose
+    edges are +inf sentinels) are excluded. Shared by every learner
+    that bins through ``_quantile_edges`` under a data mesh."""
+    if axis_name is None:
+        return interior
+    has = (n_valid > 0).astype(interior.dtype)
+    num = maybe_psum(
+        jnp.where(jnp.isfinite(interior), interior, 0.0) * has,
+        axis_name,
+    )
+    den = jnp.maximum(maybe_psum(has, axis_name), 1.0)
+    return num / den
+
+
 class _TreeBase(BaseLearner):
     """Shared growth engine for classifier/regressor trees.
 
@@ -219,14 +235,7 @@ class _TreeBase(BaseLearner):
         edges with its +inf sentinel values.
         """
         interior, n_valid = _quantile_edges(X, row_mask, self.n_bins)
-        if axis_name is not None:
-            has_rows = (n_valid > 0).astype(interior.dtype)
-            num = maybe_psum(
-                jnp.where(jnp.isfinite(interior), interior, 0.0) * has_rows,
-                axis_name,
-            )
-            den = jnp.maximum(maybe_psum(has_rows, axis_name), 1.0)
-            interior = num / den
+        interior = _psum_average_edges(interior, n_valid, axis_name)
         F = X.shape[1]
         edges = jnp.concatenate(
             [interior, jnp.full((F, 1), jnp.inf, X.dtype)], axis=1

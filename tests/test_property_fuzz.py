@@ -18,6 +18,7 @@ from spark_bagging_tpu.models import (
     GBTRegressor,
     GaussianNB,
     GeneralizedLinearRegression,
+    IsotonicRegression,
     LinearRegression,
     LinearSVC,
     LogisticRegression,
@@ -44,6 +45,7 @@ REGRESSORS = [
     GeneralizedLinearRegression(family="gaussian"),
     GeneralizedLinearRegression(family="poisson", max_iter=5),
     DecisionTreeRegressor(max_depth=3, n_bins=8),
+    IsotonicRegression(n_bins=16),
     MLPRegressor(hidden=8, max_iter=30),
     FMRegressor(factor_size=2, max_iter=30),
     GBTRegressor(n_rounds=4, max_depth=2, n_bins=8),
