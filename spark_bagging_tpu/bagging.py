@@ -1058,6 +1058,7 @@ class BaggingRegressor(_BaseBagging):
     def fit(self, X, y, sample_weight=None) -> "BaggingRegressor":
         """Fit the ensemble; ``sample_weight`` as in
         :meth:`BaggingClassifier.fit`."""
+        self.__dict__.pop("_collapsed_beta_cache", None)
         X = self._validate_X(X)
         y = np.asarray(y, np.float32)
         if y.ndim == 2 and y.shape[1] == 1:
@@ -1101,6 +1102,7 @@ class BaggingRegressor(_BaseBagging):
         [SURVEY §7 step 8]; see ``BaggingClassifier.fit_stream``."""
         from spark_bagging_tpu.utils.io import as_chunk_source
 
+        self.__dict__.pop("_collapsed_beta_cache", None)
         source = as_chunk_source(source, chunk_rows)
         self._fit_stream_engine(source, 1, n_epochs=n_epochs,
                                 steps_per_chunk=steps_per_chunk, lr=lr,
@@ -1113,10 +1115,45 @@ class BaggingRegressor(_BaseBagging):
             self._finalize_oob(sums, votes, y_np)
         return self
 
+    def _linear_collapse(self) -> "np.ndarray | None":
+        """(D+1,) mean coefficients when the fitted learner's predict
+        is LINEAR in its params (ridge, identity-link GLM): the bagged
+        mean of R linear predictions equals one prediction with the
+        subspace-scattered mean betas — EXACT, so inference is a single
+        host matvec instead of an R-replica device program. Cached per
+        fit; None for non-collapsible learners."""
+        if not hasattr(self, "_collapsed_beta_cache"):
+            cache = None
+            beta_fn = getattr(self._fitted_learner, "linear_beta", None)
+            if beta_fn is not None:
+                stacked = beta_fn(self.ensemble_)
+                if stacked is not None:
+                    B = np.asarray(to_host(stacked), np.float64)
+                    subs = np.asarray(to_host(self.subspaces_))
+                    D = self.n_features_in_
+                    out = np.zeros((B.shape[0], D + 1), np.float64)
+                    rows = np.arange(B.shape[0])[:, None]
+                    np.add.at(out, (rows, subs), B[:, :-1])
+                    out[:, -1] = B[:, -1]
+                    cache = out.mean(axis=0).astype(np.float32)
+            self._collapsed_beta_cache = cache
+        return self._collapsed_beta_cache
+
     def predict(self, X) -> np.ndarray:
         self._check_fitted()
         X = self._validate_X(X, fitted=True)
         n = X.shape[0]
+        beta = self._linear_collapse()
+        if beta is not None:
+            # to_host: a jax.Array X may be non-fully-addressable on a
+            # multi-process mesh — gather it the way the device path's
+            # global_put/to_host pair would. _validate_X already
+            # guarantees float32, no recast copy needed.
+            Xh = (
+                np.asarray(to_host(X)) if isinstance(X, jax.Array)
+                else np.asarray(X)
+            )
+            return np.asarray(Xh @ beta[:-1] + beta[-1], np.float32)
         if self.mesh is not None:
             X = pad_rows_X(X, self.mesh.shape.get(DATA_AXIS, 1))
             X = global_put(X, self.mesh, P(DATA_AXIS, None))

@@ -171,6 +171,13 @@ class GeneralizedLinearRegression(BaseLearner):
         Xf = X.astype(beta.dtype)
         return self._mean(Xf @ beta[:-1] + beta[-1])
 
+    def linear_beta(self, params):
+        """Identity-link prediction is linear in beta (collapsible for
+        bagged mean inference); nonlinear links are not."""
+        if self._resolved_link() == "identity":
+            return params["beta"]
+        return None
+
     def flops_per_fit(self, n_rows, n_features, n_outputs):
         del n_outputs
         n, d = n_rows, n_features + 1

@@ -38,6 +38,13 @@ class LinearRegression(BaseLearner):
         beta = params["beta"]
         return X.astype(beta.dtype) @ beta[:-1] + beta[-1]
 
+    def linear_beta(self, params):
+        """Prediction is linear in beta, so a bagged ensemble's mean
+        prediction collapses to ONE model with the (subspace-scattered)
+        mean coefficients — used by BaggingRegressor's exact
+        inference fast path."""
+        return params["beta"]
+
     def flops_per_fit(self, n_rows, n_features, n_outputs):
         del n_outputs
         n, d = n_rows, n_features + 1

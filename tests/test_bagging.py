@@ -491,3 +491,63 @@ def test_replica_params_slices_match_ensemble(breast_cancer):
     )
     with pytest.raises(IndexError):
         clf.replica_params(6)
+
+
+class TestLinearCollapseInference:
+    """Bagged-mean prediction of params-linear learners collapses to
+    ONE model with scatter-meaned coefficients — must match the
+    R-replica device path exactly (same math, fp rounding only)."""
+
+    def _device_pred(self, reg, X):
+        reg.__dict__["_collapsed_beta_cache"] = None  # force device path
+        pred = reg.predict(X)
+        del reg.__dict__["_collapsed_beta_cache"]
+        return pred
+
+    def test_ridge_with_subspaces_matches_device_path(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 12)).astype(np.float32)
+        y = (X @ rng.normal(size=12) + 0.1 * rng.normal(size=300)).astype(
+            np.float32
+        )
+        reg = BaggingRegressor(
+            n_estimators=24, seed=0, max_features=0.5,
+            bootstrap_features=True,  # duplicated columns must add
+        ).fit(X, y)
+        assert reg._linear_collapse() is not None
+        np.testing.assert_allclose(
+            reg.predict(X), self._device_pred(reg, X), rtol=2e-4,
+            atol=2e-4,
+        )
+
+    def test_glm_identity_collapses_log_does_not(self):
+        from spark_bagging_tpu.models import GeneralizedLinearRegression
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 5)).astype(np.float32)
+        y = np.abs(X[:, 0] + 0.1 * rng.normal(size=200)).astype(np.float32)
+        a = BaggingRegressor(
+            base_learner=GeneralizedLinearRegression(family="gaussian"),
+            n_estimators=8, seed=0,
+        ).fit(X, y)
+        assert a._linear_collapse() is not None
+        b = BaggingRegressor(
+            base_learner=GeneralizedLinearRegression(
+                family="poisson", max_iter=4
+            ),
+            n_estimators=8, seed=0,
+        ).fit(X, y)
+        assert b._linear_collapse() is None  # log link: not linear
+
+    def test_refit_invalidates_cache(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        reg = BaggingRegressor(n_estimators=4, seed=0).fit(X, y)
+        p1 = reg.predict(X)
+        y2 = (2 * X[:, 1]).astype(np.float32)
+        reg.fit(X, y2)
+        p2 = reg.predict(X)
+        assert not np.allclose(p1, p2)
+        r2 = 1 - np.var(p2 - y2) / np.var(y2)
+        assert r2 > 0.9
