@@ -700,6 +700,23 @@ class _BaseBagging(ParamsMixin):
         self._identity_subspace = (
             n_subspace == source.n_features and not self.bootstrap_features
         )
+        # FLOPs/MFU: the multi-pass tree stream does exactly the
+        # in-memory fit's contractions (the cost model applies); the
+        # SGD stream's cost depends on the epoch/step schedule and has
+        # no model — better absent than wrong. Resumed fits skip
+        # completed passes, so full-fit FLOPs over partial wall-clock
+        # would inflate MFU (even past chip peak) — omit there too.
+        stream_flops = (
+            learner.flops_per_fit(
+                int(source.n_rows), n_subspace, n_outputs
+            )
+            if "n_passes" in aux and resume_from is None else None
+        )
+        # the stream's wall-clock includes the first step's compile;
+        # exclude it from the MFU denominator like the in-memory path
+        flops_secs = None
+        if stream_flops is not None and aux.get("first_step_seconds"):
+            flops_secs = max(t_fit - aux["first_step_seconds"], 1e-9)
         self.fit_report_ = fit_report(
             n_replicas=self.n_estimators,
             fit_seconds=t_fit,
@@ -710,6 +727,8 @@ class _BaseBagging(ParamsMixin):
             backend=jax.default_backend(),
             n_devices=jax.device_count(),
             compile_seconds=aux["first_step_seconds"],
+            flops_per_fit=stream_flops,
+            flops_fit_seconds=flops_secs,
         )
         self.fit_report_["n_chunks"] = aux["n_chunks"]
         self.fit_report_["n_epochs"] = aux["n_epochs"]

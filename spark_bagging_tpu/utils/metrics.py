@@ -93,6 +93,7 @@ def fit_report(
     compile_seconds: float | None = None,
     h2d_seconds: float | None = None,
     flops_per_fit: float | None = None,
+    flops_fit_seconds: float | None = None,
 ) -> dict[str, Any]:
     """Structured training report [SURVEY §5 metrics].
 
@@ -124,10 +125,17 @@ def fit_report(
         report["fits_per_sec_e2e"] = (
             n_replicas / e2e if e2e > 0 else float("inf")
         )
-    if flops_per_fit is not None and fit_seconds > 0:
+    # MFU denominator may differ from fit_seconds when the caller's
+    # wall-clock includes a one-time compile it cannot split out (the
+    # streaming engines' first step) — compile must not dilute MFU
+    denom = (
+        flops_fit_seconds if flops_fit_seconds and flops_fit_seconds > 0
+        else fit_seconds
+    )
+    if flops_per_fit is not None and denom > 0:
         from spark_bagging_tpu.utils.profiling import device_peak_tflops
 
-        achieved = flops_per_fit * n_replicas / fit_seconds / 1e12
+        achieved = flops_per_fit * n_replicas / denom / 1e12
         peak = device_peak_tflops()
         report["model_flops_per_fit"] = flops_per_fit
         report["achieved_tflops"] = achieved
