@@ -140,5 +140,10 @@ def fit_report(
         report["model_flops_per_fit"] = flops_per_fit
         report["achieved_tflops"] = achieved
         report["peak_tflops_bf16"] = peak
-        report["mfu"] = achieved / peak if peak else None
+        # achieved aggregates every device's work, so utilization is
+        # measured against the MESH's peak, not one chip's — an 8-chip
+        # fit at 40% real MFU must not print 3.2
+        report["mfu"] = (
+            achieved / (peak * max(n_devices, 1)) if peak else None
+        )
     return report

@@ -71,7 +71,24 @@ class ParamsMixin:
         return type(self)(**params)
 
     def __repr__(self) -> str:
-        args = ", ".join(
-            f"{name}={getattr(self, name)!r}" for name in self._param_names()
-        )
-        return f"{type(self).__name__}({args})"
+        """sklearn-style repr: only params that differ from their
+        ``__init__`` defaults are shown, so a 15-param estimator with
+        one override reads as the one override."""
+        defaults = {
+            name: p.default
+            for name, p in inspect.signature(
+                type(self).__init__
+            ).parameters.items()
+            if p.default is not inspect.Parameter.empty
+        }
+        shown = []
+        for name in self._param_names():
+            value = getattr(self, name)
+            default = defaults.get(name, inspect.Parameter.empty)
+            try:
+                is_default = (value == default) is True
+            except Exception:  # noqa: BLE001 — uncomparable values print
+                is_default = False
+            if not is_default:
+                shown.append(f"{name}={value!r}")
+        return f"{type(self).__name__}({', '.join(shown)})"

@@ -551,3 +551,15 @@ class TestLinearCollapseInference:
         assert not np.allclose(p1, p2)
         r2 = 1 - np.var(p2 - y2) / np.var(y2)
         assert r2 > 0.9
+
+
+def test_repr_elides_defaults():
+    """sklearn-style repr: only non-default params appear."""
+    from spark_bagging_tpu import RandomForestClassifier
+
+    assert repr(LogisticRegression()) == "LogisticRegression()"
+    r = repr(BaggingClassifier(base_learner=LogisticRegression(l2=0.5)))
+    assert r == "BaggingClassifier(base_learner=LogisticRegression(l2=0.5))"
+    r2 = repr(RandomForestClassifier(n_estimators=32, criterion="entropy"))
+    assert "n_estimators=32" in r2 and "criterion='entropy'" in r2
+    assert "max_depth" not in r2  # default elided
