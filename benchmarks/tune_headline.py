@@ -15,6 +15,10 @@ results = []
 for impl, chunk, row_tile in [
     ("blocked", 200, None), ("blocked", 100, None), ("blocked", 300, None),
     ("blocked", 400, 65536), ("blocked", 500, 65536),
+    # HBM-aware auto chunk [VERDICT r2 ask#8]: must pick a working
+    # chunk unattended (the cell also validates the bytes model on
+    # real silicon)
+    ("blocked", None, None),
     # packed: blocked FLOPs at ~2.4x the MXU output-tile fill; temp is
     # O(tile*P*d) so it needs row tiling and a smaller replica chunk
     ("packed", 50, 16384), ("packed", 100, 8192), ("packed", 200, 4096),
@@ -33,8 +37,18 @@ for impl, chunk, row_tile in [
         for r in range(2):
             clf.fit(X, y)
             rep = clf.fit_report_
-            best = min(best or 1e9, rep["fit_seconds"])
+            if best is None or rep["fit_seconds"] < best:
+                best = rep["fit_seconds"]
+                # the winning rep's on-chip efficiency [VERDICT r2 ask#2]
+                cell["mfu"] = (
+                    round(rep["mfu"], 3) if rep.get("mfu") else None
+                )
+                cell["tflops"] = (
+                    round(rep["achieved_tflops"], 1)
+                    if rep.get("achieved_tflops") else None
+                )
         cell["fps"] = round(1000 / best, 1)
+        cell["chunk_resolved"] = rep.get("chunk_size_resolved", chunk)
         cell["acc"] = round(float(clf.score(X[:100_000], y[:100_000])), 4)
     except Exception as e:
         cell["error"] = f"{type(e).__name__}: {e}"[:200]
