@@ -22,6 +22,7 @@ from spark_bagging_tpu.forest import (
     RandomForestRegressor,
 )
 from spark_bagging_tpu.models import (
+    AFTSurvivalRegression,
     BaseLearner,
     BernoulliNB,
     DecisionTreeClassifier,
@@ -60,6 +61,7 @@ __all__ = [
     "RandomForestClassifier",
     "RandomForestRegressor",
     "BaseLearner",
+    "AFTSurvivalRegression",
     "LogisticRegression",
     "LinearRegression",
     "IsotonicRegression",

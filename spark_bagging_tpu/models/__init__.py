@@ -8,6 +8,7 @@ function of (params, X, y, sample_weight, key) so the ensemble engine can
 """
 
 from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.models.aft import AFTSurvivalRegression
 from spark_bagging_tpu.models.fm import FMClassifier, FMRegressor
 from spark_bagging_tpu.models.gbt import GBTClassifier, GBTRegressor
 from spark_bagging_tpu.models.glm import GeneralizedLinearRegression
@@ -28,6 +29,7 @@ from spark_bagging_tpu.models.tree import (
 
 __all__ = [
     "BaseLearner",
+    "AFTSurvivalRegression",
     "LogisticRegression",
     "LinearRegression",
     "IsotonicRegression",

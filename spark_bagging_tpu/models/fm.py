@@ -78,6 +78,15 @@ class _FMBase(BaseLearner):
         # ≈ 2x forward (standard AD accounting)
         return float(self.max_iter * 3 * (4 * n * d * k * C + 2 * n * d * C))
 
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        k = self.factor_size
+        C = self._n_scores(n_outputs)
+        # two (n, d)@(d, kC) pairwise matmuls + the linear term; x3
+        return float(
+            3 * (4 * chunk_rows * n_features * k * C
+                 + 2 * chunk_rows * n_features * C)
+        )
+
     def _raw_scores(self, params, X):
         """(n, C) FM scores: linear + factorized pairwise terms."""
         X = X.astype(jnp.float32)

@@ -186,6 +186,16 @@ class GeneralizedLinearRegression(BaseLearner):
 
     # -- streaming contract (SGD engine minimizes w·row_loss + penalty) -
 
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        del n_outputs  # scalar linear predictor
+        return float(6 * chunk_rows * (n_features + 1))
+
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        del n_outputs
+        # IRLS: scaled design copy (n, d+1) + working response/weight
+        # vectors per iteration (buffers reused across iterations)
+        return float(4 * n_rows * (n_features + 5))
+
     def row_loss(self, params, X, y):
         return 0.5 * self._unit_deviance(
             y.astype(jnp.float32), self.predict_scores(params, X)

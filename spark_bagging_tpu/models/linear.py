@@ -53,6 +53,15 @@ class LinearRegression(BaseLearner):
 
     # -- streaming contract (out-of-core engine, streaming.py) ---------
 
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        del n_outputs  # scalar output
+        return float(6 * chunk_rows * (n_features + 1))
+
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        del n_outputs
+        # normal equations: √w-scaled design copy (n, d+1) + weights
+        return float(4 * n_rows * (n_features + 3))
+
     def row_loss(self, params, X, y):
         return 0.5 * (self.predict_scores(params, X) - y) ** 2
 

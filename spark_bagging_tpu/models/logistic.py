@@ -132,6 +132,19 @@ class LogisticRegression(BaseLearner):
 
     # -- streaming contract (out-of-core engine, streaming.py) ---------
 
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        # one (n, d+1)@(d+1, C) forward; x3 for fwd+bwd
+        return float(6 * chunk_rows * (n_features + 1) * n_outputs)
+
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        # dominant temps: the (n, C) softmax probs + (n,) weights (+
+        # slack for the Hessian assembly's transient scaled rows).
+        # With row_tile the probs temp is bounded at (row_tile, C).
+        # Calibrated against the v5e headline: chunk=200 fits, 500
+        # OOMs [bench.py] — this model + the 0.35 budget lands ~250.
+        probs_rows = self.row_tile if self.row_tile else n_rows
+        return float(4 * (probs_rows * n_outputs + 2 * n_rows))
+
     def row_loss(self, params, X, y):
         logp = jax.nn.log_softmax(self.predict_scores(params, X), axis=-1)
         return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]

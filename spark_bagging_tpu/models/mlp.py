@@ -97,6 +97,22 @@ class _MLPBase(BaseLearner):
         per_step = 6 * b * (n_features * self.hidden + self.hidden * n_outputs)
         return float(self.max_iter * per_step)
 
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        return float(
+            6 * chunk_rows
+            * (n_features * self.hidden + self.hidden * n_outputs)
+        )
+
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        b = min(self.batch_size or n_rows, n_rows)
+        # activations + their adjoints (~3x) on one minibatch, Adam's
+        # 3 param copies (params + 2 moments)
+        return float(
+            12 * b * (self.hidden + n_outputs)
+            + 12 * (n_features * self.hidden + self.hidden * n_outputs)
+            + 4 * n_rows  # per-replica weight vector
+        )
+
     def _row_loss(self, params, X, y):
         """Per-row unweighted loss ``(n,)``; task-specific."""
         raise NotImplementedError

@@ -88,6 +88,9 @@ class LinearSVC(BaseLearner):
 
     # -- streaming contract (out-of-core engine, streaming.py) ---------
 
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        return float(6 * chunk_rows * (n_features + 1) * n_outputs)
+
     def row_loss(self, params, X, y):
         M = self.predict_scores(params, X)
         T = 2.0 * jax.nn.one_hot(y, M.shape[1], dtype=M.dtype) - 1.0

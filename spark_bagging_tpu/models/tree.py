@@ -263,6 +263,18 @@ class _TreeBase(BaseLearner):
             2 * n_rows * n_features * self.n_bins * K * nodes_total
         )
 
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        del n_features  # T indicators are shared (prepare), not per-replica
+        # dominant per-replica temp: the (n, N·K) row-stat operand at
+        # the deepest level (N = 2^(d−1) nodes), in hist_dtype, plus
+        # weight/assignment vectors
+        K = n_outputs if self.task == "classification" else 3
+        hist_bytes = 2 if self.hist_dtype == "bfloat16" else 4
+        return float(
+            hist_bytes * n_rows * (2 ** (self.max_depth - 1)) * K
+            + 8 * n_rows
+        )
+
     # -- growth ---------------------------------------------------------
 
     def _hdt(self):

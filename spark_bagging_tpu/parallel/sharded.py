@@ -113,6 +113,7 @@ def sharded_fit(
     bootstrap_features: bool = False,
     chunk_size: int | None = None,
     id_offset: int = 0,
+    aux: jnp.ndarray | None = None,
 ) -> tuple[Any, jnp.ndarray, dict[str, jnp.ndarray]]:
     """Ensemble fit over the mesh; same contract as
     :func:`spark_bagging_tpu.ensemble.fit_ensemble`.
@@ -121,28 +122,35 @@ def sharded_fit(
     (sharded ``P(replica)`` on device); losses likewise. ``id_offset``
     shifts the replica ids (warm start: ids [offset, offset+n) draw the
     same streams a cold fit of a larger ensemble would give them).
+    ``aux`` (per-row auxiliary column, e.g. AFT censor flags) shards
+    over the data axis alongside ``y``; pad it like ``y`` first.
     """
     _check_divisible(X.shape[0], n_replicas, mesh)
     data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
 
+    with_aux = aux is not None
+    in_specs = [
+        P(DATA_AXIS, None),   # X rows
+        P(DATA_AXIS),         # y
+        P(DATA_AXIS),         # row mask
+        P(),                  # key (replicated)
+        P(REPLICA_AXIS),      # replica ids
+    ]
+    if with_aux:
+        in_specs.append(P(DATA_AXIS))
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(DATA_AXIS, None),   # X rows
-            P(DATA_AXIS),         # y
-            P(DATA_AXIS),         # row mask
-            P(),                  # key (replicated)
-            P(REPLICA_AXIS),      # replica ids
-        ),
+        in_specs=tuple(in_specs),
         out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
         # jax.random.poisson's internal while_loop mixes replica-varying
         # keys with unvarying carry inits and fails the VMA type check;
         # disable it (costs only the replication-tracking optimization).
         check_vma=False,
     )
-    def _fit(Xs, ys, mask, k, ids):
-        params, subspaces, aux = fit_ensemble(
+    def _fit(Xs, ys, mask, k, ids, *aux_s):
+        params, subspaces, fit_aux = fit_ensemble(
             learner, Xs, ys, k, ids, n_outputs,
             sample_ratio=sample_ratio,
             bootstrap=bootstrap,
@@ -151,11 +159,13 @@ def sharded_fit(
             data_axis=data_axis,
             chunk_size=chunk_size,
             row_mask=mask,
+            aux=aux_s[0] if aux_s else None,
         )
-        return params, subspaces, aux["loss"]
+        return params, subspaces, fit_aux["loss"]
 
     ids = id_offset + jnp.arange(n_replicas, dtype=jnp.int32)
-    params, subspaces, losses = _fit(X, y, row_mask, key, ids)
+    args = (X, y, row_mask, key, ids) + ((aux,) if with_aux else ())
+    params, subspaces, losses = _fit(*args)
     return params, subspaces, {"loss": losses}
 
 

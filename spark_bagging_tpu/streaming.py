@@ -380,6 +380,11 @@ def fit_ensemble_stream(
         "n_epochs": n_epochs,
         "stream_seconds": time.perf_counter() - t0,
         "first_step_seconds": compile_seconds,
+        # optimizer steps actually executed THIS call (a resumed fit
+        # counts only its own steps) — the honest-accounting basis for
+        # the stream FLOPs model [VERDICT r2 ask#6]
+        "opt_steps": steps_done * steps_per_chunk,
+        "chunk_rows": chunk_rows,
     }
     return params, subspaces, aux
 

