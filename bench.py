@@ -236,7 +236,10 @@ def main() -> None:
         cache[config_key] = measure_cpu_baseline(X, y, args.l2)
         with open(CACHE_PATH, "w") as f:
             json.dump(cache, f, indent=2)
-    if "parallel" not in cache[config_key]:
+    # the parallel baseline is host-shaped: a cached entry from a
+    # different core count would silently mis-scale vs_baseline_parallel
+    cached_cores = cache[config_key].get("parallel", {}).get("cpu_cores")
+    if cached_cores != (os.cpu_count() or 1):
         cache[config_key]["parallel"] = measure_cpu_baseline_parallel(
             X, y, args.l2
         )

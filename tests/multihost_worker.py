@@ -71,6 +71,21 @@ def main() -> None:
     ).fit(X, y)
     rf_acc = float(rf.score(X, y))
 
+    # aux channel across processes: the censor column global_puts with
+    # a P(data) spec exactly like y — each process ships its shard only
+    from spark_bagging_tpu import AFTSurvivalRegression, BaggingRegressor
+
+    rng = np.random.default_rng(0)
+    T = np.exp(
+        X[:, 0] * 0.5 + 0.3 * np.log(rng.exponential(1.0, len(y)))
+    ).astype(np.float32)
+    cutoff = np.quantile(T, 0.7)
+    aft = BaggingRegressor(
+        base_learner=AFTSurvivalRegression(max_iter=40),
+        n_estimators=4, seed=1, mesh=mesh,
+    ).fit(X, np.minimum(T, cutoff), aux=(T <= cutoff).astype(np.float32))
+    aft_pred_head = np.asarray(aft.predict(X[:16])).tolist()
+
     with open(f"{out_path}.{pid}", "w") as f:
         json.dump({
             "process_id": pid,
@@ -81,6 +96,7 @@ def main() -> None:
             "losses_mean": float(np.mean(clf.fit_report_["loss_mean"])),
             "stream_accuracy": stream_acc,
             "rf_accuracy": rf_acc,
+            "aft_pred_head": aft_pred_head,
         }, f)
 
 

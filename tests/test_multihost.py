@@ -121,3 +121,14 @@ def test_multihost_forest_fit(worker_results):
     a, b = worker_results
     assert a["rf_accuracy"] == pytest.approx(b["rf_accuracy"], abs=1e-6)
     assert a["rf_accuracy"] > 0.9
+
+
+def test_multihost_aft_aux_channel(worker_results):
+    """The aux (censor) column shards over the process-spanning data
+    axis like y; both processes converge to the same bagged AFT model
+    and its predictions are positive survival times."""
+    a, b = worker_results
+    np.testing.assert_allclose(
+        a["aft_pred_head"], b["aft_pred_head"], rtol=1e-6
+    )
+    assert (np.asarray(a["aft_pred_head"]) > 0).all()

@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 from spark_bagging_tpu.models import (
+    AFTSurvivalRegression,
     BernoulliNB,
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -41,6 +42,9 @@ CLASSIFIERS = [
     GBTClassifier(n_rounds=4, max_depth=2, n_bins=8),
 ]
 REGRESSORS = [
+    # aux=None ⇒ fully-observed Weibull regression (positive y required
+    # — _reg_data guarantees it)
+    AFTSurvivalRegression(max_iter=30),
     LinearRegression(),
     GeneralizedLinearRegression(family="gaussian"),
     GeneralizedLinearRegression(family="poisson", max_iter=5),
