@@ -652,6 +652,7 @@ class _BaseBagging(ParamsMixin):
         self, source, n_outputs: int, *, n_epochs: int,
         steps_per_chunk: int, lr: float, prefetch: int = 2,
         checkpoint_dir=None, checkpoint_every: int = 0, resume_from=None,
+        aux_col: int | None = None,
     ):
         """Out-of-core fit over a ChunkSource [SURVEY §7 step 8]."""
         from spark_bagging_tpu.streaming import fit_ensemble_stream
@@ -694,10 +695,18 @@ class _BaseBagging(ParamsMixin):
                     "stream's per-shard draws; use a replica-only mesh "
                     "or drop oob_score"
                 )
-        n_subspace = self._n_subspace(source.n_features)
+        # aux_col: one streamed column is the aux channel, not a
+        # feature — the model's feature space excludes it
+        n_feat_data = source.n_features - (1 if aux_col is not None else 0)
+        n_subspace = self._n_subspace(n_feat_data)
         key = jax.random.key(self.seed)
         t0 = time.perf_counter()
         if isinstance(learner, _TreeBase) and learner.tree_streamable:
+            if aux_col is not None:
+                raise ValueError(
+                    "aux_col applies to SGD-streamable uses_aux "
+                    "learners; tree streams carry no aux channel"
+                )
             # structure-search learners stream through the multi-pass
             # level-synchronous engine (tree_stream.py), not SGD
             from spark_bagging_tpu.tree_stream import (
@@ -734,13 +743,15 @@ class _BaseBagging(ParamsMixin):
                 checkpoint_dir=checkpoint_dir,
                 checkpoint_every=checkpoint_every,
                 resume_from=resume_from,
+                aux_col=aux_col,
             )
         losses_np = to_host(aux["loss"])  # device->host barrier
         t_fit = time.perf_counter() - t0
 
         self.ensemble_ = params
         self.subspaces_ = subspaces
-        self.n_features_in_ = int(source.n_features)
+        self.n_features_in_ = int(n_feat_data)
+        self._stream_aux_col = aux_col
         self.n_estimators_ = int(self.n_estimators)
         self._fit_key = key
         self._fitted_learner = learner
@@ -749,7 +760,7 @@ class _BaseBagging(ParamsMixin):
         # by the in-memory warm start (guard keys on this attribute)
         self._fit_subspace_cfg = None
         self._identity_subspace = (
-            n_subspace == source.n_features and not self.bootstrap_features
+            n_subspace == n_feat_data and not self.bootstrap_features
         )
         # FLOPs/MFU: the multi-pass tree stream does exactly the
         # in-memory fit's contractions (the cost model applies, but a
@@ -782,7 +793,7 @@ class _BaseBagging(ParamsMixin):
             fit_seconds=t_fit,
             losses=losses_np,
             n_rows=int(source.n_rows),
-            n_features=int(source.n_features),
+            n_features=int(n_feat_data),
             n_subspace=n_subspace,
             backend=jax.default_backend(),
             n_devices=jax.device_count(),
@@ -866,6 +877,7 @@ class _BaseBagging(ParamsMixin):
             sample_ratio=ratio, bootstrap=replacement,
             n_classes=n_classes, chunk_size=self._eff_chunk(),
             identity_subspace=self._identity_subspace,
+            aux_col=getattr(self, "_stream_aux_col", None),
         )
 
     def _oob_scores(self, X: jnp.ndarray, n_classes: int | None):
@@ -1186,9 +1198,16 @@ class BaggingRegressor(_BaseBagging):
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         resume_from: str | None = None,
+        aux_col: int | None = None,
     ) -> "BaggingRegressor":
         """Out-of-core fit from a ChunkSource (or ``(X, y)`` tuple)
-        [SURVEY §7 step 8]; see ``BaggingClassifier.fit_stream``."""
+        [SURVEY §7 step 8]; see ``BaggingClassifier.fit_stream``.
+
+        ``aux_col`` designates one streamed feature column as the
+        per-row aux channel for ``uses_aux`` learners — e.g. the censor
+        indicator of a streamed AFTSurvivalRegression (Spark's
+        censorCol, carried as a column so every source format works).
+        The fitted model's feature space excludes that column."""
         from spark_bagging_tpu.utils.io import as_chunk_source
 
         self.__dict__.pop("_collapsed_beta_cache", None)
@@ -1198,7 +1217,8 @@ class BaggingRegressor(_BaseBagging):
                                 prefetch=prefetch,
                                 checkpoint_dir=checkpoint_dir,
                                 checkpoint_every=checkpoint_every,
-                                resume_from=resume_from)
+                                resume_from=resume_from,
+                                aux_col=aux_col)
         if self.oob_score:
             sums, votes, y_np = self._oob_scores_stream(source, None)
             self._finalize_oob(sums, votes, y_np)

@@ -48,8 +48,10 @@ class AFTSurvivalRegression(BaseLearner):
     """
 
     task = "regression"
-    streamable = False  # needs the aux channel; the SGD stream's
-    # row_loss contract carries no per-row censor column
+    # Streams through the SGD engine with the censor column designated
+    # via fit_stream's ``aux_col`` (the Spark censorCol-as-a-column
+    # convention); aux=None degenerates to fully-observed Weibull.
+    streamable = True
     uses_aux = True
 
     def __init__(
@@ -96,6 +98,39 @@ class AFTSurvivalRegression(BaseLearner):
         # fwd (n,d)@(d,) + bwd ≈ 2x, per Adam step
         return float(self.max_iter * 6 * n * d)
 
+    def _nll_rows(self, params, X, y, delta):
+        """Per-row negative Weibull AFT log-likelihood (shared by the
+        in-memory Newton-free Adam fit and the streaming row_loss)."""
+        logt = jnp.log(jnp.maximum(y.astype(jnp.float32), _EPS))
+        Xb = augment_bias(X.astype(jnp.float32))
+        mu = Xb @ params["beta"]
+        sigma = jnp.exp(params["log_sigma"])
+        z = (logt - mu) / sigma
+        return -(delta * (z - params["log_sigma"]) - jnp.exp(z))
+
+    # -- streaming contract (aux-carrying SGD engine) -------------------
+
+    def row_loss(self, params, X, y, aux=None):
+        delta = (
+            jnp.ones_like(y, dtype=jnp.float32) if aux is None
+            else aux.astype(jnp.float32)
+        )
+        return self._nll_rows(params, X, y, delta)
+
+    def penalty(self, params):
+        return 0.5 * self.l2 * jnp.sum(params["beta"][:-1] ** 2)
+
+    def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
+        del n_outputs
+        return float(6 * chunk_rows * (n_features + 1))
+
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        del n_outputs
+        # the per-replica (n, d+1) bias-augmented design copy (built
+        # inside the vmapped fit, like linear/glm) + a handful of (n,)
+        # working vectors (z, loglik, weights, grads)
+        return float(4 * n_rows * (n_features + 1) + 24 * n_rows)
+
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None, aux=None):
         del key, prepared
@@ -107,18 +142,13 @@ class AFTSurvivalRegression(BaseLearner):
         delta = (
             jnp.ones_like(w) if aux is None else aux.astype(jnp.float32)
         )
-        logt = jnp.log(jnp.maximum(y.astype(jnp.float32), _EPS))
-        Xb = augment_bias(X)
         w_sum = maybe_psum(jnp.sum(w), axis_name)
 
         def nll(p):
-            mu = Xb @ p["beta"]
-            sigma = jnp.exp(p["log_sigma"])
-            z = (logt - mu) / sigma
-            loglik = delta * (z - p["log_sigma"]) - jnp.exp(z)
-            data = -maybe_psum(jnp.sum(w * loglik), axis_name)
-            data = data / jnp.maximum(w_sum, _EPS)
-            return data + 0.5 * self.l2 * jnp.sum(p["beta"][:-1] ** 2)
+            data = maybe_psum(
+                jnp.sum(w * self._nll_rows(p, X, y, delta)), axis_name
+            )
+            return data / jnp.maximum(w_sum, _EPS) + self.penalty(p)
 
         opt = optax.adam(self.lr)
 

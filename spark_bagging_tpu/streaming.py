@@ -56,6 +56,19 @@ _EPS = 1e-8
 _CHUNK_STREAM = 0xC4C
 
 
+def split_aux_col(
+    Xc, aux_col: int | None
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Host-side aux-column split — the ONE place the column-drop
+    convention lives, shared by the fit loop and the OOB pass so the
+    two can never disagree on the feature layout. Returns
+    ``(X_without_aux, aux_or_None)``; both float32."""
+    Xc = np.asarray(Xc, np.float32)
+    if aux_col is None:
+        return Xc, None
+    return np.delete(Xc, aux_col % Xc.shape[1], axis=1), Xc[:, aux_col]
+
+
 def _shard_ensemble(tree: Any, mesh) -> Any:
     """Place every array leaf sharded over the replica mesh axis on its
     leading (replica) axis; scalar leaves (e.g. Adam step counts stacked
@@ -179,12 +192,21 @@ def fit_ensemble_stream(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
     resume_from: str | None = None,
+    aux_col: int | None = None,
 ) -> tuple[Any, jax.Array, dict[str, Any]]:
     """Fit all replicas by streaming chunks from ``source``.
 
     Returns ``(stacked_params, subspaces, aux)`` exactly like
     ``fit_ensemble`` — the fitted ensemble is indistinguishable
     downstream (predict/persistence) from an in-memory fit.
+
+    ``aux_col`` designates one column of the streamed feature block as
+    the per-row auxiliary channel (the Spark censorCol-as-a-column
+    convention): each chunk splits it off host-side before the device
+    step, so EVERY source (CSV, Arrow, hashed, synthetic, arrays)
+    carries aux with zero format changes. Requires a ``uses_aux``
+    learner (e.g. AFTSurvivalRegression); the model then expects
+    aux-free feature vectors at predict time.
 
     Fault tolerance [SURVEY §5 failure detection, VERDICT r1 #7]:
     ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot
@@ -206,7 +228,19 @@ def fit_ensemble_stream(
             "checkpoint_dir is set but checkpoint_every is 0 — no "
             "snapshot would ever be written; pass checkpoint_every=N"
         )
-    n_features = source.n_features
+    if aux_col is not None and not learner.uses_aux:
+        raise ValueError(
+            f"aux_col was passed but {type(learner).__name__} does not "
+            "declare uses_aux (the column would be silently dropped)"
+        )
+    n_features = source.n_features - (1 if aux_col is not None else 0)
+    if aux_col is not None and not (
+        -source.n_features <= aux_col < source.n_features
+    ):
+        raise ValueError(
+            f"aux_col={aux_col} out of range for "
+            f"{source.n_features} streamed columns"
+        )
     chunk_rows = source.chunk_rows
     if n_subspace is None:
         n_subspace = n_features
@@ -239,6 +273,7 @@ def fit_ensemble_stream(
         "bootstrap_features": bootstrap_features,
         "chunk_rows": chunk_rows,
         "n_features": n_features,
+        "aux_col": aux_col,
         "learner": learner_fingerprint(learner),
     }
 
@@ -248,6 +283,9 @@ def fit_ensemble_stream(
         from flax import serialization
 
         meta, tree = _load_stream_checkpoint(resume_from)
+        # pre-aux_col snapshots lack the key; absent == None (the
+        # default) so old checkpoints resume cleanly
+        meta.setdefault("config", {}).setdefault("aux_col", None)
         check_resume_config(meta, config, resume_from)
         params = serialization.from_state_dict(params, tree["params"])
         opt_state = serialization.from_state_dict(
@@ -285,14 +323,20 @@ def fit_ensemble_stream(
 
     y_dtype = jnp.int32 if learner.task == "classification" else jnp.float32
 
-    def chunk_step(params, opt_state, X, y, n_valid, chunk_uid):
+    use_aux = aux_col is not None
+
+    # one fixed signature: aux is None (a leafless pytree under jit)
+    # when the stream carries no aux column
+    def chunk_step(params, opt_state, X, y, aux_arr, n_valid, chunk_uid):
         valid = (jnp.arange(chunk_rows) < n_valid).astype(jnp.float32)
         chunk_key = jax.random.fold_in(row_key, chunk_uid)
 
         with jax.default_matmul_precision(precision):
-            return _chunk_body(params, opt_state, X, y, valid, chunk_key)
+            return _chunk_body(
+                params, opt_state, X, y, aux_arr, valid, chunk_key
+            )
 
-    def _chunk_body(params, opt_state, X, y, valid, chunk_key):
+    def _chunk_body(params, opt_state, X, y, aux_arr, valid, chunk_key):
 
         def one(p, os, rid, idx):
             w = bootstrap_weights_one(
@@ -302,7 +346,11 @@ def fit_ensemble_stream(
             Xs = X if identity_subspace else X[:, idx]
 
             def loss_fn(p):
-                data = jnp.sum(w * learner.row_loss(p, Xs, y))
+                rl = (
+                    learner.row_loss(p, Xs, y, aux=aux_arr)
+                    if use_aux else learner.row_loss(p, Xs, y)
+                )
+                data = jnp.sum(w * rl)
                 data = data / jnp.maximum(jnp.sum(w), _EPS)
                 return data + learner.penalty(p)
 
@@ -333,17 +381,22 @@ def fit_ensemble_stream(
         for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
             if epoch == start_epoch and c < start_chunk:
                 continue  # replay: already consumed before the snapshot
+            Xc, auxc = split_aux_col(Xc, aux_col)
             if x_sharding is not None:
                 # host chunk → ONE global placement (multihost-safe:
                 # every process streams the same chunks, each transfers
                 # only its shards — the broadcast-data design [B:5])
-                Xd = jax.device_put(np.asarray(Xc, np.float32), x_sharding)
+                Xd = jax.device_put(Xc, x_sharding)
                 yd = jax.device_put(np.asarray(yc, y_dtype), y_sharding)
+                auxd = (
+                    jax.device_put(auxc, y_sharding) if use_aux else None
+                )
             else:
-                Xd = jnp.asarray(Xc, jnp.float32)
+                Xd = jnp.asarray(Xc)
                 yd = jnp.asarray(yc, y_dtype)
+                auxd = jnp.asarray(auxc) if use_aux else None
             params, opt_state, losses = chunk_step(
-                params, opt_state, Xd, yd,
+                params, opt_state, Xd, yd, auxd,
                 jnp.asarray(n_valid, jnp.int32),
                 jnp.asarray(c, jnp.int32),
             )
@@ -402,9 +455,13 @@ def oob_scores_stream(
     n_classes: int | None = None,
     chunk_size: int | None = None,
     identity_subspace: bool = False,
+    aux_col: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """OOB aggregation for a streamed fit: ONE extra pass over the
     source [SURVEY §4, closing VERDICT r1 #3's fit_stream carve-out].
+    ``aux_col`` (an aux-carrying stream, see fit_ensemble_stream) is
+    dropped from each chunk before the predict — the fitted model's
+    feature space excludes it.
 
     Works because chunk-keyed weight draws are epoch-stable: both stream
     engines (SGD and level-synchronous trees) draw chunk ``c``'s weights
@@ -446,6 +503,7 @@ def oob_scores_stream(
 
     aggs, votes_all, ys = [], [], []
     for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
+        Xc, _ = split_aux_col(Xc, aux_col)
         a, v = chunk_oob(
             stacked_params, subspaces, jnp.asarray(Xc, jnp.float32),
             jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),

@@ -181,6 +181,45 @@ def test_aft_checkpoint_roundtrip(tmp_path):
     )
 
 
+def test_streamed_aft_aux_col():
+    """AFT streams out-of-core with the censor indicator carried as a
+    designated column (Spark's censorCol-as-a-column convention):
+    streamed quality ≈ in-memory quality, feature space excludes the
+    aux column, and streamed OOB runs on the same source."""
+    X, y, delta = _weibull_data(n=2000, censor_frac=0.3, seed=17)
+    mem = BaggingRegressor(
+        base_learner=AFTSurvivalRegression(max_iter=300),
+        n_estimators=4, seed=0,
+    ).fit(X, y, aux=delta)
+
+    Xs = np.concatenate([X, delta[:, None]], axis=1)  # aux as last col
+    stream = BaggingRegressor(
+        base_learner=AFTSurvivalRegression(),
+        n_estimators=4, seed=0, oob_score=True,
+    ).fit_stream(
+        (Xs, y), chunk_rows=256, n_epochs=40, steps_per_chunk=2,
+        lr=0.05, aux_col=-1,
+    )
+    assert stream.n_features_in_ == X.shape[1]
+    p_mem, p_stream = mem.predict(X[:200]), stream.predict(X[:200])
+    corr = np.corrcoef(np.log(p_mem), np.log(p_stream))[0, 1]
+    assert corr > 0.97
+    assert np.isfinite(stream.oob_prediction_[
+        ~np.isnan(stream.oob_prediction_)
+    ]).all()
+    rep = stream.fit_report_
+    assert rep["model_flops_per_fit"] > 0  # streamed MFU accounting
+
+
+def test_streamed_aux_col_rejected_for_non_aux_learner():
+    X, y, delta = _weibull_data(n=300)
+    Xs = np.concatenate([X, delta[:, None]], axis=1)
+    with pytest.raises(ValueError, match="uses_aux"):
+        BaggingRegressor(
+            base_learner=LinearRegression(), n_estimators=2, seed=0
+        ).fit_stream((Xs, y), chunk_rows=128, aux_col=-1)
+
+
 def test_aft_sample_weight_and_aux_coexist():
     X, y, delta = _weibull_data(n=400, censor_frac=0.2, seed=13)
     sw = np.ones(len(y), np.float32)
