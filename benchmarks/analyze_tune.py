@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Summarize benchmarks/tune_headline.json: per-impl best cell, overall
+winner, and the concrete auto-policy recommendation for
+``LogisticRegression._resolved_hessian`` [VERDICT r2 ask#2].
+
+Read-only — run after the watcher's on-chip sweep lands.
+"""
+import json
+import os
+import sys
+
+path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "tune_headline.json")
+if not os.path.exists(path):
+    print("no tune_headline.json yet — sweep has not run on-chip")
+    sys.exit(1)
+cells = json.load(open(path))
+ok = [c for c in cells if c.get("fps")]
+if not ok:
+    print(json.dumps({"error": "no successful cells", "cells": cells}))
+    sys.exit(1)
+
+best_by_impl = {}
+for c in ok:
+    cur = best_by_impl.get(c["impl"])
+    if cur is None or c["fps"] > cur["fps"]:
+        best_by_impl[c["impl"]] = c
+
+winner = max(ok, key=lambda c: c["fps"])
+print("| impl | best fps | chunk | row_tile | MFU | acc |")
+print("|---|---|---|---|---|---|")
+for impl, c in sorted(best_by_impl.items()):
+    print(f"| {impl} | {c['fps']} | {c.get('chunk_resolved', c['chunk'])} "
+          f"| {c['row_tile']} | {c.get('mfu')} | {c.get('acc')} |")
+print()
+print(json.dumps({
+    "winner": winner,
+    "recommendation": (
+        f"hessian_impl='auto' at C=7/d=55 should resolve to "
+        f"{winner['impl']!r} (chunk={winner.get('chunk_resolved', winner['chunk'])}, "
+        f"row_tile={winner['row_tile']}); update "
+        "models/logistic.py::_resolved_hessian with this measured point "
+        "and quote MFU in BASELINE.md"
+    ),
+    "errors": [c for c in cells if c.get("error")],
+}, indent=1))

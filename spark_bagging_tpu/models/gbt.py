@@ -161,6 +161,24 @@ class _GBTBase(DecisionTreeRegressor):
         one_tree = 2 * n_rows * n_features * self.n_bins * 3 * nodes_total
         return float(self.n_rounds * one_tree)
 
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        del n_features
+        # per-round regression-tree temps (K=3 moments; buffers reuse
+        # across the scanned rounds), ×C concurrent trees for
+        # multiclass, + the (n, C) running-score state
+        hist_bytes = 2 if self.hist_dtype == "bfloat16" else 4
+        per_tree = (
+            hist_bytes * n_rows * (2 ** (self.max_depth - 1)) * 3
+            + 8 * n_rows
+        )
+        n_trees = (
+            n_outputs
+            if self.task == "classification" and n_outputs > 2 else 1
+        )
+        return float(
+            per_tree * n_trees + 4 * n_rows * max(1, n_outputs)
+        )
+
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):
         del params

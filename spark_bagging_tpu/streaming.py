@@ -234,12 +234,24 @@ def fit_ensemble_stream(
             "declare uses_aux (the column would be silently dropped)"
         )
     n_features = source.n_features - (1 if aux_col is not None else 0)
-    if aux_col is not None and not (
-        -source.n_features <= aux_col < source.n_features
-    ):
-        raise ValueError(
-            f"aux_col={aux_col} out of range for "
-            f"{source.n_features} streamed columns"
+    if aux_col is not None:
+        if not (-source.n_features <= aux_col < source.n_features):
+            raise ValueError(
+                f"aux_col={aux_col} out of range for "
+                f"{source.n_features} streamed columns"
+            )
+        # normalize once so -1 and n-1 fingerprint as the SAME fit
+        # (resume compatibility) and every downstream split agrees
+        aux_col = aux_col % source.n_features
+    elif learner.uses_aux:
+        import warnings
+
+        warnings.warn(
+            f"{type(learner).__name__} consumes a per-row aux column "
+            "but the stream carries none (aux_col=None): every row is "
+            "treated as fully observed. If the censor indicator is a "
+            "column of the stream, pass aux_col=<index> — otherwise it "
+            "is being fit as an ordinary feature.", UserWarning,
         )
     chunk_rows = source.chunk_rows
     if n_subspace is None:

@@ -80,6 +80,60 @@ def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     )
 
 
+def mae(y_true, y_pred) -> float:
+    """Mean absolute error (Spark RegressionEvaluator metricName=mae)."""
+    return float(np.mean(np.abs(
+        np.asarray(y_true, np.float64).ravel()
+        - np.asarray(y_pred, np.float64).ravel()
+    )))
+
+
+def pr_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
+    """Area under the precision-recall curve
+    (Spark BinaryClassificationEvaluator metricName=areaUnderPR),
+    computed as average precision — the step-function integral
+    Σ (R_k − R_{k−1})·P_k over descending-score thresholds."""
+    y_true = np.asarray(y_true).ravel()
+    scores = np.asarray(scores, np.float64).ravel()
+    n_pos = int((y_true == 1).sum())
+    if n_pos == 0:
+        return 0.0
+    order = np.argsort(-scores, kind="mergesort")
+    tp = np.cumsum(y_true[order] == 1)
+    fp = np.cumsum(y_true[order] != 1)
+    # evaluate only at threshold boundaries (last index of each tied
+    # score run) so ties count as one operating point
+    s = scores[order]
+    boundary = np.r_[s[1:] != s[:-1], True]
+    tp, fp = tp[boundary], fp[boundary]
+    precision = tp / np.maximum(tp + fp, 1)
+    recall = tp / n_pos
+    return float(np.sum(np.diff(np.r_[0.0, recall]) * precision))
+
+
+def f1_score(y_true, y_pred, average: str = "weighted") -> float:
+    """Multiclass F1 (Spark MulticlassClassificationEvaluator
+    metricName=f1 is the weighted variant)."""
+    y_true = np.asarray(y_true).ravel()
+    y_pred = np.asarray(y_pred).ravel()
+    classes = np.unique(np.concatenate([y_true, y_pred]))
+    f1s, weights = [], []
+    for c in classes:
+        tp = float(((y_pred == c) & (y_true == c)).sum())
+        fp = float(((y_pred == c) & (y_true != c)).sum())
+        fn = float(((y_pred != c) & (y_true == c)).sum())
+        denom = 2 * tp + fp + fn
+        f1s.append(2 * tp / denom if denom > 0 else 0.0)
+        weights.append(float((y_true == c).sum()))
+    f1s = np.asarray(f1s)
+    if average == "macro":
+        return float(f1s.mean())
+    if average == "weighted":
+        w = np.asarray(weights)
+        return float((f1s * w).sum() / max(w.sum(), 1.0))
+    raise ValueError(f"average must be weighted|macro, got {average!r}")
+
+
 def fit_report(
     *,
     n_replicas: int,

@@ -220,6 +220,16 @@ def test_streamed_aux_col_rejected_for_non_aux_learner():
         ).fit_stream((Xs, y), chunk_rows=128, aux_col=-1)
 
 
+def test_streamed_aft_without_aux_col_warns():
+    """Streaming a uses_aux learner with no aux_col is legal (genuinely
+    fully-observed data) but easy to do by accident — it must warn."""
+    X, y, delta = _weibull_data(n=300)
+    with pytest.warns(UserWarning, match="aux_col"):
+        BaggingRegressor(
+            base_learner=AFTSurvivalRegression(), n_estimators=2, seed=0
+        ).fit_stream((X, y), chunk_rows=128, n_epochs=2, lr=0.05)
+
+
 def test_aft_sample_weight_and_aux_coexist():
     X, y, delta = _weibull_data(n=400, censor_frac=0.2, seed=13)
     sw = np.ones(len(y), np.float32)

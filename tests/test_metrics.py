@@ -5,7 +5,10 @@ from sklearn import metrics as skm
 
 from spark_bagging_tpu.utils.metrics import (
     accuracy,
+    f1_score,
     fit_report,
+    mae,
+    pr_auc,
     r2_score,
     rmse,
     roc_auc,
@@ -14,6 +17,43 @@ from spark_bagging_tpu.utils.metrics import (
 
 def test_accuracy():
     assert accuracy([1, 2, 3], [1, 2, 0]) == 2 / 3
+
+
+def test_mae_matches_sklearn():
+    import pytest
+
+    rng = np.random.default_rng(3)
+    y, p = rng.normal(size=200), rng.normal(size=200)
+    assert mae(y, p) == pytest.approx(skm.mean_absolute_error(y, p))
+
+
+def test_pr_auc_matches_sklearn_average_precision():
+    import pytest
+
+    rng = np.random.default_rng(4)
+    y = (rng.random(500) < 0.3).astype(int)
+    s = rng.normal(size=500) + y  # informative scores
+    assert pr_auc(y, s) == pytest.approx(skm.average_precision_score(y, s))
+    # with heavy ties
+    st = np.round(s)
+    assert pr_auc(y, st) == pytest.approx(
+        skm.average_precision_score(y, st)
+    )
+    assert pr_auc(np.zeros(10, int), rng.normal(size=10)) == 0.0
+
+
+def test_f1_matches_sklearn():
+    import pytest
+
+    rng = np.random.default_rng(5)
+    y = rng.integers(0, 4, 300)
+    p = np.where(rng.random(300) < 0.6, y, rng.integers(0, 4, 300))
+    assert f1_score(y, p) == pytest.approx(
+        skm.f1_score(y, p, average="weighted")
+    )
+    assert f1_score(y, p, average="macro") == pytest.approx(
+        skm.f1_score(y, p, average="macro")
+    )
 
 
 def test_rmse_and_r2():
