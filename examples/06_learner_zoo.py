@@ -90,3 +90,16 @@ for learner, target in regressors:
 
 rfr = RandomForestRegressor(n_estimators=32, max_depth=4, seed=0).fit(Xd, yd)
 print(f"  {'RandomForestRegressor':<28} {'':<10} r2={rfr.score(Xd, yd):.3f}")
+
+# survival: censored targets ride the aux channel (see 07_survival_aft)
+from spark_bagging_tpu import AFTSurvivalRegression
+
+y_pos = yd - yd.min() + 1.0  # survival times must be positive
+censor = (y_pos <= np.quantile(y_pos, 0.8)).astype(np.float32)
+aft = BaggingRegressor(
+    base_learner=AFTSurvivalRegression(max_iter=200), n_estimators=16,
+    seed=0,
+).fit(Xd, np.minimum(y_pos, np.quantile(y_pos, 0.8)), aux=censor)
+corr = np.corrcoef(aft.predict(Xd), y_pos)[0, 1]
+print(f"  {'AFTSurvivalRegression':<28} {'(20% censored)':<10} "
+      f"corr={corr:.3f}")

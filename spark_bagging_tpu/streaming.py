@@ -296,8 +296,13 @@ def fit_ensemble_stream(
 
         meta, tree = _load_stream_checkpoint(resume_from)
         # pre-aux_col snapshots lack the key; absent == None (the
-        # default) so old checkpoints resume cleanly
-        meta.setdefault("config", {}).setdefault("aux_col", None)
+        # default) so old checkpoints resume cleanly. Snapshots written
+        # before entry-point normalization may carry a negative index —
+        # normalize it the same way so -1 and n-1 compare equal.
+        saved_cfg = meta.setdefault("config", {})
+        saved_cfg.setdefault("aux_col", None)
+        if saved_cfg["aux_col"] is not None:
+            saved_cfg["aux_col"] %= source.n_features
         check_resume_config(meta, config, resume_from)
         params = serialization.from_state_dict(params, tree["params"])
         opt_state = serialization.from_state_dict(

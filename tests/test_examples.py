@@ -1,0 +1,49 @@
+"""Examples are user-facing documentation — they must actually run.
+
+Each fast example executes in a subprocess on the virtual-CPU backend
+(the heavy ones — mesh/multihost/zoo — are exercised by their
+dedicated test suites instead; running them here would double CI
+time for no new coverage).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+FAST_EXAMPLES = [
+    "01_quickstart.py",
+    "05_custom_learner.py",
+    "07_survival_aft.py",
+]
+
+
+@pytest.mark.parametrize("name", FAST_EXAMPLES)
+def test_example_runs(name):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    path = os.path.join(REPO, "examples", name)
+    # force the CPU backend via jax.config BEFORE the example runs: an
+    # ambient TPU plugin with a dead tunnel hangs forever in client
+    # init, and a JAX_PLATFORMS env var is too late once the site's
+    # sitecustomize has imported jax (tests/conftest.py pattern)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        f"g = {{'__file__': {path!r}, '__name__': '__main__'}}; "
+        f"exec(compile(open({path!r}).read(), {path!r}, 'exec'), g)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert proc.stdout.strip(), f"{name} printed nothing"
