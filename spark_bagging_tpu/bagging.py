@@ -455,6 +455,16 @@ class _BaseBagging(ParamsMixin):
                 "use different replica streams)"
             )
         if (
+            self._fit_n_rows is not None
+            and X.shape[0] != self._fit_n_rows
+        ):
+            raise ValueError(
+                "warm_start requires the same row count as the "
+                "original fit: old replicas drew (and OOB/"
+                "replica_weights replay) per-row weight streams over "
+                f"{self._fit_n_rows} rows, got {X.shape[0]}"
+            )
+        if (
             self._n_subspace(X.shape[1]),
             bool(self.bootstrap_features),
         ) != self._fit_subspace_cfg:
@@ -626,6 +636,17 @@ class _BaseBagging(ParamsMixin):
         self._fitted_learner = learner
         self._fit_sampling = (ratio, bool(self.bootstrap))
         self._fit_subspace_cfg = (n_subspace, bool(self.bootstrap_features))
+        # None marks draws replica_weights cannot replay globally: a
+        # data-sharded fit folds the shard index into each draw, so the
+        # global weight vector is mesh-layout-dependent. Snapshotted at
+        # fit time — mutating self.mesh afterwards must not change the
+        # answer.
+        self._fit_n_rows = (
+            None
+            if self.mesh is not None
+            and self.mesh.shape.get(DATA_AXIS, 1) > 1
+            else int(X.shape[0])
+        )
         self._identity_subspace = (
             n_subspace == X.shape[1] and not self.bootstrap_features
         )
@@ -759,6 +780,7 @@ class _BaseBagging(ParamsMixin):
         # stream fits use chunk-keyed replica streams — not extendable
         # by the in-memory warm start (guard keys on this attribute)
         self._fit_subspace_cfg = None
+        self._fit_n_rows = None  # stream fits draw per-chunk weights
         self._identity_subspace = (
             n_subspace == n_feat_data and not self.bootstrap_features
         )
@@ -839,6 +861,47 @@ class _BaseBagging(ParamsMixin):
         # host per call would make a loop over replicas O(R²) transfer
         params = jax.tree.map(lambda a: to_host(a[i]), self.ensemble_)
         return params, to_host(self.subspaces_[i])
+
+    @property
+    def estimators_features_(self) -> np.ndarray:
+        """Per-replica feature indices ``(R, n_subspace)`` — sklearn's
+        ``estimators_features_`` under its own name (``subspaces_`` is
+        the native spelling; same array, gathered to host)."""
+        self._check_fitted()
+        return np.asarray(to_host(self.subspaces_))
+
+    def replica_weights(self, i: int) -> np.ndarray:
+        """Replica ``i``'s bootstrap sample weights over the training
+        rows — the analog of sklearn's ``estimators_samples_[i]``
+        (weights, never materialized index lists, by design: the
+        weights ARE the bootstrap [SURVEY §7.2]). Regenerated from the
+        fit key, so nothing is stored; rows with weight 0 are the
+        replica's out-of-bag rows.
+
+        In-memory fits only (a streamed fit draws per-chunk weights; a
+        data-sharded mesh fit folds the shard index into the draw, so
+        the global vector is layout-dependent).
+        """
+        self._check_fitted()
+        if not 0 <= i < self.n_estimators_:
+            raise IndexError(
+                f"replica {i} out of range [0, {self.n_estimators_})"
+            )
+        if getattr(self, "_fit_n_rows", None) is None:
+            raise ValueError(
+                "replica_weights requires a fit whose weight draws are "
+                "globally replayable: stream fits draw per-chunk "
+                "streams and data-sharded mesh fits fold the shard "
+                "index into each draw (layout-dependent) — neither "
+                "regenerates to one global vector"
+            )
+        from spark_bagging_tpu.ops.bootstrap import bootstrap_weights_one
+
+        ratio, replacement = self._fit_sampling
+        return np.asarray(bootstrap_weights_one(
+            self._fit_key, jnp.asarray(i, jnp.int32), self._fit_n_rows,
+            ratio=ratio, replacement=replacement,
+        ))
 
     def _stream_chunks(self, source, chunk_rows=None, prefetch: int = 2):
         """Validated chunk iterator for the streaming predict/score

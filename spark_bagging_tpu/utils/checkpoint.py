@@ -138,6 +138,9 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
         "n_features_in_": model.n_features_in_,
         "n_estimators_": model.n_estimators_,
         "fit_sampling": list(model._fit_sampling),
+        # None for stream/data-sharded fits (weight draws not globally
+        # replayable); an int restores replica_weights after load
+        "fit_n_rows": getattr(model, "_fit_n_rows", None),
         "identity_subspace": model._identity_subspace,
         "fit_report_": model.fit_report_,
         "seed_key": np.asarray(
@@ -213,6 +216,7 @@ def load_model(path: str, *, mesh=None) -> Any:
     model.n_features_in_ = fitted["n_features_in_"]
     model.n_estimators_ = fitted["n_estimators_"]
     model._fit_sampling = tuple(fitted["fit_sampling"])
+    model._fit_n_rows = fitted.get("fit_n_rows")  # absent in old saves
     model._identity_subspace = fitted["identity_subspace"]
     model.fit_report_ = fitted["fit_report_"]
     model._fit_key = jax.random.wrap_key_data(

@@ -17,6 +17,15 @@ chunk=200 fits comfortably in 16 GB while 500 OOMs on the
 budget with the logistic bytes model reproduces (≈250). An estimate
 is still an estimate — learners without a bytes model keep the legacy
 vmap-all behavior rather than trusting a made-up number.
+
+Why analytic models and not a compile-probe: lowering the fit on the
+host backend and reading ``compiled.memory_analysis()`` was measured
+(2026-07-30) at ~124 MB/replica for the blocked-Hessian logreg at
+covtype shapes — CPU XLA materializes all C(C+1)/2 scaled-X pair
+copies that XLA:TPU fuses into its matmuls, overstating the real v5e
+footprint by ~2 orders of magnitude (chunk=200 × 124 MB could not fit
+a 16 GB chip, yet runs). A probe on the target backend would need a
+TPU compile per candidate chunk — slower than the fit it protects.
 """
 
 from __future__ import annotations

@@ -493,6 +493,103 @@ def test_replica_params_slices_match_ensemble(breast_cancer):
         clf.replica_params(6)
 
 
+def test_replica_weights_reproduce_replica_fit(breast_cancer):
+    """estimators_samples_ analog: the regenerated weight vector for
+    replica i, fed through the base learner directly, must reproduce
+    the stored replica EXACTLY — the weights ARE the bootstrap."""
+    from spark_bagging_tpu.ops.bootstrap import fit_key
+
+    X, y = breast_cancer
+    clf = BaggingClassifier(n_estimators=4, seed=3).fit(X, y)
+    w = clf.replica_weights(2)
+    assert w.shape == (X.shape[0],)
+    assert (w >= 0).all() and w.sum() > 0
+    assert abs(w.mean() - 1.0) < 0.15  # Poisson(1) counts
+    y_enc = np.searchsorted(clf.classes_, y).astype(np.int32)
+    params, _ = clf.base_learner_.fit_from_init(
+        fit_key(jax.random.key(3), jnp.asarray(2, jnp.int32)),
+        jnp.asarray(X), jnp.asarray(y_enc), jnp.asarray(w),
+        clf.n_classes_,
+    )
+    stored, _ = clf.replica_params(2)
+    # vmapped vs single-replica fits compile to different reduction
+    # orders (fp reassociation) — agreement is ~1e-4; a WRONG weight
+    # vector would produce O(1)-different params
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(stored)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4
+        )
+    # negative control: a different replica's weights give a visibly
+    # different model
+    w_other = clf.replica_weights(0)
+    assert not np.array_equal(w, w_other)
+    params_other, _ = clf.base_learner_.fit_from_init(
+        fit_key(jax.random.key(3), jnp.asarray(2, jnp.int32)),
+        jnp.asarray(X), jnp.asarray(y_enc), jnp.asarray(w_other),
+        clf.n_classes_,
+    )
+    diff = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(
+            jax.tree.leaves(params_other), jax.tree.leaves(stored)
+        )
+    )
+    assert diff > 0.01
+    with pytest.raises(IndexError):
+        clf.replica_weights(4)
+
+
+def test_estimators_features_alias(breast_cancer):
+    X, y = breast_cancer
+    clf = BaggingClassifier(
+        n_estimators=3, seed=0, max_features=0.5
+    ).fit(X, y)
+    feats = clf.estimators_features_
+    assert feats.shape == (3, int(0.5 * X.shape[1]))
+    np.testing.assert_array_equal(feats, np.asarray(clf.subspaces_))
+
+
+def test_replica_weights_rejects_stream_fit(breast_cancer):
+    X, y = breast_cancer
+    sclf = BaggingClassifier(n_estimators=2, seed=0).fit_stream(
+        (X, y), chunk_rows=200, n_epochs=2, lr=0.05
+    )
+    with pytest.raises(ValueError, match="replayable"):
+        sclf.replica_weights(0)
+
+
+def test_replica_weights_data_sharded_rejected_even_after_mesh_detach(
+    breast_cancer,
+):
+    """Data-sharded draws fold the shard index into the key; the
+    refusal is snapshotted at FIT time, so detaching the mesh
+    afterwards must not un-reject it."""
+    from spark_bagging_tpu import make_mesh
+
+    X, y = breast_cancer
+    clf = BaggingClassifier(
+        n_estimators=8, seed=0, mesh=make_mesh(data=2)
+    ).fit(X, y)
+    clf.mesh = None
+    with pytest.raises(ValueError, match="data-sharded"):
+        clf.replica_weights(0)
+    # replica-only mesh draws ARE globally replayable
+    rclf = BaggingClassifier(
+        n_estimators=8, seed=0, mesh=make_mesh()
+    ).fit(X, y)
+    assert rclf.replica_weights(0).shape == (X.shape[0],)
+
+
+def test_warm_start_rejects_different_row_count(breast_cancer):
+    X, y = breast_cancer
+    clf = BaggingClassifier(
+        n_estimators=4, seed=0, warm_start=True
+    ).fit(X, y)
+    clf.set_params(n_estimators=6)
+    with pytest.raises(ValueError, match="row count"):
+        clf.fit(X[:-10], y[:-10])
+
+
 class TestLinearCollapseInference:
     """Bagged-mean prediction of params-linear learners collapses to
     ONE model with scatter-meaned coefficients — must match the

@@ -43,6 +43,11 @@ def test_classifier_roundtrip(tmp_path, iris):
     assert loaded.base_learner.l2 == 0.01
     assert loaded._fitted_learner == clf._fitted_learner
     np.testing.assert_array_equal(loaded.classes_, clf.classes_)
+    # the bootstrap replays through the checkpoint: the loaded model's
+    # regenerated per-replica weights match the original's
+    np.testing.assert_array_equal(
+        loaded.replica_weights(3), clf.replica_weights(3)
+    )
 
 
 def test_string_label_roundtrip(tmp_path, iris):
