@@ -454,15 +454,13 @@ class _BaseBagging(ParamsMixin):
                 "extend (stream-fitted or checkpoint-loaded ensembles "
                 "use different replica streams)"
             )
-        if (
-            self._fit_n_rows is not None
-            and X.shape[0] != self._fit_n_rows
-        ):
+        fit_rows = getattr(self, "_fit_n_rows", None)
+        if fit_rows is not None and X.shape[0] != fit_rows:
             raise ValueError(
                 "warm_start requires the same row count as the "
                 "original fit: old replicas drew (and OOB/"
                 "replica_weights replay) per-row weight streams over "
-                f"{self._fit_n_rows} rows, got {X.shape[0]}"
+                f"{fit_rows} rows, got {X.shape[0]}"
             )
         if (
             self._n_subspace(X.shape[1]),
@@ -636,16 +634,14 @@ class _BaseBagging(ParamsMixin):
         self._fitted_learner = learner
         self._fit_sampling = (ratio, bool(self.bootstrap))
         self._fit_subspace_cfg = (n_subspace, bool(self.bootstrap_features))
-        # None marks draws replica_weights cannot replay globally: a
-        # data-sharded fit folds the shard index into each draw, so the
-        # global weight vector is mesh-layout-dependent. Snapshotted at
-        # fit time — mutating self.mesh afterwards must not change the
-        # answer.
-        self._fit_n_rows = (
-            None
-            if self.mesh is not None
+        self._fit_n_rows = int(X.shape[0])
+        # replica_weights can only replay draws made from ONE global
+        # key stream; a data-sharded fit folds the shard index into
+        # each draw (mesh-layout-dependent). Snapshotted at fit time —
+        # mutating self.mesh afterwards must not change the answer.
+        self._fit_weights_replayable = not (
+            self.mesh is not None
             and self.mesh.shape.get(DATA_AXIS, 1) > 1
-            else int(X.shape[0])
         )
         self._identity_subspace = (
             n_subspace == X.shape[1] and not self.bootstrap_features
@@ -780,7 +776,8 @@ class _BaseBagging(ParamsMixin):
         # stream fits use chunk-keyed replica streams — not extendable
         # by the in-memory warm start (guard keys on this attribute)
         self._fit_subspace_cfg = None
-        self._fit_n_rows = None  # stream fits draw per-chunk weights
+        self._fit_n_rows = int(source.n_rows)
+        self._fit_weights_replayable = False  # per-chunk weight draws
         self._identity_subspace = (
             n_subspace == n_feat_data and not self.bootstrap_features
         )
@@ -887,7 +884,10 @@ class _BaseBagging(ParamsMixin):
             raise IndexError(
                 f"replica {i} out of range [0, {self.n_estimators_})"
             )
-        if getattr(self, "_fit_n_rows", None) is None:
+        if (
+            not getattr(self, "_fit_weights_replayable", False)
+            or getattr(self, "_fit_n_rows", None) is None
+        ):
             raise ValueError(
                 "replica_weights requires a fit whose weight draws are "
                 "globally replayable: stream fits draw per-chunk "

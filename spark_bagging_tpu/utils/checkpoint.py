@@ -138,9 +138,12 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
         "n_features_in_": model.n_features_in_,
         "n_estimators_": model.n_estimators_,
         "fit_sampling": list(model._fit_sampling),
-        # None for stream/data-sharded fits (weight draws not globally
-        # replayable); an int restores replica_weights after load
         "fit_n_rows": getattr(model, "_fit_n_rows", None),
+        # False for stream/data-sharded fits; True restores
+        # replica_weights after load
+        "weights_replayable": bool(
+            getattr(model, "_fit_weights_replayable", False)
+        ),
         "identity_subspace": model._identity_subspace,
         "fit_report_": model.fit_report_,
         "seed_key": np.asarray(
@@ -217,6 +220,11 @@ def load_model(path: str, *, mesh=None) -> Any:
     model.n_estimators_ = fitted["n_estimators_"]
     model._fit_sampling = tuple(fitted["fit_sampling"])
     model._fit_n_rows = fitted.get("fit_n_rows")  # absent in old saves
+    model._fit_weights_replayable = bool(
+        # legacy saves (this session only) carried replayability as
+        # fit_n_rows-non-None; older ones lack both → not replayable
+        fitted.get("weights_replayable", fitted.get("fit_n_rows") is not None)
+    )
     model._identity_subspace = fitted["identity_subspace"]
     model.fit_report_ = fitted["fit_report_"]
     model._fit_key = jax.random.wrap_key_data(
