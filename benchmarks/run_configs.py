@@ -470,6 +470,11 @@ def main() -> None:
     p.add_argument("--scale", choices=["smoke", "full"], default="smoke")
     p.add_argument("--json-out", default=None)
     p.add_argument(
+        "--resume", action="store_true",
+        help="skip configs whose --json-out file already holds a TPU "
+        "result — the watcher's flaky-window accumulation mode",
+    )
+    p.add_argument(
         "--platform", default=None,
         help="force a jax platform (e.g. 'cpu' when the TPU is down)",
     )
@@ -500,8 +505,24 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)),
         f"results_{args.scale}.json",
     )
+    prior: dict[int, dict] = {}
+    if args.resume and os.path.exists(out):
+        try:
+            with open(out) as f:
+                for r in json.load(f).get("results", []):
+                    # only real-accelerator results carry over — a
+                    # CPU-fallback row must be re-measured
+                    if r.get("backend") == "tpu":
+                        prior[r["config"]] = r
+        except Exception:  # noqa: BLE001 — corrupt file: start fresh
+            pass
     results, failures = [], []
     for c in wanted:
+        if c in prior:
+            print(json.dumps({"config": c, "resumed": True}),
+                  file=sys.stderr)
+            results.append(prior[c])
+            continue
         t0 = time.perf_counter()
         try:
             res = CONFIGS[c](args.scale)

@@ -57,8 +57,10 @@ EOF
 
 # Per-stage attempt caps: a stage that keeps failing ON A LIVE TUNNEL
 # (e.g. a persistent parity failure) is abandoned after MAX_TRIES so it
-# cannot burn the whole TPU window re-running forever; the exit
-# condition treats exhausted stages as settled.
+# cannot burn the whole TPU window re-running forever. An attempt only
+# COUNTS when the tunnel is still alive after the failure — a stage
+# killed by tunnel death is weather, not a stage bug, and must keep
+# retrying in later windows (the whole point of the resumable design).
 MAX_TRIES=6
 tries_tune=0; tries_bench=0; tries_smoke=0; tries_full=0
 
@@ -66,28 +68,36 @@ settled() {  # $1 = done-check fn, $2 = tries so far
   "$1" || [ "$2" -ge "$MAX_TRIES" ]
 }
 
+count_if_real_failure() {  # $1 = done-check fn; echoes 1 to add
+  if ! "$1" && alive; then echo 1; else echo 0; fi
+}
+
 while true; do
   if alive; then
     echo "TPU alive $(date -u +%H:%M:%S)" >> "$log"
     if ! settled tune_done "$tries_tune"; then
-      tries_tune=$((tries_tune + 1))
       timeout 2700 python benchmarks/tune_headline.py >> benchmarks/tune_headline.out 2>&1
-      echo "tune try=$tries_tune rc=$? $(date -u +%H:%M:%S)" >> "$log"
+      rc=$?
+      tries_tune=$((tries_tune + $(count_if_real_failure tune_done)))
+      echo "tune try=$tries_tune rc=$rc $(date -u +%H:%M:%S)" >> "$log"
     fi
     if ! settled bench_done "$tries_bench" && alive; then
-      tries_bench=$((tries_bench + 1))
       timeout 1200 python bench.py > benchmarks/bench_latest.json 2>/dev/null
-      echo "bench try=$tries_bench rc=$? $(date -u +%H:%M:%S)" >> "$log"
+      rc=$?
+      tries_bench=$((tries_bench + $(count_if_real_failure bench_done)))
+      echo "bench try=$tries_bench rc=$rc $(date -u +%H:%M:%S)" >> "$log"
     fi
     if ! settled smoke_done "$tries_smoke" && alive; then
-      tries_smoke=$((tries_smoke + 1))
-      timeout 2400 python benchmarks/run_configs.py --scale smoke > benchmarks/run_smoke.out 2>&1
-      echo "smoke try=$tries_smoke rc=$? $(date -u +%H:%M:%S)" >> "$log"
+      timeout 2400 python benchmarks/run_configs.py --scale smoke --resume > benchmarks/run_smoke.out 2>&1
+      rc=$?
+      tries_smoke=$((tries_smoke + $(count_if_real_failure smoke_done)))
+      echo "smoke try=$tries_smoke rc=$rc $(date -u +%H:%M:%S)" >> "$log"
     fi
     if ! settled full_done "$tries_full" && alive; then
-      tries_full=$((tries_full + 1))
-      timeout 7200 python benchmarks/run_configs.py --scale full --json-out benchmarks/results_full.json > benchmarks/run_full.out 2>&1
-      echo "full try=$tries_full rc=$? $(date -u +%H:%M:%S)" >> "$log"
+      timeout 7200 python benchmarks/run_configs.py --scale full --resume --json-out benchmarks/results_full.json > benchmarks/run_full.out 2>&1
+      rc=$?
+      tries_full=$((tries_full + $(count_if_real_failure full_done)))
+      echo "full try=$tries_full rc=$rc $(date -u +%H:%M:%S)" >> "$log"
     fi
     if settled tune_done "$tries_tune" && settled bench_done "$tries_bench" \
        && settled smoke_done "$tries_smoke" && settled full_done "$tries_full"; then

@@ -161,6 +161,42 @@ class _GBTBase(DecisionTreeRegressor):
         one_tree = 2 * n_rows * n_features * self.n_bins * 3 * nodes_total
         return float(self.n_rounds * one_tree)
 
+    def to_debug_string(self, params, feature_names=None) -> str:
+        """Per-round tree dumps — Spark's ``GBT*Model.toDebugString``
+        analog. Slices each round's (and, for multiclass, each class's)
+        node arrays out of the stacked params and renders them with the
+        single-tree walker."""
+        import numpy as np_
+
+        M = 2**self.max_depth - 1
+        leaf = np_.asarray(params["leaf"])
+        feature = np_.asarray(params["feature"])
+        threshold = np_.asarray(params["threshold"])
+        multiclass = leaf.ndim == 3
+        R = leaf.shape[0]
+        C = leaf.shape[1] if multiclass else 1
+        f0 = np_.asarray(params["f0"])
+        out = [
+            f"{type(self).__name__} (rounds={R}, depth={self.max_depth},"
+            f" lr={self.lr}, f0={np_.round(f0, 4).tolist()})"
+        ]
+        for r in range(R):
+            for c in range(C):
+                i = (r * C + c) * M
+                sub = {
+                    "feature": feature[i:i + M],
+                    "threshold": threshold[i:i + M],
+                    "leaf_value": leaf[r, c] if multiclass else leaf[r],
+                }
+                title = (
+                    f"Tree {r} (class {c}):" if multiclass
+                    else f"Tree {r}:"
+                )
+                body = super().to_debug_string(sub, feature_names)
+                out.append(title)
+                out.append("\n".join(body.split("\n")[1:]))  # drop header
+        return "\n".join(out)
+
     def fit_workset_bytes(self, n_rows, n_features, n_outputs):
         del n_features
         # per-round regression-tree temps (K=3 moments; buffers reuse

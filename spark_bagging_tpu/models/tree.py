@@ -471,6 +471,60 @@ class _TreeBase(BaseLearner):
 
     # -- routing (shared by fit-time and predict-time) ------------------
 
+    def _leaf_str(self, params, leaf_idx: int) -> str:
+        raise NotImplementedError
+
+    def to_debug_string(self, params, feature_names=None) -> str:
+        """Human-readable tree dump — Spark's
+        ``DecisionTree*Model.toDebugString`` analog, decoded from the
+        static level-ordered node arrays. Non-finite thresholds are the
+        engine's pre-pruned / unsplit markers (every row routes left),
+        rendered as the leaf they effectively are. For a bagged
+        ensemble, dump replica ``i`` via::
+
+            clf.base_learner_.to_debug_string(clf.replica_params(i)[0])
+        """
+        import numpy as np_
+
+        feat = np_.asarray(params["feature"])
+        thr = np_.asarray(params["threshold"])
+
+        def name(f):
+            return (
+                feature_names[f] if feature_names is not None
+                else f"feature {f}"
+            )
+
+        lines: list[str] = []
+
+        def walk(level: int, rel: int, indent: int) -> None:
+            pad = " " * indent
+            if level == self.max_depth:
+                lines.append(pad + self._leaf_str(params, rel))
+                return
+            node = (2**level - 1) + rel
+            if not np_.isfinite(thr[node]):
+                # unsplit/pre-pruned: all rows route left — render the
+                # reachable subtree without the phantom split
+                walk(level + 1, 2 * rel, indent)
+                return
+            lines.append(
+                pad + f"If ({name(int(feat[node]))} <= {thr[node]:.6g})"
+            )
+            walk(level + 1, 2 * rel, indent + 1)
+            lines.append(
+                pad + f"Else ({name(int(feat[node]))} > {thr[node]:.6g})"
+            )
+            walk(level + 1, 2 * rel + 1, indent + 1)
+
+        walk(0, 0, 1)
+        n_nodes = int(np_.isfinite(thr).sum())
+        header = (
+            f"{type(self).__name__} (depth={self.max_depth}, "
+            f"splits={n_nodes})"
+        )
+        return "\n".join([header] + lines)
+
     def _route(self, params, X):
         """Leaf index per row via ``max_depth`` gather-compare steps."""
         rel = jnp.zeros((X.shape[0],), jnp.int32)
@@ -590,6 +644,13 @@ class DecisionTreeClassifier(_TreeBase):
     def predict_scores(self, params, X):
         return params["leaf_logp"][self._route(params, X)]
 
+    def _leaf_str(self, params, leaf_idx):
+        import numpy as np_
+
+        logp = np_.asarray(params["leaf_logp"][leaf_idx])
+        c = int(logp.argmax())
+        return f"Predict: {c} (p={float(np_.exp(logp[c])):.3f})"
+
 
 class DecisionTreeRegressor(_TreeBase):
     """Weighted-variance (SSE) regression tree.
@@ -653,3 +714,6 @@ class DecisionTreeRegressor(_TreeBase):
 
     def predict_scores(self, params, X):
         return params["leaf_value"][self._route(params, X)]
+
+    def _leaf_str(self, params, leaf_idx):
+        return f"Predict: {float(params['leaf_value'][leaf_idx]):.6g}"

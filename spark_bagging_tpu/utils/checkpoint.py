@@ -138,9 +138,14 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
         "n_features_in_": model.n_features_in_,
         "n_estimators_": model.n_estimators_,
         "fit_sampling": list(model._fit_sampling),
-        "fit_n_rows": getattr(model, "_fit_n_rows", None),
-        # False for stream/data-sharded fits; True restores
-        # replica_weights after load
+        # fit_n_rows stays None for non-replayable (stream/data-sharded)
+        # fits ON PURPOSE: loaders predating the weights_replayable key
+        # gate replica_weights on fit_n_rows-non-None, and must keep
+        # failing safe when handed a newer checkpoint
+        "fit_n_rows": (
+            getattr(model, "_fit_n_rows", None)
+            if getattr(model, "_fit_weights_replayable", False) else None
+        ),
         "weights_replayable": bool(
             getattr(model, "_fit_weights_replayable", False)
         ),

@@ -470,3 +470,52 @@ def test_fractional_weights_unaffected_by_default_gate():
     # late, confident rounds still split (Hessian mass << 1)
     late = np.asarray(params["threshold"]).reshape(10, -1)[-1]
     assert np.isfinite(late[0])
+
+
+def test_to_debug_string_matches_predictions():
+    """Spark toDebugString analog: the printed rules route a probe row
+    to the same prediction predict_scores gives, and the planted split
+    feature appears at the root."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((600, 5)).astype(np.float32)
+    y = (X[:, 2] > 0.3).astype(np.int32)
+    tree = DecisionTreeClassifier(max_depth=2, n_bins=32)
+    params, _ = tree.fit_from_init(
+        jax.random.key(0), jnp.asarray(X), jnp.asarray(y),
+        jnp.ones(600), 2,
+    )
+    s = tree.to_debug_string(params)
+    assert s.splitlines()[1].startswith(" If (feature 2 <= ")
+    assert "Predict: " in s
+    # named features render
+    s2 = tree.to_debug_string(params, feature_names=list("abcde"))
+    assert "If (c <= " in s2
+    # manual routing along the printed root rule agrees with predict
+    thr = float(np.asarray(params["threshold"])[0])
+    probe_left = np.zeros((1, 5), np.float32); probe_left[0, 2] = thr - 1
+    probe_right = np.zeros((1, 5), np.float32); probe_right[0, 2] = thr + 1
+    pl = int(np.asarray(tree.predict_scores(params, jnp.asarray(probe_left))).argmax())
+    pr = int(np.asarray(tree.predict_scores(params, jnp.asarray(probe_right))).argmax())
+    assert pl == 0 and pr == 1
+
+
+def test_gbt_debug_string_binary_and_multiclass():
+    from spark_bagging_tpu import GBTClassifier
+
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((400, 4)).astype(np.float32)
+    y2 = (X[:, 1] > 0).astype(np.int32)
+    gbt = GBTClassifier(n_rounds=2, max_depth=2)
+    p, _ = gbt.fit_from_init(
+        jax.random.key(0), jnp.asarray(X), jnp.asarray(y2),
+        jnp.ones(400), 2,
+    )
+    s = gbt.to_debug_string(p)
+    assert "Tree 0:" in s and "Tree 1:" in s and "rounds=2" in s
+    y3 = rng.integers(0, 3, 400).astype(np.int32)
+    p3, _ = gbt.fit_from_init(
+        jax.random.key(0), jnp.asarray(X), jnp.asarray(y3),
+        jnp.ones(400), 3,
+    )
+    s3 = gbt.to_debug_string(p3)
+    assert "Tree 0 (class 0):" in s3 and "Tree 1 (class 2):" in s3
