@@ -81,6 +81,27 @@ def probe_backend(timeout_s: float = 120.0, retries: int = 1,
     return None, reason
 
 
+def load_sweep_winner(min_acc: float) -> dict | None:
+    """Best measured cell from the on-chip tuning sweep, if captured.
+
+    Lets the headline bench self-tune from data that may land (via the
+    detached watcher) after the builder's session. Cells without
+    accuracy, or below ``min_acc`` (the bench's own parity bar:
+    cached CPU baseline accuracy − parity tolerance), can't win — a
+    config that would fail the parity gate must not be selected by it.
+    """
+    path = os.path.join(REPO, "benchmarks", "tune_headline.json")
+    try:
+        cells = json.load(open(path))
+    except Exception:  # noqa: BLE001 — absent/corrupt: no sweep yet
+        return None
+    ok = [
+        c for c in cells
+        if c.get("fps") and c.get("acc") and c["acc"] >= min_acc
+    ]
+    return max(ok, key=lambda c: c["fps"]) if ok else None
+
+
 def fail(metric: str, error: str) -> None:
     print(json.dumps({
         "metric": metric, "value": None, "unit": "fits/sec",
@@ -175,10 +196,16 @@ def main() -> None:
     # 3 damped-Newton iters reach accuracy parity (0.7756 vs CPU
     # 0.7762, tolerance 0.01); "high" (bf16_3x) matmul precision keeps
     # parity at ~2.7x the fp32 MXU rate. --row-tile bounds the softmax
-    # temps at (chunk, tile, C), lifting the chunk ceiling.
-    p.add_argument("--chunk-size", type=int, default=200,
-                   help="0 = HBM-aware auto resolution (utils/memory.py)")
+    # temps at (chunk, tile, C), lifting the chunk ceiling. When the
+    # on-chip sweep (tune_headline.json) has been captured, its winner
+    # supersedes these hand-tuned defaults (explicit flags still win).
+    p.add_argument("--chunk-size", type=int, default=None,
+                   help="0 = HBM-aware auto resolution (utils/memory.py); "
+                   "unset = sweep winner if captured, else 200")
     p.add_argument("--row-tile", type=int, default=None)
+    p.add_argument("--no-sweep", action="store_true",
+                   help="ignore a captured tune_headline.json and run "
+                   "the pre-sweep hand-tuned defaults")
     # "blocked" emits C²/2 (d, d)-output matmuls — at d=55 the MXU's
     # 128x128 output tiles run ~18% full; "fused" emits one
     # (C·d, n)@(n, C·d) matmul whose 385-wide output tiles far better
@@ -248,14 +275,43 @@ def main() -> None:
     baseline = cache[config_key]
     baseline_par = baseline["parallel"]
 
+    # Self-tuning from the captured on-chip sweep: the winner's
+    # (impl, chunk, row_tile) apply ALL-OR-NOTHING, and only when every
+    # one of the three knobs was left at its default — the trio is
+    # co-tuned (packed's temp is O(chunk·tile·P·d); a winner chunk
+    # under a different impl is meaningless), so explicit flags opt the
+    # whole run out of sweep tuning. --no-sweep forces the pre-sweep
+    # defaults even with all flags defaulted.
+    hessian_impl = args.hessian_impl
+    chunk_size = args.chunk_size
+    row_tile = args.row_tile
+    tuned_from = None
+    all_defaulted = (
+        hessian_impl == "auto" and chunk_size is None and row_tile is None
+    )
+    if all_defaulted and not args.no_sweep:
+        sweep = load_sweep_winner(
+            baseline["accuracy"] - args.parity_tol
+        )
+        if sweep is not None:
+            hessian_impl = sweep["impl"]
+            chunk_size = sweep.get("chunk_resolved") or sweep["chunk"]
+            row_tile = sweep["row_tile"]
+            tuned_from = {
+                k: sweep.get(k)
+                for k in ("impl", "chunk", "row_tile", "fps")
+            }
+    if chunk_size is None:
+        chunk_size = 200  # pre-sweep hand-tuned default
+
     learner = LogisticRegression(
         l2=args.l2, max_iter=args.max_iter, precision=args.precision,
-        row_tile=args.row_tile, hessian_impl=args.hessian_impl,
+        row_tile=row_tile, hessian_impl=hessian_impl,
     )
     clf = BaggingClassifier(
         base_learner=learner,
         n_estimators=args.n_replicas,
-        chunk_size=args.chunk_size or None,  # 0 → HBM-aware auto
+        chunk_size=chunk_size or None,  # 0 → HBM-aware auto
         seed=0,
     )
     report, first_report, fit_seconds_all = None, None, []
@@ -314,6 +370,9 @@ def main() -> None:
         "h2d_seconds": round(report["h2d_seconds"], 3),
         "fits_per_sec_e2e": round(report["fits_per_sec_e2e"], 2),
         "predict_rows_per_sec": round(predict_rows_per_sec, 0),
+        "hessian_impl": hessian_impl,
+        "chunk_size": chunk_size,
+        "tuned_from_sweep": tuned_from,
     }
     if report.get("mfu") is not None:
         result["achieved_tflops"] = round(report["achieved_tflops"], 1)
