@@ -81,7 +81,7 @@ def probe_backend(timeout_s: float = 120.0, retries: int = 1,
     return None, reason
 
 
-def load_sweep_winner(min_acc: float) -> dict | None:
+def load_sweep_winner(min_acc: float, workload: dict) -> dict | None:
     """Best measured cell from the on-chip tuning sweep, if captured.
 
     Lets the headline bench self-tune from data that may land (via the
@@ -89,6 +89,9 @@ def load_sweep_winner(min_acc: float) -> dict | None:
     accuracy, or below ``min_acc`` (the bench's own parity bar:
     cached CPU baseline accuracy − parity tolerance), can't win — a
     config that would fail the parity gate must not be selected by it.
+    Cells whose stamped ``workload`` differs from the current one
+    (older sweep constants, older synthetic generator) can't win
+    either: their fps and acc were measured on a different problem.
     """
     path = os.path.join(REPO, "benchmarks", "tune_headline.json")
     try:
@@ -98,6 +101,7 @@ def load_sweep_winner(min_acc: float) -> dict | None:
     ok = [
         c for c in cells
         if c.get("fps") and c.get("acc") and c["acc"] >= min_acc
+        and c.get("workload") == workload
     ]
     return max(ok, key=lambda c: c["fps"]) if ok else None
 
@@ -243,16 +247,16 @@ def main() -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    from headline_data import (DATASET_VERSION, HEADLINE, WORKLOAD,
+                               load_headline_data)
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
-    from spark_bagging_tpu.utils.datasets import synthetic_covtype
 
-    X, y = synthetic_covtype(args.n_rows)
-    mu, sigma = X.mean(0), X.std(0) + 1e-8
-    X = ((X - mu) / sigma).astype(np.float32)
+    X, y = load_headline_data(args.n_rows)
 
     config_key = hashlib.sha1(
         json.dumps(
-            ["covtype_synth_v3", args.n_rows, args.l2], sort_keys=True
+            [DATASET_VERSION, args.n_rows, args.l2], sort_keys=True
         ).encode()
     ).hexdigest()[:12]
     cache = {}
@@ -289,13 +293,35 @@ def main() -> None:
     all_defaulted = (
         hessian_impl == "auto" and chunk_size is None and row_tile is None
     )
-    if all_defaulted and not args.no_sweep:
+    # …and only on the sweep's own workload + backend: a winner measured
+    # at 3 Newton iters on 581k TPU rows says nothing about --max-iter 1,
+    # --n-rows 50000, or --platform cpu (where a pallas winner wouldn't
+    # even compile), and its acc would gate against an incomparable
+    # baseline
+    workload_matches = (
+        backend == "tpu"
+        and args.n_replicas == HEADLINE["n_replicas"]
+        and args.n_rows == HEADLINE["n_rows"]
+        and args.l2 == HEADLINE["l2"]
+        and args.max_iter == HEADLINE["max_iter"]
+        and args.precision == HEADLINE["precision"]
+    )
+    if all_defaulted and workload_matches and not args.no_sweep:
         sweep = load_sweep_winner(
-            baseline["accuracy"] - args.parity_tol
+            baseline["accuracy"] - args.parity_tol, WORKLOAD
         )
         if sweep is not None:
             hessian_impl = sweep["impl"]
-            chunk_size = sweep.get("chunk_resolved") or sweep["chunk"]
+            # prefer what the winning cell actually resolved to; a null
+            # chunk_resolved on the auto cell means it ran UNchunked, so
+            # reproduce that via auto (chunk_size=0), not the hand-tuned
+            # 200 the sweep never measured
+            if sweep.get("chunk_resolved") is not None:
+                chunk_size = sweep["chunk_resolved"]
+            elif sweep["chunk"] is not None:
+                chunk_size = sweep["chunk"]
+            else:
+                chunk_size = 0
             row_tile = sweep["row_tile"]
             tuned_from = {
                 k: sweep.get(k)

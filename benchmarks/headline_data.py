@@ -1,0 +1,37 @@
+"""The headline workload's dataset, in ONE place.
+
+bench.py and tune_headline.py gate configs against each other's
+accuracies (load_sweep_winner), which is only sound if both measure on
+identically-preprocessed data — so both import this helper instead of
+keeping copies that could drift.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the sweep's fixed workload conditions; bench.py only applies a sweep
+# winner when its own knobs match these (a winner measured at 3 Newton
+# iters on 581k rows says nothing about --max-iter 1 on 50k rows)
+HEADLINE = dict(n_rows=581_012, n_replicas=1000, l2=1e-3, max_iter=3,
+                precision="high")
+
+DATASET_VERSION = "covtype_synth_v3"
+
+# stamped into every sweep cell and compared by bench.py's
+# load_sweep_winner: a stale tune_headline.json captured under older
+# constants or an older synthetic generator must not tune (or acc-gate)
+# a workload it never measured
+WORKLOAD = dict(HEADLINE, dataset=DATASET_VERSION)
+
+
+def load_headline_data(n_rows: int = HEADLINE["n_rows"]):
+    import numpy as np
+
+    from spark_bagging_tpu.utils.datasets import synthetic_covtype
+
+    X, y = synthetic_covtype(n_rows)
+    mu, sigma = X.mean(0), X.std(0) + 1e-8
+    return ((X - mu) / sigma).astype(np.float32), y

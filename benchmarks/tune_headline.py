@@ -5,29 +5,22 @@ fits/sec per cell. Writes benchmarks/tune_headline.json.
 Resumable per cell: already-measured cells (fps non-null in the
 existing JSON) are kept and skipped, so a tunnel that dies mid-sweep
 costs only the unmeasured cells on the next attempt — the watcher
-re-invokes this script until the grid is fully measured."""
-import json, os, sys
+re-invokes this script until the grid is fully measured.
+
+Each cell runs in its OWN SUBPROCESS with a hard timeout: on
+2026-07-31 a tunnel-side compile-helper crash (HTTP 500) left the
+in-process sweep blocked in an RPC for 25+ minutes of a live TPU
+window. A hung cell now costs at most CELL_TIMEOUT_S and is recorded
+as an error; the next cell gets a fresh client connection.
+"""
+import json, os, subprocess, sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-import numpy as np
-from spark_bagging_tpu import BaggingClassifier, LogisticRegression
-from spark_bagging_tpu.utils.datasets import synthetic_covtype
 
 OUT = os.path.join(REPO, "benchmarks", "tune_headline.json")
-done: dict = {}
-if os.path.exists(OUT):
-    try:
-        for c in json.load(open(OUT)):
-            if c.get("fps"):
-                done[(c["impl"], c["chunk"], c["row_tile"])] = c
-    except Exception:
-        pass
+CELL_TIMEOUT_S = 900
 
-X, y = synthetic_covtype(581_012)
-mu, sigma = X.mean(0), X.std(0) + 1e-8
-X = ((X - mu) / sigma).astype(np.float32)
-results = []
-for impl, chunk, row_tile in [
+GRID = [
     ("blocked", 200, None), ("blocked", 100, None), ("blocked", 300, None),
     ("blocked", 400, 65536), ("blocked", 500, 65536),
     # HBM-aware auto chunk [VERDICT r2 ask#8]: must pick a working
@@ -40,41 +33,132 @@ for impl, chunk, row_tile in [
     ("packed", 100, 16384),
     # pallas: packed math, wide operand built in VMEM (no HBM temp)
     ("pallas", 100, None), ("pallas", 200, None), ("pallas", 400, None),
-]:
-    if (impl, chunk, row_tile) in done:
-        results.append(done[(impl, chunk, row_tile)])
-        continue
-    learner = LogisticRegression(l2=1e-3, max_iter=3, precision="high",
-                                 row_tile=row_tile, hessian_impl=impl)
-    clf = BaggingClassifier(base_learner=learner, n_estimators=1000,
+]
+
+
+def run_cell(impl: str, chunk, row_tile) -> dict:
+    """Measure one grid cell (called in the child process)."""
+    from headline_data import HEADLINE, WORKLOAD, load_headline_data
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+
+    X, y = load_headline_data()
+    learner = LogisticRegression(
+        l2=HEADLINE["l2"], max_iter=HEADLINE["max_iter"],
+        precision=HEADLINE["precision"], row_tile=row_tile,
+        hessian_impl=impl)
+    clf = BaggingClassifier(base_learner=learner,
+                            n_estimators=HEADLINE["n_replicas"],
                             chunk_size=chunk, seed=0)
     cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
             "fps": None}
-    try:
-        best = None
-        for r in range(2):
-            clf.fit(X, y)
-            rep = clf.fit_report_
-            if best is None or rep["fit_seconds"] < best:
-                best = rep["fit_seconds"]
-                # the winning rep's on-chip efficiency [VERDICT r2 ask#2]
-                cell["mfu"] = (
-                    round(rep["mfu"], 3) if rep.get("mfu") else None
+    best = None
+    for _ in range(2):
+        clf.fit(X, y)
+        rep = clf.fit_report_
+        if best is None or rep["fit_seconds"] < best:
+            best = rep["fit_seconds"]
+            # the winning rep's on-chip efficiency [VERDICT r2 ask#2]
+            cell["mfu"] = round(rep["mfu"], 3) if rep.get("mfu") else None
+            cell["tflops"] = (
+                round(rep["achieved_tflops"], 1)
+                if rep.get("achieved_tflops") else None
+            )
+    cell["fps"] = round(HEADLINE["n_replicas"] / best, 1)
+    cell["chunk_resolved"] = rep.get("chunk_size_resolved", chunk)
+    cell["acc"] = round(float(clf.score(X[:100_000], y[:100_000])), 4)
+    cell["workload"] = WORKLOAD
+    return cell
+
+
+def main() -> None:
+    if "--cell" in sys.argv:
+        impl, chunk, row_tile = json.loads(sys.argv[sys.argv.index("--cell") + 1])
+        try:
+            cell = run_cell(impl, chunk, row_tile)
+        except Exception as e:  # noqa: BLE001 — child reports, parent records
+            cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
+                    "fps": None,
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+        print("CELL_RESULT " + json.dumps(cell), flush=True)
+        return
+
+    from headline_data import WORKLOAD
+
+    done: dict = {}
+    prior_err: dict = {}
+    if os.path.exists(OUT):
+        try:
+            for c in json.load(open(OUT)):
+                key = (c["impl"], c["chunk"], c["row_tile"])
+                # a cell measured under a different workload stamp (or
+                # none) is stale — re-measure it, don't resume it
+                if c.get("fps") and c.get("workload") == WORKLOAD:
+                    done[key] = c
+                elif c.get("error"):
+                    prior_err[key] = c
+        except Exception:
+            pass
+
+    # never-attempted cells first, previously-errored cells last: a
+    # persistently hanging early cell must not starve the rest of the
+    # grid under the watcher's outer timeout (each errored retry can
+    # cost CELL_TIMEOUT_S)
+    order = sorted(GRID, key=lambda k: k in prior_err)
+    # children share a persistent compilation cache so per-cell process
+    # isolation doesn't re-pay compiles a prior attempt already did
+    child_env = dict(os.environ,
+                     JAX_COMPILATION_CACHE_DIR=os.path.join(
+                         REPO, ".jax_cache"))
+    results = []
+    for impl, chunk, row_tile in order:
+        if (impl, chunk, row_tile) in done:
+            results.append(done[(impl, chunk, row_tile)])
+            continue
+        cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
+                "fps": None}
+        # start_new_session + killpg: the JAX client spawns helper
+        # processes that inherit the pipes; killing only the direct
+        # child would leave communicate() blocked on pipe EOF and
+        # re-wedge the sweep the timeout exists to protect
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--cell",
+             json.dumps([impl, chunk, row_tile])],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=child_env, start_new_session=True,
+        )
+        try:
+            out, err = proc.communicate(timeout=CELL_TIMEOUT_S)
+            for line in out.splitlines():
+                if line.startswith("CELL_RESULT "):
+                    cell = json.loads(line[len("CELL_RESULT "):])
+                    break
+            else:
+                cell["error"] = (
+                    f"child rc={proc.returncode}, no result: "
+                    + err.strip()[-200:]
                 )
-                cell["tflops"] = (
-                    round(rep["achieved_tflops"], 1)
-                    if rep.get("achieved_tflops") else None
-                )
-        cell["fps"] = round(1000 / best, 1)
-        cell["chunk_resolved"] = rep.get("chunk_size_resolved", chunk)
-        cell["acc"] = round(float(clf.score(X[:100_000], y[:100_000])), 4)
-    except Exception as e:
-        cell["error"] = f"{type(e).__name__}: {e}"[:200]
-    results.append(cell)
-    print(json.dumps(cell), flush=True)
-    # incremental write keeps prior-attempt measurements the loop has
-    # not reached yet — dying mid-sweep must never lose a measured cell
-    emitted = {(c["impl"], c["chunk"], c["row_tile"]) for c in results}
-    rest = [c for k, c in done.items() if k not in emitted]
-    with open(OUT, "w") as f:
-        json.dump(results + rest, f, indent=1)
+        except subprocess.TimeoutExpired:
+            import signal
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+            cell["error"] = f"cell timed out at {CELL_TIMEOUT_S}s (hung RPC?)"
+        results.append(cell)
+        print(json.dumps(cell), flush=True)
+        # incremental write keeps prior-attempt records the loop has not
+        # reached yet — measured cells AND error records (the errored-
+        # last ordering above depends on errors surviving rewrites)
+        emitted = {(c["impl"], c["chunk"], c["row_tile"]) for c in results}
+        rest = [c for k, c in {**prior_err, **done}.items()
+                if k not in emitted]
+        with open(OUT, "w") as f:
+            json.dump(results + rest, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
