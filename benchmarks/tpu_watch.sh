@@ -17,12 +17,20 @@ alive() {
 tune_done() {
   python - <<'EOF' 2>/dev/null
 import json, sys
+sys.path.insert(0, "benchmarks")
+from headline_data import WORKLOAD
+from tune_headline import GRID
 cells = json.load(open("benchmarks/tune_headline.json"))
-# done = full grid present and >=10/13 cells actually measured (a few
-# may legitimately OOM; the sweep resumes per-cell, so a partial file
-# from a dropped tunnel never counts as done)
-measured = sum(1 for c in cells if c.get("fps"))
-sys.exit(0 if len(cells) >= 13 and measured >= 10 else 1)
+# done = full grid attempted and all but <=3 cells measured UNDER THE
+# CURRENT WORKLOAD STAMP (a few may legitimately OOM; the sweep resumes
+# per-cell, so a partial file from a dropped tunnel never counts as
+# done). Cells measured under an older workload (changed HEADLINE
+# constants / dataset version) don't count — bench.py would reject
+# them, so a fully-captured stale sweep must trigger a re-sweep, not
+# settle the stage.
+measured = sum(1 for c in cells
+               if c.get("fps") and c.get("workload") == WORKLOAD)
+sys.exit(0 if len(cells) >= len(GRID) and measured >= len(GRID) - 3 else 1)
 EOF
 }
 
