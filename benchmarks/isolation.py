@@ -1,0 +1,75 @@
+"""Run one unit of TPU work in an isolated, timed child process.
+
+Shared by the tuning sweep (per grid cell) and the config suite (per
+config). The protocol exists because a tunnel-side compile-helper crash
+can leave a JAX client wedged in an RPC forever (observed 2026-07-31):
+
+- own process group (``start_new_session``) + ``killpg`` on timeout,
+  because JAX helper children inherit the pipes and would keep
+  ``communicate()`` blocked past the direct child's death;
+- a SIGTERM/SIGINT handler while the child runs, so the watcher's
+  *outer* ``timeout`` killing the parent also kills the child's whole
+  group — an orphaned child would keep running on the TPU and contend
+  with the watcher's next stage;
+- a shared persistent compilation cache, so process isolation doesn't
+  re-pay compiles a prior unit already did;
+- results ride one ``<prefix> <json>`` stdout line.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _kill_group(proc) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    try:
+        proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+def run_isolated_child(cmd: list, timeout_s: float, result_prefix: str):
+    """Returns ``(result_dict, None)`` or ``(None, error_str)``."""
+    env = dict(os.environ,
+               JAX_COMPILATION_CACHE_DIR=os.path.join(REPO, ".jax_cache"))
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True,
+    )
+
+    def on_term(signum, frame):
+        _kill_group(proc)
+        # re-raise with default disposition so the parent still dies
+        # with the right status for its own caller (the watcher)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    prev = {s: signal.signal(s, on_term)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _kill_group(proc)
+            return None, f"timed out at {timeout_s:.0f}s (hung RPC?)"
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+    prefix = result_prefix + " "
+    for line in out.splitlines():
+        if line.startswith(prefix):
+            return json.loads(line[len(prefix):]), None
+    return None, (
+        f"child rc={proc.returncode}, no result: " + err.strip()[-300:]
+    )
+
+
+def child_cmd(script: str, *args: str) -> list:
+    return [sys.executable, script, *args]

@@ -37,6 +37,7 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 
 
 def _standardize(X: np.ndarray) -> np.ndarray:
@@ -464,6 +465,19 @@ CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4,
            5: config_5, 6: config_6, 7: config_7}
 
 
+def _run_config_child(c: int, args, timeout_s: float):
+    """Run one config isolated — an in-process hang would burn the
+    watcher's whole suite timeout (7200 s at full scale) on one config;
+    see benchmarks/isolation.py for the protocol."""
+    from isolation import child_cmd, run_isolated_child
+
+    cmd = child_cmd(os.path.abspath(__file__),
+                    "--one-config", str(c), "--scale", args.scale)
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    return run_isolated_child(cmd, timeout_s, "CONFIG_RESULT")
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--configs", default="1,2,3,4,5,6,7")
@@ -479,7 +493,29 @@ def main() -> None:
         help="force a jax platform (e.g. 'cpu' when the TPU is down)",
     )
     p.add_argument("--probe-timeout", type=float, default=120.0)
+    p.add_argument(
+        "--one-config", type=int, default=None,
+        help="(internal) run a single config in-process and print a "
+        "CONFIG_RESULT line — the per-config child mode",
+    )
+    p.add_argument(
+        "--config-timeout", type=float, default=None,
+        help="per-config hard timeout in seconds "
+        "(default: 600 smoke / 1800 full)",
+    )
     args = p.parse_args()
+
+    if args.one_config is not None:
+        import jax
+
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+        t0 = time.perf_counter()
+        res = CONFIGS[args.one_config](args.scale)
+        res["wall_seconds"] = round(time.perf_counter() - t0, 2)
+        res["backend"] = jax.default_backend()
+        print("CONFIG_RESULT " + json.dumps(res), flush=True)
+        return
 
     # The ambient TPU plugin can block FOREVER in client init when the
     # tunnel is down (bench.py's probe protocol [VERDICT r1 weak#1]);
@@ -495,12 +531,10 @@ def main() -> None:
         }))
         sys.exit(1)
 
-    import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-
     wanted = [int(c) for c in args.configs.split(",")]
+    child_timeout = args.config_timeout or (
+        600.0 if args.scale == "smoke" else 1800.0
+    )
     out = args.json_out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"results_{args.scale}.json",
@@ -523,20 +557,13 @@ def main() -> None:
                   file=sys.stderr)
             results.append(prior[c])
             continue
-        t0 = time.perf_counter()
-        try:
-            res = CONFIGS[c](args.scale)
-        except Exception as e:  # noqa: BLE001 — a dropped TPU tunnel or
-            # OOM on one config must not lose the finished ones
-            failures.append({
-                "config": c,
-                "error": f"{type(e).__name__}: {e}"[:400],
-            })
+        res, error = _run_config_child(c, args, child_timeout)
+        if error is not None:
+            # a dropped TPU tunnel, OOM, or hang on one config must not
+            # lose the finished ones
+            failures.append({"config": c, "error": error[:400]})
             print(json.dumps(failures[-1]), file=sys.stderr)
-            res = None
-        if res is not None:
-            res["wall_seconds"] = round(time.perf_counter() - t0, 2)
-            res["backend"] = jax.default_backend()
+        else:
             print(json.dumps(res))
             results.append(res)
         # incremental persist: every completed config survives a crash

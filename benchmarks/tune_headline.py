@@ -11,14 +11,24 @@ Each cell runs in its OWN SUBPROCESS with a hard timeout: on
 2026-07-31 a tunnel-side compile-helper crash (HTTP 500) left the
 in-process sweep blocked in an RPC for 25+ minutes of a live TPU
 window. A hung cell now costs at most CELL_TIMEOUT_S and is recorded
-as an error; the next cell gets a fresh client connection.
+as an error; the next cell gets a fresh client connection. Protocol in
+benchmarks/isolation.py.
 """
-import json, os, subprocess, sys
+import json, os, sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 
 OUT = os.path.join(REPO, "benchmarks", "tune_headline.json")
 CELL_TIMEOUT_S = 900
+
+def order_cells(grid, prior_err):
+    """Never-attempted cells first, previously-errored cells last: a
+    persistently hanging early cell must not starve the rest of the
+    grid under the watcher's outer timeout (each errored retry can
+    cost CELL_TIMEOUT_S). Stable within each group."""
+    return sorted(grid, key=lambda k: k in prior_err)
+
 
 GRID = [
     ("blocked", 200, None), ("blocked", 100, None), ("blocked", 300, None),
@@ -99,55 +109,22 @@ def main() -> None:
         except Exception:
             pass
 
-    # never-attempted cells first, previously-errored cells last: a
-    # persistently hanging early cell must not starve the rest of the
-    # grid under the watcher's outer timeout (each errored retry can
-    # cost CELL_TIMEOUT_S)
-    order = sorted(GRID, key=lambda k: k in prior_err)
-    # children share a persistent compilation cache so per-cell process
-    # isolation doesn't re-pay compiles a prior attempt already did
-    child_env = dict(os.environ,
-                     JAX_COMPILATION_CACHE_DIR=os.path.join(
-                         REPO, ".jax_cache"))
+    from isolation import child_cmd, run_isolated_child
+
     results = []
-    for impl, chunk, row_tile in order:
+    for impl, chunk, row_tile in order_cells(GRID, prior_err):
         if (impl, chunk, row_tile) in done:
             results.append(done[(impl, chunk, row_tile)])
             continue
-        cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
-                "fps": None}
-        # start_new_session + killpg: the JAX client spawns helper
-        # processes that inherit the pipes; killing only the direct
-        # child would leave communicate() blocked on pipe EOF and
-        # re-wedge the sweep the timeout exists to protect
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--cell",
-             json.dumps([impl, chunk, row_tile])],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=child_env, start_new_session=True,
+        result, error = run_isolated_child(
+            child_cmd(os.path.abspath(__file__), "--cell",
+                      json.dumps([impl, chunk, row_tile])),
+            CELL_TIMEOUT_S, "CELL_RESULT",
         )
-        try:
-            out, err = proc.communicate(timeout=CELL_TIMEOUT_S)
-            for line in out.splitlines():
-                if line.startswith("CELL_RESULT "):
-                    cell = json.loads(line[len("CELL_RESULT "):])
-                    break
-            else:
-                cell["error"] = (
-                    f"child rc={proc.returncode}, no result: "
-                    + err.strip()[-200:]
-                )
-        except subprocess.TimeoutExpired:
-            import signal
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            try:
-                proc.communicate(timeout=30)
-            except subprocess.TimeoutExpired:
-                pass
-            cell["error"] = f"cell timed out at {CELL_TIMEOUT_S}s (hung RPC?)"
+        cell = result if result is not None else {
+            "impl": impl, "chunk": chunk, "row_tile": row_tile,
+            "fps": None, "error": error[:200],
+        }
         results.append(cell)
         print(json.dumps(cell), flush=True)
         # incremental write keeps prior-attempt records the loop has not

@@ -72,17 +72,21 @@ class TestLoadSweepWinner:
 class TestSweepOrdering:
     def test_errored_cells_sort_after_unattempted(self):
         errored = {tune_headline.GRID[0], tune_headline.GRID[2]}
-        order = sorted(tune_headline.GRID, key=lambda k: k in errored)
+        order = tune_headline.order_cells(tune_headline.GRID, errored)
         assert set(order[-2:]) == errored
         assert order[0] == tune_headline.GRID[1]
         # stable within each group: grid order is preserved
         rest = [k for k in tune_headline.GRID if k not in errored]
         assert order[:-2] == rest
 
-    def test_grid_matches_watcher_done_threshold(self):
-        # tpu_watch.sh's tune_done requires len(cells) >= 13; the grid
-        # shrinking below that would make the stage unsatisfiable-done
-        assert len(tune_headline.GRID) >= 13
+    def test_watcher_done_check_derives_from_grid(self):
+        # tune_done must stay coupled to the actual grid and workload
+        # stamp — a hardcoded count or stamp-blind count would let a
+        # stale or shrunken sweep settle the stage forever
+        src = open(os.path.join(REPO, "benchmarks", "tpu_watch.sh")).read()
+        assert "from tune_headline import GRID" in src
+        assert "from headline_data import WORKLOAD" in src
+        assert 'c.get("workload") == WORKLOAD' in src
 
     def test_workload_stamp_carries_headline_constants(self):
         for k, v in HEADLINE.items():
