@@ -191,6 +191,59 @@ def measure_cpu_baseline_parallel(X, y, l2: float) -> dict:
     }
 
 
+def _measure(args) -> dict:
+    """The measured phase (child mode): repeated fits, accuracy, and
+    the steady-state predict path. Returns a JSON-serializable dict."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from headline_data import load_headline_data
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+
+    X, y = load_headline_data(args.n_rows)
+    learner = LogisticRegression(
+        l2=args.l2, max_iter=args.max_iter, precision=args.precision,
+        row_tile=args.row_tile, hessian_impl=args.hessian_impl,
+    )
+    clf = BaggingClassifier(
+        base_learner=learner,
+        n_estimators=args.n_replicas,
+        chunk_size=args.chunk_size or None,  # 0 → HBM-aware auto
+        seed=0,
+    )
+    report, first_report, fit_seconds_all = None, None, []
+    for _ in range(max(1, args.repeat)):
+        clf.fit(X, y)  # includes compile; fit_report_ separates the two
+        if first_report is None:
+            first_report = clf.fit_report_
+        fit_seconds_all.append(round(clf.fit_report_["fit_seconds"], 2))
+        if report is None or clf.fit_report_["fit_seconds"] < report["fit_seconds"]:
+            report = clf.fit_report_
+    # compile/h2d come from the FIRST run — later runs hit the compile
+    # cache and would report ~0, hiding the real one-time cost
+    report = dict(report)
+    report["compile_seconds"] = first_report["compile_seconds"]
+    report["h2d_seconds"] = first_report["h2d_seconds"]
+    acc = float(clf.score(X[:100_000], y[:100_000]))
+
+    # Inference hot path [SURVEY §3.2]: the batched 1000-replica
+    # forward + soft-vote reduction, timed steady-state (one warm-up
+    # call compiles + pages in the row block).
+    n_pred = min(100_000, args.n_rows)
+    clf.predict_proba(X[:n_pred])
+    t0 = time.perf_counter()
+    clf.predict_proba(X[:n_pred])
+    predict_rows_per_sec = n_pred / (time.perf_counter() - t0)
+    return {
+        "report": json.loads(json.dumps(report, default=str)),
+        "fit_seconds_all": fit_seconds_all,
+        "acc": acc,
+        "predict_rows_per_sec": predict_rows_per_sec,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--n-replicas", type=int, default=1000)
@@ -229,6 +282,16 @@ def main() -> None:
     # — steady-state device throughput, not tunnel weather.
     p.add_argument("--repeat", type=int, default=2)
     p.add_argument("--probe-timeout", type=float, default=120.0)
+    # A tunnel-side crash can wedge a JAX client mid-fit (not error —
+    # hang); the measured phase therefore runs in an isolated child
+    # process group, and on expiry the parent still prints the one-line
+    # JSON error the driver parses [VERDICT r1 weak#1].
+    p.add_argument("--measure-timeout", type=float, default=1500.0)
+    p.add_argument(
+        "--measure-only", action="store_true",
+        help="(internal) run the measured phase in-process and print a "
+        "MEASURE_RESULT line — the isolation child mode",
+    )
     p.add_argument(
         "--platform", default=None,
         help="force a jax platform (e.g. 'cpu' to debug off-TPU)",
@@ -237,22 +300,22 @@ def main() -> None:
     args = p.parse_args()
 
     metric = "fits_per_sec_logreg_bag1000_covtype581k"
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+    if args.measure_only:
+        try:
+            measured = _measure(args)
+        except Exception as e:  # noqa: BLE001 — child reports, parent records
+            measured = {"error": f"{type(e).__name__}: {e}"[:400]}
+        print("MEASURE_RESULT " + json.dumps(measured, default=str),
+              flush=True)
+        return
 
     backend, reason = probe_backend(args.probe_timeout, platform=args.platform)
     if backend is None:
         fail(metric, f"jax backend unavailable after 2 attempts — {reason}")
 
-    import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-
-    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
-    from headline_data import (DATASET_VERSION, HEADLINE, WORKLOAD,
-                               load_headline_data)
-    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
-
-    X, y = load_headline_data(args.n_rows)
+    from headline_data import DATASET_VERSION, HEADLINE, WORKLOAD
 
     config_key = hashlib.sha1(
         json.dumps(
@@ -263,14 +326,19 @@ def main() -> None:
     if os.path.exists(CACHE_PATH):
         with open(CACHE_PATH) as f:
             cache = json.load(f)
-    if config_key not in cache:
-        cache[config_key] = measure_cpu_baseline(X, y, args.l2)
-        with open(CACHE_PATH, "w") as f:
-            json.dump(cache, f, indent=2)
     # the parallel baseline is host-shaped: a cached entry from a
     # different core count would silently mis-scale vs_baseline_parallel
-    cached_cores = cache[config_key].get("parallel", {}).get("cpu_cores")
-    if cached_cores != (os.cpu_count() or 1):
+    cores_stale = (
+        config_key in cache
+        and cache[config_key].get("parallel", {}).get("cpu_cores")
+        != (os.cpu_count() or 1)
+    )
+    if config_key not in cache or cores_stale:
+        from headline_data import load_headline_data
+
+        X, y = load_headline_data(args.n_rows)
+        if config_key not in cache:
+            cache[config_key] = measure_cpu_baseline(X, y, args.l2)
         cache[config_key]["parallel"] = measure_cpu_baseline_parallel(
             X, y, args.l2
         )
@@ -330,43 +398,38 @@ def main() -> None:
     if chunk_size is None:
         chunk_size = 200  # pre-sweep hand-tuned default
 
-    learner = LogisticRegression(
-        l2=args.l2, max_iter=args.max_iter, precision=args.precision,
-        row_tile=row_tile, hessian_impl=hessian_impl,
-    )
-    clf = BaggingClassifier(
-        base_learner=learner,
-        n_estimators=args.n_replicas,
-        chunk_size=chunk_size or None,  # 0 → HBM-aware auto
-        seed=0,
-    )
-    report, first_report, fit_seconds_all = None, None, []
-    for _ in range(max(1, args.repeat)):
-        try:
-            clf.fit(X, y)  # includes compile; fit_report_ separates the two
-        except Exception as e:  # noqa: BLE001 — surface OOM/compile errors as JSON
-            fail(metric, f"fit failed: {type(e).__name__}: {e}"[:400])
-        if first_report is None:
-            first_report = clf.fit_report_
-        fit_seconds_all.append(round(clf.fit_report_["fit_seconds"], 2))
-        if report is None or clf.fit_report_["fit_seconds"] < report["fit_seconds"]:
-            report = clf.fit_report_
-    # compile/h2d come from the FIRST run — later runs hit the compile
-    # cache and would report ~0, hiding the real one-time cost
-    report = dict(report)
-    report["compile_seconds"] = first_report["compile_seconds"]
-    report["h2d_seconds"] = first_report["h2d_seconds"]
-    acc = clf.score(X[:100_000], y[:100_000])
-    parity = bool(acc >= baseline["accuracy"] - args.parity_tol)
+    # measured phase: isolated child process group with a hard timeout
+    # (a wedged tunnel RPC must yield the JSON error line, not rc=124)
+    from isolation import child_cmd, run_isolated_child
 
-    # Inference hot path [SURVEY §3.2]: the batched 1000-replica
-    # forward + soft-vote reduction, timed steady-state (one warm-up
-    # call compiles + pages in the row block).
-    n_pred = min(100_000, args.n_rows)
-    clf.predict_proba(X[:n_pred])
-    t0 = time.perf_counter()
-    clf.predict_proba(X[:n_pred])
-    predict_rows_per_sec = n_pred / (time.perf_counter() - t0)
+    cmd = child_cmd(
+        os.path.abspath(__file__), "--measure-only",
+        "--hessian-impl", hessian_impl,
+        "--chunk-size", str(chunk_size),
+        "--n-replicas", str(args.n_replicas),
+        "--n-rows", str(args.n_rows),
+        "--l2", str(args.l2),
+        "--max-iter", str(args.max_iter),
+        "--precision", args.precision,
+        "--repeat", str(args.repeat),
+    )
+    if row_tile is not None:
+        cmd += ["--row-tile", str(row_tile)]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    measured, error = run_isolated_child(
+        cmd, args.measure_timeout, "MEASURE_RESULT"
+    )
+    if error is not None:
+        fail(metric, f"measurement child failed: {error}"[:400])
+    if measured.get("error"):
+        fail(metric, f"fit failed: {measured['error']}"[:400])
+
+    report = measured["report"]
+    fit_seconds_all = measured["fit_seconds_all"]
+    acc = measured["acc"]
+    predict_rows_per_sec = measured["predict_rows_per_sec"]
+    parity = bool(acc >= baseline["accuracy"] - args.parity_tol)
 
     fps = report["fits_per_sec"]
     result = {

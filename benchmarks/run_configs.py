@@ -511,9 +511,14 @@ def main() -> None:
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
         t0 = time.perf_counter()
-        res = CONFIGS[args.one_config](args.scale)
-        res["wall_seconds"] = round(time.perf_counter() - t0, 2)
-        res["backend"] = jax.default_backend()
+        try:
+            res = CONFIGS[args.one_config](args.scale)
+            res["wall_seconds"] = round(time.perf_counter() - t0, 2)
+            res["backend"] = jax.default_backend()
+        except Exception as e:  # noqa: BLE001 — concise '<Type>: <msg>'
+            # beats a truncated traceback tail in the failure log
+            res = {"config": args.one_config,
+                   "error": f"{type(e).__name__}: {e}"[:400]}
         print("CONFIG_RESULT " + json.dumps(res), flush=True)
         return
 
@@ -558,6 +563,8 @@ def main() -> None:
             results.append(prior[c])
             continue
         res, error = _run_config_child(c, args, child_timeout)
+        if error is None and res.get("error"):
+            error, res = res["error"], None
         if error is not None:
             # a dropped TPU tunnel, OOM, or hang on one config must not
             # lose the finished ones

@@ -123,7 +123,8 @@ def main() -> None:
         )
         cell = result if result is not None else {
             "impl": impl, "chunk": chunk, "row_tile": row_tile,
-            "fps": None, "error": error[:200],
+            # keep the TAIL — that's where the exception line lives
+            "fps": None, "error": error[-200:],
         }
         results.append(cell)
         print(json.dumps(cell), flush=True)
