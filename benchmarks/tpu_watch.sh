@@ -90,7 +90,10 @@ while true; do
       echo "tune try=$tries_tune rc=$rc $(date -u +%H:%M:%S)" >> "$log"
     fi
     if ! settled bench_done "$tries_bench" && alive; then
-      timeout 1200 python bench.py > benchmarks/bench_latest.json 2>/dev/null
+      # 1800 > bench.py's --measure-timeout (1500) + probe + baselines:
+      # let bench.py's own child isolation report a wedge as a JSON
+      # error line rather than being killed from outside mid-write
+      timeout 1800 python bench.py > benchmarks/bench_latest.json 2>/dev/null
       rc=$?
       tries_bench=$((tries_bench + $(count_if_real_failure bench_done)))
       echo "bench try=$tries_bench rc=$rc $(date -u +%H:%M:%S)" >> "$log"
