@@ -15,13 +15,16 @@ can leave a JAX client wedged in an RPC forever (observed 2026-07-31):
   re-pay compiles a prior unit already did;
 - results ride one ``<prefix> <json>`` stdout line.
 """
+import fcntl
 import json
 import os
 import signal
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK_PATH = os.path.join(REPO, ".tpu_lock")
 
 
 def _kill_group(proc) -> None:
@@ -35,8 +38,42 @@ def _kill_group(proc) -> None:
         pass
 
 
+def _acquire_device_lock(deadline_s: float):
+    """One TPU child at a time, machine-wide: the watcher's capture
+    stages and a driver-invoked bench.py can overlap in wall-clock, and
+    two benchmark processes contending for the single chip would
+    corrupt both runs' timings (or OOM HBM). flock is released by the
+    kernel when the holder exits, so a killed parent can't leak the
+    lock. Polls nonblocking so a wedged holder costs at most
+    ``deadline_s``, not forever."""
+    f = open(LOCK_PATH, "w")
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except BlockingIOError:
+            if time.monotonic() >= deadline:
+                f.close()
+                return None
+            time.sleep(5.0)
+
+
 def run_isolated_child(cmd: list, timeout_s: float, result_prefix: str):
     """Returns ``(result_dict, None)`` or ``(None, error_str)``."""
+    lock = _acquire_device_lock(deadline_s=timeout_s)
+    if lock is None:
+        return None, (
+            f"device lock not acquired within {timeout_s:.0f}s — another "
+            "benchmark process holds the TPU"
+        )
+    try:
+        return _run_child_locked(cmd, timeout_s, result_prefix)
+    finally:
+        lock.close()
+
+
+def _run_child_locked(cmd: list, timeout_s: float, result_prefix: str):
     env = dict(os.environ,
                JAX_COMPILATION_CACHE_DIR=os.path.join(REPO, ".jax_cache"))
     proc = subprocess.Popen(

@@ -94,6 +94,37 @@ class TestSweepOrdering:
         assert "dataset" in WORKLOAD
 
 
+class TestDeviceLock:
+    def test_serializes_across_processes(self, tmp_path, monkeypatch):
+        """Two benchmark parents must not drive the chip concurrently:
+        acquire fails within its deadline while another process holds
+        the lock, succeeds after the holder exits."""
+        import subprocess
+        import time
+
+        import isolation
+        monkeypatch.setattr(isolation, "LOCK_PATH",
+                            str(tmp_path / "tpu_lock"))
+        holder = subprocess.Popen(
+            [sys.executable, "-u", "-c", f"""
+import sys, time, fcntl
+f = open({str(tmp_path / "tpu_lock")!r}, "w")
+fcntl.flock(f, fcntl.LOCK_EX)
+print("HELD", flush=True)
+time.sleep(6)
+f.close()
+"""],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert holder.stdout.readline().strip() == "HELD"
+            assert isolation._acquire_device_lock(1.0) is None
+            got = isolation._acquire_device_lock(60.0)
+            assert got is not None
+            got.close()
+        finally:
+            holder.wait(timeout=30)
+
+
 class TestCellChild:
     def test_bad_impl_reports_error_not_crash(self):
         import subprocess
