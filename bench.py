@@ -199,12 +199,16 @@ def _measure(args) -> dict:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    from headline_data import load_headline_data
+    from headline_data import HEADLINE, load_headline_data
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
 
     X, y = load_headline_data(args.n_rows)
     learner = LogisticRegression(
-        l2=args.l2, max_iter=args.max_iter, precision=args.precision,
+        l2=args.l2,
+        max_iter=(HEADLINE["max_iter"] if args.max_iter is None
+                  else args.max_iter),
+        init=args.init or HEADLINE["init"],
+        precision=args.precision,
         row_tile=args.row_tile, hessian_impl=args.hessian_impl,
     )
     clf = BaggingClassifier(
@@ -271,7 +275,11 @@ def main() -> None:
     # (~43% fill) — needs --row-tile.
     p.add_argument("--hessian-impl", default="auto",
                    choices=["auto", "blocked", "fused", "packed", "pallas"])
-    p.add_argument("--max-iter", type=int, default=3)
+    # max_iter/init are sweep-tunable solver knobs (None = sweep winner
+    # if captured, else the HEADLINE defaults 3/"zeros"); init="pooled"
+    # warm-starts every replica from one shared pooled solve
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--init", default=None, choices=["zeros", "pooled"])
     p.add_argument("--l2", type=float, default=1e-3)
     p.add_argument("--precision", default="high")
     p.add_argument("--parity-tol", type=float, default=0.01)
@@ -357,21 +365,22 @@ def main() -> None:
     hessian_impl = args.hessian_impl
     chunk_size = args.chunk_size
     row_tile = args.row_tile
+    max_iter = args.max_iter
+    init = args.init
     tuned_from = None
     all_defaulted = (
         hessian_impl == "auto" and chunk_size is None and row_tile is None
+        and max_iter is None and init is None
     )
     # …and only on the sweep's own workload + backend: a winner measured
-    # at 3 Newton iters on 581k TPU rows says nothing about --max-iter 1,
-    # --n-rows 50000, or --platform cpu (where a pallas winner wouldn't
-    # even compile), and its acc would gate against an incomparable
-    # baseline
+    # on 581k TPU rows at l2=1e-3 says nothing about --n-rows 50000 or
+    # --platform cpu (where a pallas winner wouldn't even compile), and
+    # its acc would gate against an incomparable baseline
     workload_matches = (
         backend == "tpu"
         and args.n_replicas == HEADLINE["n_replicas"]
         and args.n_rows == HEADLINE["n_rows"]
         and args.l2 == HEADLINE["l2"]
-        and args.max_iter == HEADLINE["max_iter"]
         and args.precision == HEADLINE["precision"]
     )
     if all_defaulted and workload_matches and not args.no_sweep:
@@ -391,12 +400,19 @@ def main() -> None:
             else:
                 chunk_size = 0
             row_tile = sweep["row_tile"]
+            max_iter = sweep.get("max_iter", HEADLINE["max_iter"])
+            init = sweep.get("init", HEADLINE["init"])
             tuned_from = {
                 k: sweep.get(k)
-                for k in ("impl", "chunk", "row_tile", "fps")
+                for k in ("impl", "chunk", "row_tile", "max_iter",
+                          "init", "fps")
             }
     if chunk_size is None:
         chunk_size = 200  # pre-sweep hand-tuned default
+    if max_iter is None:
+        max_iter = HEADLINE["max_iter"]
+    if init is None:
+        init = HEADLINE["init"]
 
     # measured phase: isolated child process group with a hard timeout
     # (a wedged tunnel RPC must yield the JSON error line, not rc=124)
@@ -409,7 +425,8 @@ def main() -> None:
         "--n-replicas", str(args.n_replicas),
         "--n-rows", str(args.n_rows),
         "--l2", str(args.l2),
-        "--max-iter", str(args.max_iter),
+        "--max-iter", str(max_iter),
+        "--init", init,
         "--precision", args.precision,
         "--repeat", str(args.repeat),
     )
@@ -461,6 +478,8 @@ def main() -> None:
         "predict_rows_per_sec": round(predict_rows_per_sec, 0),
         "hessian_impl": hessian_impl,
         "chunk_size": chunk_size,
+        "max_iter": max_iter,
+        "init": init,
         "tuned_from_sweep": tuned_from,
     }
     if report.get("mfu") is not None:

@@ -53,10 +53,11 @@ def _acquire_device_lock(deadline_s: float):
             fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
             return f
         except BlockingIOError:
-            if time.monotonic() >= deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 f.close()
                 return None
-            time.sleep(5.0)
+            time.sleep(min(5.0, remaining))
 
 
 def run_isolated_child(cmd: list, timeout_s: float, result_prefix: str):

@@ -30,37 +30,51 @@ def order_cells(grid, prior_err):
     return sorted(grid, key=lambda k: k in prior_err)
 
 
+# cell = (impl, chunk, row_tile, max_iter, init)
 GRID = [
-    ("blocked", 200, None), ("blocked", 100, None), ("blocked", 300, None),
-    ("blocked", 400, 65536), ("blocked", 500, 65536),
+    # controls: the round-2 headline config (3 cold Newton iters)
+    ("blocked", 200, None, 3, "zeros"),
+    ("blocked", 100, None, 3, "zeros"),
+    # pooled warm start: ONE refinement iter from a shared pooled solve
+    # reaches 3-cold-iter ensemble accuracy at ~1/3 the per-replica
+    # Newton work (tests/test_pooled_init.py); max_iter=2 cell is the
+    # parity fallback if 1 iter misses the gate at 581k
+    ("blocked", 200, None, 1, "pooled"),
+    ("blocked", 300, None, 1, "pooled"),
+    ("blocked", 200, None, 2, "pooled"),
     # HBM-aware auto chunk [VERDICT r2 ask#8]: must pick a working
     # chunk unattended (the cell also validates the bytes model on
     # real silicon)
-    ("blocked", None, None),
+    ("blocked", None, None, 1, "pooled"),
+    ("blocked", 400, 65536, 3, "zeros"),
     # packed: blocked FLOPs at ~2.4x the MXU output-tile fill; temp is
     # O(tile*P*d) so it needs row tiling and a smaller replica chunk
-    ("packed", 50, 16384), ("packed", 100, 8192), ("packed", 200, 4096),
-    ("packed", 100, 16384),
+    ("packed", 100, 8192, 3, "zeros"),
+    ("packed", 100, 8192, 1, "pooled"),
+    ("packed", 200, 4096, 1, "pooled"),
     # pallas: packed math, wide operand built in VMEM (no HBM temp)
-    ("pallas", 100, None), ("pallas", 200, None), ("pallas", 400, None),
+    ("pallas", 200, None, 3, "zeros"),
+    ("pallas", 200, None, 1, "pooled"),
+    ("pallas", 400, None, 1, "pooled"),
 ]
 
 
-def run_cell(impl: str, chunk, row_tile) -> dict:
+def run_cell(impl: str, chunk, row_tile, max_iter: int,
+             init: str) -> dict:
     """Measure one grid cell (called in the child process)."""
     from headline_data import HEADLINE, WORKLOAD, load_headline_data
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
 
     X, y = load_headline_data()
     learner = LogisticRegression(
-        l2=HEADLINE["l2"], max_iter=HEADLINE["max_iter"],
+        l2=HEADLINE["l2"], max_iter=max_iter, init=init,
         precision=HEADLINE["precision"], row_tile=row_tile,
         hessian_impl=impl)
     clf = BaggingClassifier(base_learner=learner,
                             n_estimators=HEADLINE["n_replicas"],
                             chunk_size=chunk, seed=0)
     cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
-            "fps": None}
+            "max_iter": max_iter, "init": init, "fps": None}
     best = None
     for _ in range(2):
         clf.fit(X, y)
@@ -80,14 +94,22 @@ def run_cell(impl: str, chunk, row_tile) -> dict:
     return cell
 
 
+def cell_key(c: dict) -> tuple:
+    """Resume key; pre-pooled records default to (3, 'zeros') — the
+    constants they were measured under."""
+    return (c["impl"], c["chunk"], c["row_tile"],
+            c.get("max_iter", 3), c.get("init", "zeros"))
+
+
 def main() -> None:
     if "--cell" in sys.argv:
-        impl, chunk, row_tile = json.loads(sys.argv[sys.argv.index("--cell") + 1])
+        spec = json.loads(sys.argv[sys.argv.index("--cell") + 1])
+        impl, chunk, row_tile, max_iter, init = spec
         try:
-            cell = run_cell(impl, chunk, row_tile)
+            cell = run_cell(impl, chunk, row_tile, max_iter, init)
         except Exception as e:  # noqa: BLE001 — child reports, parent records
             cell = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
-                    "fps": None,
+                    "max_iter": max_iter, "init": init, "fps": None,
                     "error": f"{type(e).__name__}: {e}"[:200]}
         print("CELL_RESULT " + json.dumps(cell), flush=True)
         return
@@ -99,30 +121,31 @@ def main() -> None:
     if os.path.exists(OUT):
         try:
             for c in json.load(open(OUT)):
-                key = (c["impl"], c["chunk"], c["row_tile"])
                 # a cell measured under a different workload stamp (or
                 # none) is stale — re-measure it, don't resume it
                 if c.get("fps") and c.get("workload") == WORKLOAD:
-                    done[key] = c
+                    done[cell_key(c)] = c
                 elif c.get("error"):
-                    prior_err[key] = c
+                    prior_err[cell_key(c)] = c
         except Exception:
             pass
 
     from isolation import child_cmd, run_isolated_child
 
     results = []
-    for impl, chunk, row_tile in order_cells(GRID, prior_err):
-        if (impl, chunk, row_tile) in done:
-            results.append(done[(impl, chunk, row_tile)])
+    for spec in order_cells(GRID, prior_err):
+        if spec in done:
+            results.append(done[spec])
             continue
+        impl, chunk, row_tile, max_iter, init = spec
         result, error = run_isolated_child(
             child_cmd(os.path.abspath(__file__), "--cell",
-                      json.dumps([impl, chunk, row_tile])),
+                      json.dumps(list(spec))),
             CELL_TIMEOUT_S, "CELL_RESULT",
         )
         cell = result if result is not None else {
             "impl": impl, "chunk": chunk, "row_tile": row_tile,
+            "max_iter": max_iter, "init": init,
             # keep the TAIL — that's where the exception line lives
             "fps": None, "error": error[-200:],
         }
@@ -131,7 +154,7 @@ def main() -> None:
         # incremental write keeps prior-attempt records the loop has not
         # reached yet — measured cells AND error records (the errored-
         # last ordering above depends on errors surviving rewrites)
-        emitted = {(c["impl"], c["chunk"], c["row_tile"]) for c in results}
+        emitted = {cell_key(c) for c in results}
         rest = [c for k, c in {**prior_err, **done}.items()
                 if k not in emitted]
         with open(OUT, "w") as f:

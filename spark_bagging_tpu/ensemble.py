@@ -113,6 +113,14 @@ def fit_ensemble(
     # unbatched so it is not repeated per replica [models/base.py].
     with named_scope("prepare"):
         prepared = learner.prepare(X, axis_name=data_axis, row_mask=row_mask)
+        if learner.uses_pooled_init:
+            # one shared ensemble-level solve; replicas warm-start from
+            # it via initial_params (amortized over all replicas, and
+            # replicated — not per-replica — under data sharding)
+            prepared = learner.pooled_init(
+                key, prepared, X, y, n_outputs,
+                row_mask=row_mask, axis_name=data_axis,
+            )
 
     def fit_one(rid):
         with named_scope("bootstrap"):

@@ -65,11 +65,45 @@ class BaseLearner(ParamsMixin):
     # ``prepared`` pattern), so the plain contract is unchanged
     # [VERDICT r2 ask#7].
     uses_aux: ClassVar[bool] = False
+    # Learners that can warm-start every replica from ONE shared
+    # ensemble-level solve (e.g. logistic regression's pooled unweighted
+    # optimum — the problem is convex, so per-replica refinement from a
+    # good shared start reaches the same optimum in far fewer
+    # iterations) expose ``uses_pooled_init`` (typically a property on
+    # an ``init="pooled"`` hyperparam) and implement ``pooled_init``.
+    # The engine calls ``pooled_init`` once outside the replica map and
+    # threads the result through ``prepared``/``gather_subspace`` into
+    # ``initial_params`` — the same plumbing as ``prepare``.
+    uses_pooled_init: ClassVar[bool] = False
 
     def init_params(
         self, key: jax.Array, n_features: int, n_outputs: int
     ) -> Params:
         raise NotImplementedError
+
+    def pooled_init(
+        self,
+        key: jax.Array,
+        prepared: Any,
+        X: jax.Array,
+        y: jax.Array,
+        n_outputs: int,
+        *,
+        row_mask: jax.Array | None = None,
+        axis_name: str | None = None,
+    ) -> Any:
+        """Shared warm-start state, computed once per ensemble; returned
+        value replaces ``prepared`` for this fit."""
+        raise NotImplementedError
+
+    def initial_params(
+        self, key: jax.Array, n_features: int, n_outputs: int,
+        prepared: Any | None,
+    ) -> Params:
+        """Per-replica initial params; sees the prepared state so a
+        pooled warm start can override the cold ``init_params``."""
+        del prepared
+        return self.init_params(key, n_features, n_outputs)
 
     def fit(
         self,
@@ -194,7 +228,7 @@ class BaseLearner(ParamsMixin):
         from spark_bagging_tpu.ops.bootstrap import split_init_fit
 
         init_key, fit_key = split_init_fit(key)
-        params = self.init_params(init_key, X.shape[1], n_outputs)
+        params = self.initial_params(init_key, X.shape[1], n_outputs, prepared)
         kwargs = {}
         if prepared is not None:
             # Only learners with a prepare() hook receive the kwarg, so

@@ -22,8 +22,9 @@ from headline_data import HEADLINE, WORKLOAD  # noqa: E402
 
 
 def _cell(impl="blocked", chunk=200, row_tile=None, fps=100.0, acc=0.77,
-          workload=WORKLOAD, **extra):
-    c = {"impl": impl, "chunk": chunk, "row_tile": row_tile, "fps": fps,
+          workload=WORKLOAD, max_iter=3, init="zeros", **extra):
+    c = {"impl": impl, "chunk": chunk, "row_tile": row_tile,
+         "max_iter": max_iter, "init": init, "fps": fps,
          "acc": acc, "workload": workload}
     c.update(extra)
     return c
@@ -88,10 +89,21 @@ class TestSweepOrdering:
         assert "from headline_data import WORKLOAD" in src
         assert 'c.get("workload") == WORKLOAD' in src
 
-    def test_workload_stamp_carries_headline_constants(self):
-        for k, v in HEADLINE.items():
-            assert WORKLOAD[k] == v
-        assert "dataset" in WORKLOAD
+    def test_workload_stamp_carries_problem_constants_only(self):
+        # WORKLOAD = the problem (dataset + size + l2 + precision);
+        # max_iter/init are tunable solver knobs each cell records for
+        # itself and must NOT be in the stamp (a pooled-1-iter winner is
+        # a legitimate tuning, not a different workload)
+        assert set(WORKLOAD) == {"dataset", "n_rows", "n_replicas",
+                                 "l2", "precision"}
+        for k in set(WORKLOAD) & set(HEADLINE):
+            assert WORKLOAD[k] == HEADLINE[k]
+        assert "max_iter" not in WORKLOAD and "init" not in WORKLOAD
+
+    def test_resume_key_defaults_for_pre_pooled_records(self):
+        import tune_headline as th
+        old = {"impl": "blocked", "chunk": 200, "row_tile": None}
+        assert th.cell_key(old) == ("blocked", 200, None, 3, "zeros")
 
 
 class TestDeviceLock:
@@ -131,7 +143,7 @@ class TestCellChild:
         proc = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "benchmarks", "tune_headline.py"),
-             "--cell", json.dumps(["bogus", 10, None])],
+             "--cell", json.dumps(["bogus", 10, None, 1, "zeros"])],
             capture_output=True, text=True, timeout=300,
             env=dict(os.environ, JAX_PLATFORMS="cpu"),
         )
