@@ -105,6 +105,7 @@ class BaseLearner(ParamsMixin):
         del prepared
         return self.init_params(key, n_features, n_outputs)
 
+
     def fit(
         self,
         params: Params,
@@ -254,3 +255,65 @@ class BaseLearner(ParamsMixin):
             type(self) is type(other)
             and self.get_params(deep=False) == other.get_params(deep=False)  # type: ignore[union-attr]
         )
+
+
+class PooledStartMixin:
+    """Pooled warm start for CONVEX learners (logistic/GLM/SVC):
+    ``init="pooled"`` solves the unweighted pooled problem once per
+    ensemble (``pooled_iter`` solver steps, amortized over all
+    replicas) and starts every replica's weighted fit from that shared
+    solution. Convexity is load-bearing — each replica's objective has
+    a unique optimum, so the init changes the solver's path, not its
+    destination; for non-convex learners (MLP, FM) a shared start would
+    instead collapse ensemble diversity, so they must NOT use this.
+
+    This amortization is an ensemble-LEVEL optimization the reference's
+    per-fit driver loop cannot express [SURVEY §3.1]: Spark fits each
+    replica as an independent job, while here the pooled solve is one
+    more node in the single XLA program.
+
+    Subclass requirements: list this mixin BEFORE ``BaseLearner`` in
+    the bases, declare ``init``/``pooled_iter`` hyperparams (validated
+    in ``__init__``), keep coefficients in a single params leaf named
+    ``_pooled_leaf`` with the bias row/element LAST, and a ``fit`` that
+    honors arbitrary initial params AND accepts (it may ignore) a
+    ``prepared=`` keyword — with pooled init active the engine's
+    ``prepared`` state is non-None, so ``fit_from_init`` forwards it.
+    """
+
+    _pooled_leaf: ClassVar[str] = "W"
+
+    @property
+    def uses_pooled_init(self) -> bool:
+        return self.init == "pooled"
+
+    def pooled_init(self, key, prepared, X, y, n_outputs, *,
+                    row_mask=None, axis_name=None):
+        del prepared  # these learners have no other prepared state
+        w = (jnp.ones(X.shape[0], jnp.float32) if row_mask is None
+             else row_mask.astype(jnp.float32))
+        solver = type(self)(**{
+            **self.get_params(), "init": "zeros",
+            "max_iter": self.pooled_iter,
+        })
+        params0 = solver.init_params(key, X.shape[1], n_outputs)
+        params, _ = solver.fit(params0, X, y, w, key, axis_name=axis_name)
+        return params[self._pooled_leaf]
+
+    def gather_subspace(self, prepared, idx):
+        if prepared is None:
+            return None
+        # restrict the pooled solution to this replica's feature
+        # subspace; the bias (last row/element) rides along
+        return jnp.concatenate([prepared[idx], prepared[-1:]], axis=0)
+
+    def initial_params(self, key, n_features, n_outputs, prepared):
+        if self.init == "pooled" and prepared is not None:
+            return {self._pooled_leaf: prepared}
+        return self.init_params(key, n_features, n_outputs)
+
+    @staticmethod
+    def validate_init(init: str) -> str:
+        if init not in ("zeros", "pooled"):
+            raise ValueError(f"init must be zeros|pooled, got {init!r}")
+        return init

@@ -19,7 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from spark_bagging_tpu.models.base import BaseLearner, augment_bias
+from spark_bagging_tpu.models.base import (BaseLearner, PooledStartMixin,
+                                            augment_bias)
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _SOLVER_DAMPING = 1e-3
@@ -40,7 +41,7 @@ _DEFAULT_LINK = {
 }
 
 
-class GeneralizedLinearRegression(BaseLearner):
+class GeneralizedLinearRegression(PooledStartMixin, BaseLearner):
     """Exponential-family regression with a link function.
 
     Parameters follow Spark's vocabulary: ``family``, ``link``
@@ -52,6 +53,7 @@ class GeneralizedLinearRegression(BaseLearner):
 
     task = "regression"
     streamable = True
+    _pooled_leaf = "beta"
 
     def __init__(
         self,
@@ -61,6 +63,8 @@ class GeneralizedLinearRegression(BaseLearner):
         l2: float = 1e-6,
         max_iter: int = 8,
         precision: str = "highest",
+        init: str = "zeros",
+        pooled_iter: int = 5,
     ):
         if family not in _FAMILIES:
             raise ValueError(
@@ -87,6 +91,25 @@ class GeneralizedLinearRegression(BaseLearner):
         self.l2 = l2
         self.max_iter = max_iter
         self.precision = precision
+        # The pooled warm start's convexity precondition holds for each
+        # family's DEFAULT link (gaussian+identity, poisson/gamma/
+        # tweedie+log, binomial+logit — all verified convex in beta);
+        # a non-default combination like gaussian+log is non-convex, so
+        # the shared start could collapse ensemble diversity there.
+        # Ignored by fit_stream (no pooled pre-pass in the streaming
+        # engine) — in-memory fits only.
+        self.validate_init(init)
+        if init == "pooled" and link is not None \
+                and link != _DEFAULT_LINK[family]:
+            raise ValueError(
+                "init='pooled' requires the family's default link "
+                f"({_DEFAULT_LINK[family]!r} for {family!r}): the "
+                f"deviance under link={link!r} is not convex in beta, "
+                "so a shared warm start would collapse ensemble "
+                "diversity instead of preserving per-replica optima"
+            )
+        self.init = init
+        self.pooled_iter = pooled_iter
 
     # -- link/family machinery -----------------------------------------
 

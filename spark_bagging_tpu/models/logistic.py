@@ -44,6 +44,7 @@ from spark_bagging_tpu.models.base import (
     Aux,
     BaseLearner,
     Params,
+    PooledStartMixin,
     augment_bias,
 )
 from spark_bagging_tpu.ops.reduce import maybe_psum
@@ -57,7 +58,7 @@ _BIAS_JITTER = 1e-6  # keeps the softmax gauge direction solvable
 _SOLVER_DAMPING = 1e-3
 
 
-class LogisticRegression(BaseLearner):
+class LogisticRegression(PooledStartMixin, BaseLearner):
     """Weighted multinomial logistic regression with L2 penalty.
 
     Parameters mirror the reference base learner's capability [B:7]:
@@ -86,8 +87,7 @@ class LogisticRegression(BaseLearner):
         self.solver = solver
         self.lr = lr
         self.precision = precision
-        if init not in ("zeros", "pooled"):
-            raise ValueError(f"init must be zeros|pooled, got {init!r}")
+        self.validate_init(init)
         # init="pooled": solve the UNWEIGHTED pooled problem once per
         # ensemble (pooled_iter Newton steps, amortized over all
         # replicas) and start every replica's weighted fit from that
@@ -127,36 +127,7 @@ class LogisticRegression(BaseLearner):
         del key  # zero init: uniform probabilities, Newton's best start
         return {"W": jnp.zeros((n_features + 1, n_outputs), jnp.float32)}
 
-    # -- pooled warm start (init="pooled") ------------------------------
-
-    @property
-    def uses_pooled_init(self) -> bool:  # type: ignore[override]
-        return self.init == "pooled"
-
-    def pooled_init(self, key, prepared, X, y, n_outputs, *,
-                    row_mask=None, axis_name=None):
-        del prepared  # logistic has no other prepared state
-        w = (jnp.ones(X.shape[0], jnp.float32) if row_mask is None
-             else row_mask.astype(jnp.float32))
-        solver = type(self)(**{
-            **self.get_params(), "init": "zeros",
-            "max_iter": self.pooled_iter,
-        })
-        params0 = solver.init_params(key, X.shape[1], n_outputs)
-        params, _ = solver.fit(params0, X, y, w, key, axis_name=axis_name)
-        return params["W"]  # (d + 1, C), bias row last
-
-    def gather_subspace(self, prepared, idx):
-        if prepared is None:
-            return None
-        # restrict the pooled solution to this replica's feature
-        # subspace; the bias row rides along
-        return jnp.concatenate([prepared[idx], prepared[-1:]], axis=0)
-
-    def initial_params(self, key, n_features, n_outputs, prepared):
-        if self.init == "pooled" and prepared is not None:
-            return {"W": prepared}
-        return self.init_params(key, n_features, n_outputs)
+    # pooled warm start (init="pooled"): PooledStartMixin
 
     def flops_per_fit(self, n_rows, n_features, n_outputs):
         n, d, C = n_rows, n_features + 1, n_outputs

@@ -26,6 +26,7 @@ from spark_bagging_tpu.models.base import (
     Aux,
     BaseLearner,
     Params,
+    PooledStartMixin,
     augment_bias,
 )
 from spark_bagging_tpu.ops.reduce import maybe_psum
@@ -45,7 +46,7 @@ _STEPS = (1.0, 0.5, 0.25, 0.0)
 
 
 
-class LinearSVC(BaseLearner):
+class LinearSVC(PooledStartMixin, BaseLearner):
     """L2-regularized squared-hinge linear classifier (OVR).
 
     Parameters mirror the Spark/sklearn vocabulary: ``l2`` penalty
@@ -62,12 +63,20 @@ class LinearSVC(BaseLearner):
         l2: float = 1e-3,
         max_iter: int = 8,
         precision: str = "high",
+        init: str = "zeros",
+        pooled_iter: int = 5,
     ):
         if max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {max_iter}")
         self.l2 = l2
         self.max_iter = max_iter
         self.precision = precision
+        # squared-hinge OVR is convex, so the pooled warm start applies.
+        # Ignored by fit_stream (no pooled pre-pass in the streaming
+        # engine) — in-memory fits only.
+        self.validate_init(init)
+        self.init = init
+        self.pooled_iter = pooled_iter
 
     def init_params(self, key, n_features, n_outputs):
         del key  # deterministic zero start

@@ -86,6 +86,52 @@ class TestPooledInit:
         with pytest.raises(ValueError, match="init must be"):
             LogisticRegression(init="warm")
 
+    def test_glm_pooled_matches_cold_optimum(self):
+        """PooledStartMixin on IRLS: poisson/log deviance is convex in
+        beta, so both inits converge to the same fit."""
+        from spark_bagging_tpu import BaggingRegressor
+        from spark_bagging_tpu.models.glm import GeneralizedLinearRegression
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 6)).astype(np.float32)
+        beta = rng.normal(size=6).astype(np.float32) * 0.4
+        y = rng.poisson(np.exp(X @ beta)).astype(np.float32)
+
+        def reg(init, mi):
+            glm = GeneralizedLinearRegression(family="poisson",
+                                              max_iter=mi, init=init)
+            return BaggingRegressor(base_learner=glm, n_estimators=8,
+                                    seed=0).fit(X, y)
+        a, b = reg("zeros", 25), reg("pooled", 25)
+        np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=2e-3)
+        warm = reg("pooled", 2)
+        # 2 warm IRLS iters land within a few percent of converged
+        np.testing.assert_allclose(
+            warm.predict(X), a.predict(X), rtol=0.05
+        )
+
+    def test_glm_pooled_rejects_nonconvex_links(self):
+        from spark_bagging_tpu.models.glm import GeneralizedLinearRegression
+
+        with pytest.raises(ValueError, match="default link"):
+            GeneralizedLinearRegression(family="gaussian", link="log",
+                                        init="pooled")
+        # the default link spelled explicitly stays allowed
+        GeneralizedLinearRegression(family="poisson", link="log",
+                                    init="pooled")
+
+    def test_svc_pooled_matches_cold_accuracy(self, breast_cancer):
+        from spark_bagging_tpu.models.svm import LinearSVC
+
+        X, y = breast_cancer
+        def clf(init, mi):
+            svc = LinearSVC(max_iter=mi, init=init)
+            return BaggingClassifier(base_learner=svc, n_estimators=8,
+                                     seed=0).fit(X, y)
+        cold = clf("zeros", 8).score(X, y)
+        warm = clf("pooled", 2).score(X, y)
+        assert warm >= cold - 0.01
+
     def test_zeros_init_prepared_stays_none(self, breast_cancer):
         """init='zeros' must not pay the pooled solve: prepared state
         stays None through the engine."""
