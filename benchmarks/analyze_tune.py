@@ -20,17 +20,22 @@ if not ok:
     print(json.dumps({"error": "no successful cells", "cells": cells}))
     sys.exit(1)
 
-best_by_impl = {}
+def knobs(c):
+    return (c["impl"], c.get("max_iter", 3), c.get("init", "zeros"))
+
+
+best = {}
 for c in ok:
-    cur = best_by_impl.get(c["impl"])
+    cur = best.get(knobs(c))
     if cur is None or c["fps"] > cur["fps"]:
-        best_by_impl[c["impl"]] = c
+        best[knobs(c)] = c
 
 winner = max(ok, key=lambda c: c["fps"])
-print("| impl | best fps | chunk | row_tile | MFU | acc |")
-print("|---|---|---|---|---|---|")
-for impl, c in sorted(best_by_impl.items()):
-    print(f"| {impl} | {c['fps']} | {c.get('chunk_resolved', c['chunk'])} "
+print("| impl | init | iters | best fps | chunk | row_tile | MFU | acc |")
+print("|---|---|---|---|---|---|---|---|")
+for (impl, mi, init), c in sorted(best.items()):
+    print(f"| {impl} | {init} | {mi} | {c['fps']} "
+          f"| {c.get('chunk_resolved', c['chunk'])} "
           f"| {c['row_tile']} | {c.get('mfu')} | {c.get('acc')} |")
 print()
 print(json.dumps({
@@ -38,9 +43,12 @@ print(json.dumps({
     "recommendation": (
         f"hessian_impl='auto' at C=7/d=55 should resolve to "
         f"{winner['impl']!r} (chunk={winner.get('chunk_resolved', winner['chunk'])}, "
-        f"row_tile={winner['row_tile']}); update "
+        f"row_tile={winner['row_tile']}, "
+        f"max_iter={winner.get('max_iter', 3)}, "
+        f"init={winner.get('init', 'zeros')!r}); update "
         "models/logistic.py::_resolved_hessian with this measured point "
-        "and quote MFU in BASELINE.md"
+        "and quote MFU in BASELINE.md (bench.py already self-tunes from "
+        "the sweep winner)"
     ),
     "errors": [c for c in cells if c.get("error")],
 }, indent=1))
