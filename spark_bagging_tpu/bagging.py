@@ -915,6 +915,16 @@ class _BaseBagging(ParamsMixin):
         self._check_fitted()
         already_wrapped = isinstance(source, PrefetchChunks)
         source = as_chunk_source(source, chunk_rows)
+        # A stream-fitted aux-channel model (AFT censor column) must be
+        # able to score its own training source: drop the fitted aux
+        # column when the source still carries it, exactly as the fit
+        # and OOB passes do (split_aux_col's convention).
+        aux_col = getattr(self, "_stream_aux_col", None)
+        if (aux_col is not None and not already_wrapped
+                and source.n_features == self.n_features_in_ + 1):
+            from spark_bagging_tpu.utils.io import DropColumnChunks
+
+            source = DropColumnChunks(source, aux_col)
         if source.n_features != self.n_features_in_:
             raise ValueError(
                 f"source has {source.n_features} features; the ensemble "

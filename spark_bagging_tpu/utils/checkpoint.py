@@ -150,6 +150,11 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
             getattr(model, "_fit_weights_replayable", False)
         ),
         "identity_subspace": model._identity_subspace,
+        # what the fit's HBM-aware auto resolution picked — without it
+        # a loaded auto-chunked ensemble would vmap-all its predict/OOB
+        # maps into the OOM the resolution existed to avoid
+        "chunk_resolved": getattr(model, "_chunk_resolved", None),
+        "stream_aux_col": getattr(model, "_stream_aux_col", None),
         "fit_report_": model.fit_report_,
         "seed_key": np.asarray(
             jax.random.key_data(model._fit_key)
@@ -231,6 +236,10 @@ def load_model(path: str, *, mesh=None) -> Any:
         fitted.get("weights_replayable", fitted.get("fit_n_rows") is not None)
     )
     model._identity_subspace = fitted["identity_subspace"]
+    if fitted.get("chunk_resolved") is not None:
+        model._chunk_resolved = fitted["chunk_resolved"]
+    if fitted.get("stream_aux_col") is not None:
+        model._stream_aux_col = fitted["stream_aux_col"]
     model.fit_report_ = fitted["fit_report_"]
     model._fit_key = jax.random.wrap_key_data(
         jax.numpy.asarray(fitted["seed_key"], jax.numpy.uint32)

@@ -87,6 +87,29 @@ class ChunkSource:
             yield _pad_chunk(Xa, ya, self.chunk_rows)
 
 
+class DropColumnChunks(ChunkSource):
+    """View of another source with one column removed.
+
+    Lets a stream-fitted aux-channel model (AFT's censor column) run
+    its predict/score passes on the SAME wide source it was trained
+    on: the fit consumed ``aux_col`` via ``split_aux_col``, so scoring
+    must drop the identical column or the width check rejects the
+    model's own training source. Index normalization matches
+    ``split_aux_col`` (modulo the full source width).
+    """
+
+    def __init__(self, inner: ChunkSource, col: int):
+        self.inner = inner
+        self.col = col % inner.n_features
+        self.n_features = inner.n_features - 1
+        self.n_rows = inner.n_rows
+        self.chunk_rows = inner.chunk_rows
+
+    def _iter_raw(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        for X, y in self.inner._iter_raw():
+            yield np.delete(np.asarray(X, np.float32), self.col, axis=1), y
+
+
 class ArrayChunks(ChunkSource):
     """Chunk view over in-memory arrays (or np.memmap for on-disk)."""
 

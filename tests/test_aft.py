@@ -239,3 +239,25 @@ def test_aft_sample_weight_and_aux_coexist():
         n_estimators=4, seed=0,
     ).fit(X, y, sample_weight=sw, aux=delta)
     assert np.isfinite(reg.predict(X[:16])).all()
+
+
+def test_streamed_aft_scores_its_own_training_source():
+    """A stream-fitted AFT model must consume the SAME wide source it
+    was trained on: predict_stream/score_stream drop the fitted aux
+    column exactly as the fit and OOB passes do."""
+    X, y, delta = _weibull_data(n=1200, censor_frac=0.3, seed=5)
+    Xs = np.concatenate([X, delta[:, None]], axis=1)
+    reg = BaggingRegressor(
+        base_learner=AFTSurvivalRegression(),
+        n_estimators=3, seed=0,
+    ).fit_stream((Xs, y), chunk_rows=256, n_epochs=5, aux_col=-1)
+
+    preds = reg.predict_stream((Xs, y), chunk_rows=256)
+    assert preds.shape == (len(y),)
+    # matches predicting on the narrow matrix directly
+    np.testing.assert_allclose(preds, reg.predict(X), rtol=1e-5)
+    assert np.isfinite(reg.score_stream((Xs, y), chunk_rows=256))
+    # a narrow (already aux-free) source keeps working too
+    np.testing.assert_allclose(
+        reg.predict_stream((X, y), chunk_rows=256), preds, rtol=1e-5
+    )

@@ -137,3 +137,16 @@ def test_checkpoint_zstd_compression(tmp_path, iris):
     np.testing.assert_allclose(
         clf.predict_proba(X), loaded_raw.predict_proba(X), rtol=1e-6
     )
+
+
+def test_auto_chunk_resolution_survives_roundtrip(tmp_path, iris):
+    """An auto-chunked fit's resolved chunk must survive save/load, or
+    the loaded model's predict/OOB maps vmap all replicas at once —
+    the OOM the HBM-aware resolution exists to avoid."""
+    X, y = iris
+    clf = BaggingClassifier(n_estimators=8, seed=0).fit(X, y)
+    clf._chunk_resolved = 3  # as the fit's auto resolution would set
+    save_model(clf, str(tmp_path / "m"))
+    loaded = load_model(str(tmp_path / "m"))
+    assert loaded._eff_chunk() == 3
+    np.testing.assert_array_equal(loaded.predict(X), clf.predict(X))
