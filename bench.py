@@ -60,6 +60,21 @@ def probe_backend(timeout_s: float = 120.0, retries: int = 1,
     benchmark itself. ``reason`` distinguishes a timeout from a crash
     and carries the subprocess's stderr tail.
     """
+    # Don't probe over a measurement in flight: the probe's TPU client
+    # + matmul would perturb a flock-holding run's steady-state timings
+    # on the single chip. Wait for the lock (bounded), release, probe —
+    # a wedged holder past the deadline degrades to probing anyway
+    # rather than losing liveness detection.
+    if platform is None:
+        try:
+            sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+            from isolation import _acquire_device_lock
+
+            lock = _acquire_device_lock(deadline_s=timeout_s)
+            if lock is not None:
+                lock.close()
+        except Exception:  # noqa: BLE001 — lock is best-effort here
+            pass
     reason = "no probe attempt ran"
     for attempt in range(retries + 1):
         try:
@@ -167,8 +182,12 @@ def measure_cpu_baseline_parallel(X, y, l2: float) -> dict:
     ]
 
     def one(idx):
-        lr = SkLR(max_iter=100, C=1.0 / (l2 * len(idx))).fit(X[idx], y[idx])
-        return lr.score(X, y)
+        # fit ONLY inside the timed window — the serial baseline times
+        # fits and scores outside it, and the two must stay
+        # workload-matched or vs_baseline_parallel is biased; the
+        # fitted model returns to the parent (small: coef_ + intercept_)
+        # and scoring happens after the clock stops
+        return SkLR(max_iter=100, C=1.0 / (l2 * len(idx))).fit(X[idx], y[idx])
 
     # warm the worker pool before the timed window: loky process spawn
     # (~1s+) must not be billed as baseline fit time — that would
@@ -176,8 +195,9 @@ def measure_cpu_baseline_parallel(X, y, l2: float) -> dict:
     pool = Parallel(n_jobs=-1)
     pool(delayed(int)(i) for i in range(n_cores))
     t0 = time.perf_counter()
-    accs = pool(delayed(one)(idx) for idx in idxs)
+    models = pool(delayed(one)(idx) for idx in idxs)
     wall = time.perf_counter() - t0
+    accs = [m.score(X, y) for m in models]
     return {
         "seconds_per_fit": wall / n_fits,
         "fits_per_sec": n_fits / wall,

@@ -9,15 +9,42 @@ import json
 import os
 import sys
 
-path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                    "tune_headline.json")
+BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, BENCH_DIR)
+sys.path.insert(0, os.path.dirname(BENCH_DIR))
+from headline_data import DATASET_VERSION, WORKLOAD  # noqa: E402
+
+path = os.path.join(BENCH_DIR, "tune_headline.json")
 if not os.path.exists(path):
     print("no tune_headline.json yet — sweep has not run on-chip")
     sys.exit(1)
 cells = json.load(open(path))
-ok = [c for c in cells if c.get("fps")]
+
+# same filters bench.py's load_sweep_winner applies — a recommendation
+# must never select a cell the headline bench itself would reject:
+# current workload stamp, and accuracy over the parity bar (cached CPU
+# baseline accuracy − 0.01) when the baseline has been measured
+min_acc = None
+try:
+    import hashlib
+
+    cache = json.load(open(os.path.join(os.path.dirname(BENCH_DIR),
+                                        "bench_baseline_cache.json")))
+    key = hashlib.sha1(json.dumps(
+        [DATASET_VERSION, WORKLOAD["n_rows"], WORKLOAD["l2"]],
+        sort_keys=True).encode()).hexdigest()[:12]
+    min_acc = cache[key]["accuracy"] - 0.01
+except Exception:  # noqa: BLE001 — no cached baseline: skip the bar
+    print("(no cached CPU baseline — accuracy-parity filter skipped)")
+
+ok = [
+    c for c in cells
+    if c.get("fps") and c.get("workload") == WORKLOAD
+    and (min_acc is None or (c.get("acc") or 0.0) >= min_acc)
+]
 if not ok:
-    print(json.dumps({"error": "no successful cells", "cells": cells}))
+    print(json.dumps({"error": "no successful current-workload cells "
+                               "over the parity bar", "cells": cells}))
     sys.exit(1)
 
 def knobs(c):

@@ -19,7 +19,9 @@ if REPO not in sys.path:
 HEADLINE = dict(n_rows=581_012, n_replicas=1000, l2=1e-3, max_iter=3,
                 init="zeros", precision="high")
 
-DATASET_VERSION = "covtype_synth_v3"
+from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
+
+DATASET_VERSION = f"covtype_synth_{SYNTHETICS_VERSION}"
 
 # stamped into every sweep cell and compared by bench.py's
 # load_sweep_winner: a stale tune_headline.json captured under older

@@ -61,7 +61,15 @@ def _acquire_device_lock(deadline_s: float):
 
 
 def run_isolated_child(cmd: list, timeout_s: float, result_prefix: str):
-    """Returns ``(result_dict, None)`` or ``(None, error_str)``."""
+    """Returns ``(result_dict, None)`` or ``(None, error_str)``.
+
+    ``timeout_s`` is the TOTAL budget: lock wait and child run share
+    it, so the caller's outer bound (the watcher's stage ``timeout``)
+    stays meaningful even when another process holds the chip. A
+    contended lock that leaves too little budget returns an error
+    rather than starting a child doomed to be killed mid-measure.
+    """
+    start = time.monotonic()
     lock = _acquire_device_lock(deadline_s=timeout_s)
     if lock is None:
         return None, (
@@ -69,7 +77,13 @@ def run_isolated_child(cmd: list, timeout_s: float, result_prefix: str):
             "benchmark process holds the TPU"
         )
     try:
-        return _run_child_locked(cmd, timeout_s, result_prefix)
+        remaining = timeout_s - (time.monotonic() - start)
+        if remaining < 60.0:
+            return None, (
+                f"device lock left only {remaining:.0f}s of the "
+                f"{timeout_s:.0f}s budget — retry next window"
+            )
+        return _run_child_locked(cmd, remaining, result_prefix)
     finally:
         lock.close()
 

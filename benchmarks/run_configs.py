@@ -508,6 +508,8 @@ def main() -> None:
     if args.one_config is not None:
         import jax
 
+        from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
+
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
         t0 = time.perf_counter()
@@ -515,6 +517,10 @@ def main() -> None:
             res = CONFIGS[args.one_config](args.scale)
             res["wall_seconds"] = round(time.perf_counter() - t0, 2)
             res["backend"] = jax.default_backend()
+            # rows captured under an older synthetic generator must not
+            # resume or settle a capture stage (the sweep's workload-
+            # stamp rule, applied to config rows)
+            res["datasets_version"] = SYNTHETICS_VERSION
         except Exception as e:  # noqa: BLE001 — concise '<Type>: <msg>'
             # beats a truncated traceback tail in the failure log
             res = {"config": args.one_config,
@@ -544,14 +550,20 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)),
         f"results_{args.scale}.json",
     )
+    from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
+
     prior: dict[int, dict] = {}
     if args.resume and os.path.exists(out):
         try:
             with open(out) as f:
                 for r in json.load(f).get("results", []):
-                    # only real-accelerator results carry over — a
-                    # CPU-fallback row must be re-measured
-                    if r.get("backend") == "tpu":
+                    # only real-accelerator results measured on the
+                    # CURRENT synthetic generator carry over — a
+                    # CPU-fallback or stale-generator row must be
+                    # re-measured
+                    if (r.get("backend") == "tpu"
+                            and r.get("datasets_version")
+                            == SYNTHETICS_VERSION):
                         prior[r["config"]] = r
         except Exception:  # noqa: BLE001 — corrupt file: start fresh
             pass
@@ -573,10 +585,16 @@ def main() -> None:
         else:
             print(json.dumps(res))
             results.append(res)
-        # incremental persist: every completed config survives a crash
+        # incremental persist: every completed config survives a crash,
+        # INCLUDING prior-window rows the loop has not reached yet — a
+        # kill mid-suite must not lose cross-window progress (the
+        # sweep's `rest` rule, applied to config rows)
+        emitted = {r["config"] for r in results}
+        rest = [r for c2, r in sorted(prior.items())
+                if c2 not in emitted]
         with open(out, "w") as f:
             json.dump(
-                {"scale": args.scale, "results": results,
+                {"scale": args.scale, "results": results + rest,
                  "failures": failures},
                 f, indent=2,
             )

@@ -45,9 +45,14 @@ EOF
 smoke_done() {
   python - <<'EOF' 2>/dev/null
 import json, sys
+sys.path.insert(0, ".")
+from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
 d = json.load(open("benchmarks/results_smoke.json"))
 rs = d.get("results", [])
-ok = len(rs) >= 7 and all(r.get("backend") == "tpu" for r in rs)
+# CPU-fallback or stale-generator rows must not settle the stage
+ok = len(rs) >= 7 and all(
+    r.get("backend") == "tpu"
+    and r.get("datasets_version") == SYNTHETICS_VERSION for r in rs)
 sys.exit(0 if ok else 1)
 EOF
 }
@@ -55,10 +60,14 @@ EOF
 full_done() {
   python - <<'EOF' 2>/dev/null
 import json, sys
+sys.path.insert(0, ".")
+from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
 d = json.load(open("benchmarks/results_full.json"))
 rs = d.get("results", [])
-# CPU-fallback runs must not count as captured (same rule as smoke)
-ok = len(rs) >= 7 and all(r.get("backend") == "tpu" for r in rs)
+# CPU-fallback or stale-generator rows must not settle the stage
+ok = len(rs) >= 7 and all(
+    r.get("backend") == "tpu"
+    and r.get("datasets_version") == SYNTHETICS_VERSION for r in rs)
 sys.exit(0 if ok else 1)
 EOF
 }

@@ -22,6 +22,13 @@ from typing import Callable
 
 import numpy as np
 
+# Bump when ANY synthetic generator's distribution changes (v2→v3
+# recalibrated covtype for tree-recoverable structure, 2026-07-30).
+# Benchmark rows are stamped with this so results captured under an
+# older generator can't resume, settle a capture stage, or be compared
+# against newer quality proxies.
+SYNTHETICS_VERSION = "v3"
+
 # ---------------------------------------------------------------------
 # File parsers
 # ---------------------------------------------------------------------

@@ -137,6 +137,70 @@ f.close()
             holder.wait(timeout=30)
 
 
+class TestConfigResumePersist:
+    def test_prior_rows_survive_a_partial_run(self, tmp_path):
+        """Cross-window accumulation: prior TPU rows for configs the
+        current run has not (re)measured must survive every incremental
+        rewrite — a kill mid-suite must not lose captured progress."""
+        import subprocess
+
+        from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
+
+        out = tmp_path / "results.json"
+        prior = {
+            "scale": "smoke",
+            "results": [
+                {"config": c, "name": f"cfg{c}", "metric": "accuracy",
+                 "value": 0.9, "fits_per_sec": 1.0, "wall_seconds": 1.0,
+                 "backend": "tpu",
+                 "datasets_version": SYNTHETICS_VERSION}
+                for c in (6, 7)
+            ],
+            "failures": [],
+        }
+        out.write_text(json.dumps(prior))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "run_configs.py"),
+             "--configs", "1", "--platform", "cpu", "--resume",
+             "--json-out", str(out)],
+            capture_output=True, text=True, timeout=500, cwd=REPO,
+        )
+        data = json.loads(out.read_text())
+        configs = {r["config"] for r in data["results"]}
+        assert {1, 6, 7} <= configs, (configs, proc.stderr[-500:])
+        # the cpu row must NOT poison future resumes
+        row1 = next(r for r in data["results"] if r["config"] == 1)
+        assert row1["backend"] == "cpu"
+
+    def test_stale_generator_rows_do_not_resume(self, tmp_path):
+        import subprocess
+
+        out = tmp_path / "results.json"
+        out.write_text(json.dumps({
+            "scale": "smoke",
+            "results": [{"config": 1, "name": "cfg1",
+                         "metric": "accuracy", "value": 0.9,
+                         "fits_per_sec": 1.0, "wall_seconds": 1.0,
+                         "backend": "tpu",
+                         "datasets_version": "v0-stale"}],
+            "failures": [],
+        }))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "run_configs.py"),
+             "--configs", "1", "--platform", "cpu", "--resume",
+             "--json-out", str(out)],
+            capture_output=True, text=True, timeout=500, cwd=REPO,
+        )
+        # the stale row was re-measured (backend flips to cpu here),
+        # not resumed
+        assert '"resumed": true' not in proc.stderr.lower()
+        data = json.loads(out.read_text())
+        row1 = next(r for r in data["results"] if r["config"] == 1)
+        assert row1["backend"] == "cpu"
+
+
 class TestCellChild:
     def test_bad_impl_reports_error_not_crash(self):
         import subprocess
