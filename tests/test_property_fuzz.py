@@ -32,7 +32,9 @@ KEY = jax.random.key(42)
 
 CLASSIFIERS = [
     LogisticRegression(max_iter=4),
+    LogisticRegression(max_iter=1, init="pooled"),
     LinearSVC(max_iter=4),
+    LinearSVC(max_iter=2, init="pooled"),
     DecisionTreeClassifier(max_depth=3, n_bins=8),
     MLPClassifier(hidden=8, max_iter=30),
     GaussianNB(),
@@ -48,6 +50,8 @@ REGRESSORS = [
     LinearRegression(),
     GeneralizedLinearRegression(family="gaussian"),
     GeneralizedLinearRegression(family="poisson", max_iter=5),
+    GeneralizedLinearRegression(family="poisson", max_iter=2,
+                                init="pooled"),
     DecisionTreeRegressor(max_depth=3, n_bins=8),
     IsotonicRegression(n_bins=16),
     MLPRegressor(hidden=8, max_iter=30),
