@@ -29,7 +29,6 @@ re-measure.
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import os
 import subprocess
@@ -40,7 +39,16 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 CACHE_PATH = os.path.join(REPO, "bench_baseline_cache.json")
+
+
+def _sk_lr(l2: float, n_rows: int):
+    """The sklearn stand-in at matched regularization — one definition
+    for the serial/parallel/predict baselines so they can't drift."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    return SkLR(max_iter=100, C=1.0 / (l2 * n_rows))
 
 def _probe_code(platform: str | None) -> str:
     force = (
@@ -67,7 +75,6 @@ def probe_backend(timeout_s: float = 120.0, retries: int = 1,
     # rather than losing liveness detection.
     if platform is None:
         try:
-            sys.path.insert(0, os.path.join(REPO, "benchmarks"))
             from isolation import _acquire_device_lock
 
             lock = _acquire_device_lock(deadline_s=timeout_s)
@@ -133,8 +140,6 @@ def measure_cpu_baseline(X, y, l2: float, n_fits: int = 5,
                          budget_s: float = 180.0) -> dict:
     """sklearn CPU proxy: seconds per base-learner fit (mean over up to
     n_fits bootstrap fits, stopping early past the time budget)."""
-    from sklearn.linear_model import LogisticRegression as SkLR
-
     rng = np.random.default_rng(0)
     times, accs = [], []
     t_start = time.perf_counter()
@@ -143,7 +148,7 @@ def measure_cpu_baseline(X, y, l2: float, n_fits: int = 5,
         w = rng.poisson(1.0, len(y))
         idx = np.repeat(np.arange(len(y)), w)
         t0 = time.perf_counter()
-        lr = SkLR(max_iter=100, C=1.0 / (l2 * len(idx))).fit(X[idx], y[idx])
+        lr = _sk_lr(l2, len(idx)).fit(X[idx], y[idx])
         times.append(time.perf_counter() - t0)
         accs.append(lr.score(X, y))
         if time.perf_counter() - t_start > budget_s and len(times) >= 2:
@@ -165,9 +170,7 @@ def measure_cpu_predict_baseline(X, y, l2: float) -> dict:
     UDF loop to beat that)."""
     import time as _time
 
-    from sklearn.linear_model import LogisticRegression as SkLR
-
-    lr = SkLR(max_iter=100, C=1.0 / (l2 * len(y))).fit(X, y)
+    lr = _sk_lr(l2, len(y)).fit(X, y)
     n = min(100_000, len(y))
     lr.predict_proba(X[:n])  # warm (BLAS paging)
     t0 = _time.perf_counter()
@@ -190,7 +193,6 @@ def measure_cpu_baseline_parallel(X, y, l2: float) -> dict:
     import os as _os
 
     from joblib import Parallel, delayed
-    from sklearn.linear_model import LogisticRegression as SkLR
 
     n_cores = _os.cpu_count() or 1
     n_fits = max(4, min(32, 2 * n_cores))
@@ -206,7 +208,7 @@ def measure_cpu_baseline_parallel(X, y, l2: float) -> dict:
         # workload-matched or vs_baseline_parallel is biased; the
         # fitted model returns to the parent (small: coef_ + intercept_)
         # and scoring happens after the clock stops
-        return SkLR(max_iter=100, C=1.0 / (l2 * len(idx))).fit(X[idx], y[idx])
+        return _sk_lr(l2, len(idx)).fit(X[idx], y[idx])
 
     # warm the worker pool before the timed window: loky process spawn
     # (~1s+) must not be billed as baseline fit time — that would
@@ -347,7 +349,6 @@ def main() -> None:
     args = p.parse_args()
 
     metric = "fits_per_sec_logreg_bag1000_covtype581k"
-    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 
     if args.measure_only:
         try:
@@ -362,13 +363,9 @@ def main() -> None:
     if backend is None:
         fail(metric, f"jax backend unavailable after 2 attempts — {reason}")
 
-    from headline_data import DATASET_VERSION, HEADLINE, WORKLOAD
+    from headline_data import HEADLINE, WORKLOAD, baseline_cache_key
 
-    config_key = hashlib.sha1(
-        json.dumps(
-            [DATASET_VERSION, args.n_rows, args.l2], sort_keys=True
-        ).encode()
-    ).hexdigest()[:12]
+    config_key = baseline_cache_key(args.n_rows, args.l2)
     cache = {}
     if os.path.exists(CACHE_PATH):
         with open(CACHE_PATH) as f:

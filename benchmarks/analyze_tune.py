@@ -12,7 +12,7 @@ import sys
 BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, BENCH_DIR)
 sys.path.insert(0, os.path.dirname(BENCH_DIR))
-from headline_data import DATASET_VERSION, WORKLOAD  # noqa: E402
+from headline_data import WORKLOAD, baseline_cache_key  # noqa: E402
 
 path = os.path.join(BENCH_DIR, "tune_headline.json")
 if not os.path.exists(path):
@@ -26,14 +26,9 @@ cells = json.load(open(path))
 # baseline accuracy − 0.01) when the baseline has been measured
 min_acc = None
 try:
-    import hashlib
-
     cache = json.load(open(os.path.join(os.path.dirname(BENCH_DIR),
                                         "bench_baseline_cache.json")))
-    key = hashlib.sha1(json.dumps(
-        [DATASET_VERSION, WORKLOAD["n_rows"], WORKLOAD["l2"]],
-        sort_keys=True).encode()).hexdigest()[:12]
-    min_acc = cache[key]["accuracy"] - 0.01
+    min_acc = cache[baseline_cache_key()]["accuracy"] - 0.01
 except Exception:  # noqa: BLE001 — no cached baseline: skip the bar
     print("(no cached CPU baseline — accuracy-parity filter skipped)")
 

@@ -35,6 +35,18 @@ WORKLOAD = dict(dataset=DATASET_VERSION, n_rows=HEADLINE["n_rows"],
                 precision=HEADLINE["precision"])
 
 
+def baseline_cache_key(n_rows: int = HEADLINE["n_rows"],
+                       l2: float = HEADLINE["l2"]) -> str:
+    """Key into bench_baseline_cache.json — ONE definition, shared by
+    bench.py and analyze_tune.py so their parity bars can't diverge."""
+    import hashlib
+    import json
+
+    return hashlib.sha1(
+        json.dumps([DATASET_VERSION, n_rows, l2], sort_keys=True).encode()
+    ).hexdigest()[:12]
+
+
 def load_headline_data(n_rows: int = HEADLINE["n_rows"]):
     import numpy as np
 
