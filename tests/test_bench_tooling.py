@@ -106,6 +106,40 @@ class TestSweepOrdering:
         assert th.cell_key(old) == ("blocked", 200, None, 3, "zeros")
 
 
+class TestAnalyzeTune:
+    def test_stale_and_parity_failing_cells_cannot_win(self, tmp_path):
+        """The analyzer's recommendation must apply the same filters as
+        bench.py's winner selection: stale workload stamps and cells
+        under the parity bar are excluded even when fastest."""
+        import shutil
+        import subprocess
+
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        for f in ("analyze_tune.py", "headline_data.py"):
+            shutil.copy(os.path.join(REPO, "benchmarks", f), bdir / f)
+        (tmp_path / "spark_bagging_tpu").symlink_to(
+            os.path.join(REPO, "spark_bagging_tpu"))
+        (bdir / "tune_headline.json").write_text(json.dumps([
+            _cell(chunk=200, fps=100.0, acc=0.77),
+            _cell(chunk=300, fps=900.0, acc=0.77,
+                  workload=dict(WORKLOAD, dataset="stale")),
+            _cell(chunk=400, fps=800.0, acc=0.40),  # under the bar
+        ]))
+        key = __import__("headline_data").baseline_cache_key()
+        (tmp_path / "bench_baseline_cache.json").write_text(json.dumps({
+            key: {"accuracy": 0.765}
+        }))
+        proc = subprocess.run(
+            [sys.executable, str(bdir / "analyze_tune.py")],
+            capture_output=True, text=True, timeout=120, cwd=tmp_path,
+        )
+        assert proc.returncode == 0, proc.stderr[-400:]
+        winner = json.loads(
+            proc.stdout[proc.stdout.index("{"):])["winner"]
+        assert (winner["chunk"], winner["fps"]) == (200, 100.0)
+
+
 class TestDeviceLock:
     def test_serializes_across_processes(self, tmp_path, monkeypatch):
         """Two benchmark parents must not drive the chip concurrently:

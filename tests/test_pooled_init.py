@@ -77,6 +77,19 @@ class TestPooledInit:
         clf = _clf("pooled", 1, oob_score=True).fit(X, y)
         assert clf.oob_score_ > 0.9
 
+    def test_warm_start_grows_pooled_ensembles(self, breast_cancer):
+        """bagging-level warm_start adds replicas; the pooled solve is
+        re-derived deterministically, so grown ensembles keep working."""
+        X, y = breast_cancer
+        lr = LogisticRegression(l2=1e-3, max_iter=1, precision="high",
+                                init="pooled")
+        clf = BaggingClassifier(base_learner=lr, n_estimators=8, seed=0,
+                                warm_start=True).fit(X, y)
+        clf.n_estimators = 16
+        clf.fit(X, y)
+        assert clf.n_estimators_ == 16
+        assert clf.score(X, y) > 0.95
+
     def test_params_roundtrip_and_validation(self):
         lr = LogisticRegression(init="pooled", pooled_iter=7)
         p = lr.get_params()
