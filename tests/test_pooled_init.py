@@ -77,6 +77,26 @@ class TestPooledInit:
         clf = _clf("pooled", 1, oob_score=True).fit(X, y)
         assert clf.oob_score_ > 0.9
 
+    @pytest.mark.parametrize("impl,row_tile", [
+        ("packed", 128), ("pallas", None),
+    ])
+    def test_pooled_under_every_hessian_impl(self, breast_cancer, impl,
+                                             row_tile):
+        """The sweep grid pairs pooled init with every Hessian ladder
+        rung; each must reproduce the blocked+pooled predictions
+        (pallas runs in interpreter mode off-TPU)."""
+        X, y = breast_cancer
+        def clf(impl, rt):
+            lr = LogisticRegression(l2=1e-3, max_iter=1, init="pooled",
+                                    precision="high", hessian_impl=impl,
+                                    row_tile=rt)
+            return BaggingClassifier(base_learner=lr, n_estimators=8,
+                                     seed=0).fit(X, y)
+        np.testing.assert_allclose(
+            clf(impl, row_tile).predict_proba(X),
+            clf("blocked", None).predict_proba(X), atol=2e-3,
+        )
+
     def test_warm_start_grows_pooled_ensembles(self, breast_cancer):
         """bagging-level warm_start adds replicas; the pooled solve is
         re-derived deterministically, so grown ensembles keep working."""
