@@ -86,6 +86,20 @@ def main() -> None:
     ).fit(X, np.minimum(T, cutoff), aux=(T <= cutoff).astype(np.float32))
     aft_pred_head = np.asarray(aft.predict(X[:16])).tolist()
 
+    # pooled warm start across processes: the shared pooled solve's row
+    # stats psum over the process-spanning data axis; every process
+    # must derive the SAME pooled start or replica fits diverge
+    from spark_bagging_tpu import LogisticRegression
+
+    pooled = BaggingClassifier(
+        base_learner=LogisticRegression(
+            l2=1e-3, max_iter=1, init="pooled", precision="high"
+        ),
+        n_estimators=8, seed=1, mesh=mesh,
+    ).fit(X, y)
+    pooled_pred_head = np.asarray(pooled.predict_proba(X[:16])).tolist()
+    pooled_acc = float(pooled.score(X, y))
+
     with open(f"{out_path}.{pid}", "w") as f:
         json.dump({
             "process_id": pid,
@@ -97,6 +111,8 @@ def main() -> None:
             "stream_accuracy": stream_acc,
             "rf_accuracy": rf_acc,
             "aft_pred_head": aft_pred_head,
+            "pooled_pred_head": pooled_pred_head,
+            "pooled_accuracy": pooled_acc,
         }, f)
 
 

@@ -132,3 +132,18 @@ def test_multihost_aft_aux_channel(worker_results):
         a["aft_pred_head"], b["aft_pred_head"], rtol=1e-6
     )
     assert (np.asarray(a["aft_pred_head"]) > 0).all()
+
+
+def test_multihost_pooled_warm_start(worker_results):
+    """The pooled warm start's shared solve psums row stats across the
+    process-spanning data axis: both processes derive identical pooled
+    starts (hence identical ensembles), and 1 refinement iteration
+    trains to quality."""
+    a, b = worker_results
+    np.testing.assert_allclose(
+        a["pooled_pred_head"], b["pooled_pred_head"], rtol=1e-6
+    )
+    assert a["pooled_accuracy"] == pytest.approx(
+        b["pooled_accuracy"], abs=1e-6
+    )
+    assert a["pooled_accuracy"] > 0.95
