@@ -156,6 +156,23 @@ def _jitted_predict_reg(learner, n_total, chunk_size, identity_subspace):
 
 
 @functools.lru_cache(maxsize=256)
+def _jitted_predict_quantiles(learner, probs, chunk_size,
+                              identity_subspace):
+    from spark_bagging_tpu.ensemble import map_replicas
+
+    def agg(params, subspaces, X):
+        def one(args):
+            p, idx = args
+            Xs = X if identity_subspace else X[:, idx]
+            return learner.predict_quantiles(p, Xs, probs)
+
+        q = map_replicas(one, (params, subspaces), chunk_size)
+        return q.mean(axis=0)
+
+    return jax.jit(agg)
+
+
+@functools.lru_cache(maxsize=256)
 def _jitted_oob(learner, n_replicas, ratio, replacement, n_classes, chunk_size,
                 identity_subspace):
     return jax.jit(
@@ -246,6 +263,14 @@ class _BaseBagging(ParamsMixin):
         self.chunk_size = chunk_size
         self.mesh = mesh
         self.warm_start = warm_start
+
+    def _mesh_layout(self):
+        """The mesh-shape signature that parameterizes per-shard weight
+        streams (None = unmeshed); snapshotted at fit time and required
+        unchanged by warm_start."""
+        if self.mesh is None:
+            return None
+        return tuple(sorted(self.mesh.shape.items()))
 
     def _eff_chunk(self) -> int | None:
         """The replica-map chunk for predict/OOB: the user's explicit
@@ -470,6 +495,14 @@ class _BaseBagging(ParamsMixin):
                 "warm_start requires unchanged max_features/"
                 "bootstrap_features"
             )
+        if self._mesh_layout() != getattr(self, "_fit_mesh_layout", None):
+            raise ValueError(
+                "warm_start requires the original mesh layout: "
+                "data-sharded replicas draw per-shard weight streams "
+                "(fold_in(key, shard)), so a changed mesh would splice "
+                "replicas from different stream families and silently "
+                "corrupt OOB replay"
+            )
         return self.n_estimators_
 
     def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int,
@@ -635,6 +668,7 @@ class _BaseBagging(ParamsMixin):
         self._fit_sampling = (ratio, bool(self.bootstrap))
         self._fit_subspace_cfg = (n_subspace, bool(self.bootstrap_features))
         self._fit_n_rows = int(X.shape[0])
+        self._fit_mesh_layout = self._mesh_layout()
         # replica_weights can only replay draws made from ONE global
         # key stream; a data-sharded fit folds the shard index into
         # each draw (mesh-layout-dependent). Snapshotted at fit time —
@@ -918,13 +952,22 @@ class _BaseBagging(ParamsMixin):
         # A stream-fitted aux-channel model (AFT censor column) must be
         # able to score its own training source: drop the fitted aux
         # column when the source still carries it, exactly as the fit
-        # and OOB passes do (split_aux_col's convention).
+        # and OOB passes do (split_aux_col's convention). An explicitly
+        # prefetch-wrapped source gets the drop spliced INSIDE the wrap
+        # (keeping its configured depth) — the contract must not depend
+        # on whether the caller wrapped first.
         aux_col = getattr(self, "_stream_aux_col", None)
-        if (aux_col is not None and not already_wrapped
+        if (aux_col is not None
                 and source.n_features == self.n_features_in_ + 1):
             from spark_bagging_tpu.utils.io import DropColumnChunks
 
-            source = DropColumnChunks(source, aux_col)
+            if already_wrapped:
+                source = PrefetchChunks(
+                    DropColumnChunks(source._inner, aux_col),
+                    depth=source._depth,
+                )
+            else:
+                source = DropColumnChunks(source, aux_col)
         if source.n_features != self.n_features_in_:
             raise ValueError(
                 f"source has {source.n_features} features; the ensemble "
@@ -1028,6 +1071,10 @@ class BaggingClassifier(_BaseBagging):
         counts; OOB membership stays weight-independent."""
         X = self._validate_X(X)
         y = np.asarray(y)
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y[:, 0]  # column-vector labels, as the regressor accepts
+        if y.ndim != 1:
+            raise ValueError(f"y must be 1-D, got shape {y.shape}")
         if y.shape[0] != X.shape[0]:
             raise ValueError("X and y row counts differ")
         classes, y_enc = np.unique(y, return_inverse=True)
@@ -1368,22 +1415,13 @@ class BaggingRegressor(_BaseBagging):
                 "predict_quantiles is single-device; gather the model "
                 "(load without mesh) first"
             )
-        from spark_bagging_tpu.ensemble import map_replicas
-
         X = self._validate_X(X, fitted=True)
         probs = tuple(float(p) for p in probs)
-        identity = self._identity_subspace
-
-        @jax.jit
-        def agg(params, subspaces, X):
-            def one(args):
-                p, idx = args
-                Xs = X if identity else X[:, idx]
-                return learner.predict_quantiles(p, Xs, probs)
-
-            q = map_replicas(one, (params, subspaces), self._eff_chunk())
-            return q.mean(axis=0)
-
+        # lru-cached jit: repeated calls (per-chunk survival curves)
+        # must not re-trace the R-replica program every time
+        agg = _jitted_predict_quantiles(
+            learner, probs, self._eff_chunk(), self._identity_subspace
+        )
         return np.asarray(agg(self.ensemble_, self.subspaces_, X))
 
     def predict_stream(self, source, chunk_rows=None, *,

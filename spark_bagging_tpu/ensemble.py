@@ -45,17 +45,6 @@ from spark_bagging_tpu.utils.debug import check_bootstrap_weights
 from spark_bagging_tpu.utils.profiling import named_scope
 
 
-def _map_replicas(fn, replica_ids: jax.Array, chunk_size: int | None):
-    """vmap when chunk_size is None, else chunked lax.map.
-
-    Chunked mapping is scan-of-vmap: full MXU utilization inside a
-    chunk, bounded peak memory across chunks.
-    """
-    if chunk_size is None:
-        return jax.vmap(fn)(replica_ids)
-    return jax.lax.map(fn, replica_ids, batch_size=chunk_size)
-
-
 def fit_ensemble(
     learner: BaseLearner,
     X: jax.Array,
@@ -151,7 +140,7 @@ def fit_ensemble(
             )
         return params, idx, fit_aux["loss"]
 
-    params, subspaces, losses = _map_replicas(fit_one, replica_ids, chunk_size)
+    params, subspaces, losses = map_replicas(fit_one, replica_ids, chunk_size)
     return params, subspaces, {"loss": losses}
 
 

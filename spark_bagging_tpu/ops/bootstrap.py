@@ -147,11 +147,12 @@ def bootstrap_weights_one(
             counts = jax.random.poisson(k, ratio, (n_rows,))
         return jnp.minimum(counts, _MAX_COUNT).astype(dtype)
 
+    if ratio <= 0:  # before the m computation — m=max(1,·) could
+        # otherwise mask a nonsensical ratio as a full-weight sample
+        raise ValueError(f"ratio={ratio} must be positive")
     m = max(1, int(round(ratio * n_rows)))
     if m >= n_rows:
         return jnp.ones((n_rows,), dtype)
-    if ratio <= 0:
-        raise ValueError(f"ratio={ratio} must be positive")
     u = jax.random.uniform(k, (n_rows,))
     # The m-th smallest u is the inclusion threshold; ties have
     # probability ~0 in float32 for practical n.

@@ -261,3 +261,12 @@ def test_streamed_aft_scores_its_own_training_source():
     np.testing.assert_allclose(
         reg.predict_stream((X, y), chunk_rows=256), preds, rtol=1e-5
     )
+    # the contract must not depend on whether the caller prefetch-
+    # wrapped first: the aux drop splices inside the wrap
+    from spark_bagging_tpu import ArrayChunks
+    from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+
+    wrapped = PrefetchChunks(ArrayChunks(Xs, y, chunk_rows=256), depth=3)
+    np.testing.assert_allclose(
+        reg.predict_stream(wrapped), preds, rtol=1e-5
+    )

@@ -660,3 +660,63 @@ def test_repr_elides_defaults():
     r2 = repr(RandomForestClassifier(n_estimators=32, criterion="entropy"))
     assert "n_estimators=32" in r2 and "criterion='entropy'" in r2
     assert "max_depth" not in r2  # default elided
+
+
+class TestLibraryAuditFixes:
+    """Regression tests for the round-3 core-library audit findings."""
+
+    def test_classifier_column_vector_y(self, breast_cancer):
+        X, y = breast_cancer
+        a = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+        b = BaggingClassifier(n_estimators=4, seed=0).fit(
+            X, y.reshape(-1, 1)
+        )
+        np.testing.assert_array_equal(a.predict(X), b.predict(X))
+        with pytest.raises(ValueError, match="1-D"):
+            BaggingClassifier(n_estimators=2).fit(
+                X, np.stack([y, y], axis=1)
+            )
+
+    def test_warm_start_rejects_mesh_layout_change(self, breast_cancer):
+        from spark_bagging_tpu.parallel import make_mesh
+
+        X, y = breast_cancer
+        clf = BaggingClassifier(
+            n_estimators=4, seed=0, warm_start=True,
+            mesh=make_mesh(data=2),
+        ).fit(X, y)
+        clf.mesh = None
+        clf.n_estimators = 8
+        with pytest.raises(ValueError, match="mesh layout"):
+            clf.fit(X, y)
+
+    def test_without_replacement_rejects_bad_ratio_even_tiny_n(self):
+        from spark_bagging_tpu.ops.bootstrap import bootstrap_weights_one
+
+        import jax
+
+        with pytest.raises(ValueError, match="positive"):
+            bootstrap_weights_one(
+                jax.random.key(0), 0, n_rows=1, ratio=0.0,
+                replacement=False,
+            )
+
+    def test_predict_quantiles_jit_is_cached(self):
+        from spark_bagging_tpu import AFTSurvivalRegression, BaggingRegressor
+        from spark_bagging_tpu.bagging import _jitted_predict_quantiles
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(120, 4)).astype(np.float32)
+        y = np.exp(X[:, 0] * 0.3 + 0.1 * rng.normal(size=120)).astype(
+            np.float32
+        )
+        reg = BaggingRegressor(
+            base_learner=AFTSurvivalRegression(max_iter=30),
+            n_estimators=3, seed=0,
+        ).fit(X, y)
+        before = _jitted_predict_quantiles.cache_info().misses
+        q1 = reg.predict_quantiles(X[:10])
+        q2 = reg.predict_quantiles(X[10:20])
+        assert q1.shape == (10, 3) and q2.shape == (10, 3)
+        after = _jitted_predict_quantiles.cache_info()
+        assert after.misses == before + 1 and after.hits >= 1
