@@ -75,6 +75,9 @@ class _GBTBase(DecisionTreeRegressor):
         )
         if n_rounds < 1:
             raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
+        if not 0.0 < lr <= 1.0:  # Spark's stepSize bound — lr=0 would
+            # silently train a constant model, negative lr anti-learns
+            raise ValueError(f"lr must be in (0, 1], got {lr}")
         if not 0.0 < subsample <= 1.0:
             raise ValueError(
                 f"subsample must be in (0, 1], got {subsample}"

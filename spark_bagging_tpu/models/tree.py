@@ -484,10 +484,8 @@ class _TreeBase(BaseLearner):
 
             clf.base_learner_.to_debug_string(clf.replica_params(i)[0])
         """
-        import numpy as np_
-
-        feat = np_.asarray(params["feature"])
-        thr = np_.asarray(params["threshold"])
+        feat = np.asarray(params["feature"])
+        thr = np.asarray(params["threshold"])
 
         def name(f):
             return (
@@ -503,7 +501,7 @@ class _TreeBase(BaseLearner):
                 lines.append(pad + self._leaf_str(params, rel))
                 return
             node = (2**level - 1) + rel
-            if not np_.isfinite(thr[node]):
+            if not np.isfinite(thr[node]):
                 # unsplit/pre-pruned: all rows route left — render the
                 # reachable subtree without the phantom split
                 walk(level + 1, 2 * rel, indent)
@@ -518,7 +516,7 @@ class _TreeBase(BaseLearner):
             walk(level + 1, 2 * rel + 1, indent + 1)
 
         walk(0, 0, 1)
-        n_nodes = int(np_.isfinite(thr).sum())
+        n_nodes = int(np.isfinite(thr).sum())
         header = (
             f"{type(self).__name__} (depth={self.max_depth}, "
             f"splits={n_nodes})"
@@ -575,6 +573,10 @@ class DecisionTreeClassifier(_TreeBase):
             raise ValueError(
                 f"criterion must be gini|entropy, got {criterion!r}"
             )
+        if leaf_smoothing < 0:
+            raise ValueError(
+                f"leaf_smoothing must be >= 0, got {leaf_smoothing}"
+            )
         self.leaf_smoothing = leaf_smoothing
         self.criterion = criterion
 
@@ -613,8 +615,16 @@ class DecisionTreeClassifier(_TreeBase):
         shared by the in-memory fit and the streaming fit."""
         C = counts.shape[1]
         a = self.leaf_smoothing
-        logp = jnp.log(
-            (counts + a) / (counts.sum(-1, keepdims=True) + a * C)
+        totals = counts.sum(-1, keepdims=True)
+        # empty leaves (a pure split upstream leaves whole subtrees
+        # unpopulated) fall back to uniform log-probs — without this,
+        # leaf_smoothing=0 yields log(0/0)=NaN leaves that silently
+        # poison predictions for any row routed there (the regressor's
+        # global-mean fallback, classifier-shaped)
+        logp = jnp.where(
+            totals > 0,
+            jnp.log((counts + a) / jnp.maximum(totals + a * C, _EPS)),
+            jnp.log(1.0 / C),
         )
         w_tot = jnp.maximum(counts.sum(), _EPS)
         leaf_gini = jnp.sum(self._impurity(counts))
@@ -645,11 +655,9 @@ class DecisionTreeClassifier(_TreeBase):
         return params["leaf_logp"][self._route(params, X)]
 
     def _leaf_str(self, params, leaf_idx):
-        import numpy as np_
-
-        logp = np_.asarray(params["leaf_logp"][leaf_idx])
+        logp = np.asarray(params["leaf_logp"][leaf_idx])
         c = int(logp.argmax())
-        return f"Predict: {c} (p={float(np_.exp(logp[c])):.3f})"
+        return f"Predict: {c} (p={float(np.exp(logp[c])):.3f})"
 
 
 class DecisionTreeRegressor(_TreeBase):

@@ -487,6 +487,14 @@ def oob_scores_stream(
     rows are its out-of-bag rows — the same contract as the in-memory
     ``oob_predict_scores``.
 
+    RESTRICTION: the replay assumes the fit drew from the GLOBAL chunk
+    stream. A tree stream fitted over a mesh with ``data`` sharding > 1
+    folds the shard index into each draw and draws per-shard-length
+    weight vectors — this function cannot replay those, and calling it
+    for such a fit would return silently wrong (optimistically biased)
+    OOB memberships. Callers must reject that combination up front, as
+    ``BaggingClassifier.fit_stream`` does.
+
     Returns ``(agg, n_votes, y)`` over all valid rows in stream order:
     ``agg`` is vote counts ``(n, C)`` for classification or prediction
     sums ``(n,)`` for regression; rows with ``n_votes == 0`` have no

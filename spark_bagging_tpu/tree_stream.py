@@ -87,6 +87,16 @@ def fit_tree_ensemble_stream(
     re-runs only the in-flight one, reproducing the uninterrupted fit
     exactly (chunk-keyed weight draws are visit-order independent).
     """
+    if not getattr(learner, "tree_streamable", False):
+        # bagging.py guards its own entry; the public engine must too —
+        # a GBT slips through the _TreeBase isinstance check but would
+        # return single-tree params its own predict contract rejects
+        # far from the cause (tree.py's tree_streamable comment)
+        raise ValueError(
+            f"{type(learner).__name__} is not tree-streamable "
+            "(multi-round boosting needs margins over the whole "
+            "dataset per round; stream a bagged forest instead)"
+        )
     n_features = source.n_features
     chunk_rows = source.chunk_rows
     data_size = replica_size = 1
@@ -234,8 +244,13 @@ def fit_tree_ensemble_stream(
         """jit the per-chunk accumulation; on a mesh, shard_map it with
         rows over ``data`` (per-shard hists ``psum`` back — the
         treeAggregate analog) and replicas over ``replica``."""
+        # donate the accumulator (arg 0): it is rebound on every chunk
+        # step (acc = step_fn(acc, ...)), and without donation the old
+        # and new histograms are live simultaneously — doubling the
+        # engine's largest resident buffer, the exact bound the module
+        # docstring promises (streaming.py's chunk_step donates too)
         if mesh is None:
-            return jax.jit(body)
+            return jax.jit(body, donate_argnums=(0,))
         r = P(REPLICA_AXIS)
         return jax.jit(jax.shard_map(
             body,
@@ -245,7 +260,7 @@ def fit_tree_ensemble_stream(
                       P(), P(), r, r),  # n_valid, chunk_uid, ids, subs
             out_specs=r,
             check_vma=False,
-        ))
+        ), donate_argnums=(0,))
 
     def _accumulate(step_fn, acc, stats_src):
         """Run one pass over the stream, folding chunks into ``acc``."""

@@ -334,3 +334,10 @@ def test_multiclass_feature_subset_trees_differ():
     )
     feats = np.asarray(params["feature"]).reshape(4, 3, 3)
     assert not (feats[:, 0] == feats[:, 1]).all()
+
+
+def test_lr_validated():
+    with pytest.raises(ValueError, match="lr must be"):
+        GBTRegressor(lr=0.0)
+    with pytest.raises(ValueError, match="lr must be"):
+        GBTClassifier(lr=-0.1)

@@ -814,3 +814,23 @@ def test_regressor_score_stream_large_mean_targets():
             np.empty((0, X.shape[1]), np.float32),
             np.empty(0, np.float32), chunk_rows=16,
         ))
+
+
+def test_tree_stream_engine_rejects_gbt():
+    """The public engine must enforce tree_streamable itself — a GBT
+    would otherwise return single-tree params its own predict rejects
+    far from the cause."""
+    import jax
+
+    from spark_bagging_tpu import ArrayChunks
+    from spark_bagging_tpu.models.gbt import GBTRegressor
+    from spark_bagging_tpu.tree_stream import fit_tree_ensemble_stream
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    with pytest.raises(ValueError, match="not tree-streamable"):
+        fit_tree_ensemble_stream(
+            GBTRegressor(n_rounds=2, max_depth=2), ArrayChunks(X, y, 32),
+            jax.random.key(0), n_replicas=2, n_outputs=1,
+        )

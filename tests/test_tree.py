@@ -519,3 +519,35 @@ def test_gbt_debug_string_binary_and_multiclass():
     )
     s3 = gbt.to_debug_string(p3)
     assert "Tree 0 (class 0):" in s3 and "Tree 1 (class 2):" in s3
+
+
+def test_classifier_empty_leaves_no_nan_with_zero_smoothing():
+    """leaf_smoothing=0 with unpopulated leaves (pure splits upstream)
+    must fall back to uniform log-probs, not log(0/0)=NaN."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bagging_tpu.models.tree import DecisionTreeClassifier
+
+    rng = np.random.default_rng(0)
+    # one feature perfectly separates two classes: depth-3 tree leaves
+    # below the pure split stay empty
+    X = np.concatenate([rng.normal(-3, 0.1, (50, 2)),
+                        rng.normal(3, 0.1, (50, 2))]).astype(np.float32)
+    y = np.array([0] * 50 + [1] * 50)
+    t = DecisionTreeClassifier(max_depth=3, leaf_smoothing=0.0)
+    params, _ = t.fit(
+        t.init_params(jax.random.key(0), 2, 2), jnp.asarray(X),
+        jnp.asarray(y), jnp.ones(100), jax.random.key(1),
+    )
+    logp = np.asarray(params["leaf_logp"])
+    # no NaN anywhere (log(0/0) on empty leaves was the bug); -inf is
+    # CORRECT for a class absent from a populated leaf at smoothing=0
+    assert not np.isnan(logp).any()
+    # empty leaves fell back to uniform: some leaf rows are all log(1/C)
+    assert (np.isclose(logp, np.log(0.5)).all(axis=1)).any()
+    scores = t.predict_scores(params, jnp.asarray(X))
+    assert not np.isnan(np.asarray(scores)).any()
+    assert (np.asarray(scores).argmax(1) == y).mean() > 0.99
+    with pytest.raises(ValueError, match="leaf_smoothing"):
+        DecisionTreeClassifier(leaf_smoothing=-1.0)
