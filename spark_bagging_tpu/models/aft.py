@@ -164,4 +164,8 @@ class AFTSurvivalRegression(BaseLearner):
                 step, (params, opt.init(params)), None,
                 length=self.max_iter,
             )
-        return params, {"loss": losses[-1]}
+            # losses[i] is evaluated BEFORE step i's update, so
+            # losses[-1] is one step stale; report the loss at the
+            # final params (and the curve), like every other learner
+            final = nll(params)
+        return params, {"loss": final, "loss_curve": losses}

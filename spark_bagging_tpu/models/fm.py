@@ -78,6 +78,19 @@ class _FMBase(BaseLearner):
         # ≈ 2x forward (standard AD accounting)
         return float(self.max_iter * 3 * (4 * n * d * k * C + 2 * n * d * C))
 
+    def fit_workset_bytes(self, n_rows, n_features, n_outputs):
+        k = self.factor_size
+        C = self._n_scores(n_outputs)
+        # dominant per-replica temps: the (n, k, C) XV and X2V2
+        # pairwise activations (plus their AD adjoints, ~2x), the
+        # (n, C) scores/probs, and the (n,) weight vector — without
+        # this model auto_chunk_size keeps legacy vmap-all and a
+        # 1000-replica FM bag OOMs exactly where the resolver was
+        # built to step in [utils/memory.py]
+        return float(
+            4 * (3 * 2 * n_rows * k * C + 2 * n_rows * C + 2 * n_rows)
+        )
+
     def sgd_step_flops(self, chunk_rows, n_features, n_outputs):
         k = self.factor_size
         C = self._n_scores(n_outputs)

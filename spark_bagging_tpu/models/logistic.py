@@ -161,12 +161,41 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
         # With row_tile the probs temp is bounded at (row_tile, C).
         # Calibrated against the v5e headline: chunk=200 fits, 500
         # OOMs [bench.py] — this model + the 0.35 budget lands ~250.
-        probs_rows = self.row_tile if self.row_tile else n_rows
-        return float(4 * (probs_rows * n_outputs + 2 * n_rows))
+        C, d = n_outputs, n_features + 1
+        # the Adam path never row-tiles, so its (n, C) temp is unbounded
+        # regardless of row_tile
+        probs_rows = (
+            self.row_tile if self.row_tile and self.solver == "newton"
+            else n_rows
+        )
+        base = 4.0 * (probs_rows * C + 2 * n_rows)
+        # the wide Hessian assemblies materialize an HBM operand the
+        # blocked path does not — unmodeled, auto_chunk_size would
+        # overestimate capacity ~C·d/4-fold and OOM [hessian ladder]:
+        # fused builds (rows, C·d), packed (rows, P·d) with P=C(C+1)/2;
+        # pallas builds its wide operand in VMEM (no HBM temp)
+        impl = self._resolved_hessian(C) if self.solver == "newton" else None
+        if impl == "fused":
+            base += 4.0 * probs_rows * C * d
+        elif impl == "packed":
+            base += 4.0 * probs_rows * (C * (C + 1) // 2) * d
+        return float(base)
+
+    @staticmethod
+    def _nll_from_scores(scores, y):
+        """(per-row NLL, log-probs) — THE softmax-NLL definition, used
+        by every loss/gradient site so the optimized objective can
+        never desync from the reported one."""
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0], logp
+
+    def _penalty_grad(self, W):
+        """d/dW of _penalty by AD — editing the penalty cannot leave a
+        stale closed-form gradient behind (fm.py's pattern)."""
+        return jax.grad(self._penalty)(W)
 
     def row_loss(self, params, X, y):
-        logp = jax.nn.log_softmax(self.predict_scores(params, X), axis=-1)
-        return -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        return self._nll_from_scores(self.predict_scores(params, X), y)[0]
 
     def penalty(self, params):
         return self._penalty(params["W"])
@@ -174,14 +203,12 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
     def _global_loss(self, W, Xb, y, w, w_sum, axis_name, tiles=None):
         """Global weighted mean NLL + penalty (for reporting/curves)."""
         if tiles is None:
-            logp = jax.nn.log_softmax(Xb @ W, axis=-1)
-            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            nll, _ = self._nll_from_scores(Xb @ W, y)
             local = jnp.sum(w * nll)
         else:
             def acc(s, tup):
                 Xt, yt, wt = tup
-                logp = jax.nn.log_softmax(Xt @ W, axis=-1)
-                nll = -jnp.take_along_axis(logp, yt[:, None], axis=1)[:, 0]
+                nll, _ = self._nll_from_scores(Xt @ W, yt)
                 return s + jnp.sum(wt * nll), None
 
             local, _ = jax.lax.scan(acc, jnp.float32(0.0), tiles)
@@ -222,8 +249,7 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
         """Un-normalized (Σw·nll, data gradient, data Hessian) for one
         row block — the per-tile body shared by the single-pass and
         row-tiled paths."""
-        logp = jax.nn.log_softmax(Xt @ W, axis=-1)
-        nll = -jnp.take_along_axis(logp, yt[:, None], axis=1)[:, 0]
+        nll, logp = self._nll_from_scores(Xt @ W, yt)
         loss_sum = jnp.sum(wt * nll)
         P = jnp.exp(logp)
         Y = jax.nn.one_hot(yt, C, dtype=jnp.float32)
@@ -356,9 +382,7 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
                 )
                 (loss_sum, G, H), _ = jax.lax.scan(acc, zero, tiles)
             loss = maybe_psum(loss_sum, axis_name) / w_sum + self._penalty(W)
-            G = maybe_psum(G, axis_name) / w_sum + jnp.concatenate(
-                [self.l2 * W[:-1], jnp.zeros((1, C), W.dtype)], axis=0
-            )
+            G = maybe_psum(G, axis_name) / w_sum + self._penalty_grad(W)
             H = maybe_psum(H, axis_name) / w_sum + jnp.diag(
                 pen_cd + _SOLVER_DAMPING
             )
@@ -380,20 +404,13 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
             # Local shard's weighted NLL sum over the *global* weight
             # total; grads are psum'd explicitly below (the penalty is
             # added once, outside the psum).
-            logp = jax.nn.log_softmax(Xb @ W, axis=-1)
-            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            nll, _ = self._nll_from_scores(Xb @ W, y)
             return jnp.sum(w * nll) / w_sum
-
-        def penalty_grad(W):
-            return jnp.concatenate(
-                [self.l2 * W[:-1], jnp.zeros((1, W.shape[1]), W.dtype)],
-                axis=0,
-            )
 
         def step(carry, _):
             W, opt_state = carry
             local_loss, g_local = jax.value_and_grad(local_data_loss)(W)
-            g = maybe_psum(g_local, axis_name) + penalty_grad(W)
+            g = maybe_psum(g_local, axis_name) + self._penalty_grad(W)
             loss = maybe_psum(local_loss, axis_name) + self._penalty(W)
             updates, opt_state = opt.update(g, opt_state, W)
             return (optax.apply_updates(W, updates), opt_state), loss

@@ -106,11 +106,15 @@ class _MLPBase(BaseLearner):
     def fit_workset_bytes(self, n_rows, n_features, n_outputs):
         b = min(self.batch_size or n_rows, n_rows)
         # activations + their adjoints (~3x) on one minibatch, Adam's
-        # 3 param copies (params + 2 moments)
+        # 3 param copies (params + 2 moments), the per-replica (b, d)
+        # minibatch gather X[idx] (idx differs per replica under vmap —
+        # at wide-feature scale this dominates the activations), and
+        # the per-replica weight vector
         return float(
             12 * b * (self.hidden + n_outputs)
             + 12 * (n_features * self.hidden + self.hidden * n_outputs)
-            + 4 * n_rows  # per-replica weight vector
+            + 4 * b * n_features
+            + 4 * n_rows
         )
 
     def _row_loss(self, params, X, y):
@@ -163,7 +167,11 @@ class _MLPBase(BaseLearner):
             loss = maybe_psum(loss_sum, axis_name) / denom + pen
             return loss, grad
 
-        if self.batch_size is None:
+        # batch_size >= n degenerates to the EXACT full-batch path — a
+        # with-replacement draw of n rows would silently train on ~63%
+        # unique rows per step, a different (noisier) trajectory than
+        # the "full batch" the size requests
+        if self.batch_size is None or self.batch_size >= n:
             def step(carry, _):
                 p, opt_state = carry
                 loss, g = weighted_grad(p, X, y, w)
@@ -171,7 +179,7 @@ class _MLPBase(BaseLearner):
                 return (optax.apply_updates(p, updates), opt_state), loss
             xs = None
         else:
-            b = min(self.batch_size, n)
+            b = self.batch_size
 
             def step(carry, k_step):
                 p, opt_state = carry

@@ -213,6 +213,11 @@ class _TreeBase(BaseLearner):
         # Dense peak HBM per (row, feature, bin) element: the int8 T
         # indicator plus the hist_dtype Tf = T.reshape(...).astype(...)
         # copy materialized inside _grow — budget both, not just T.
+        # NOTE: the fused kernel also has a VMEM feasibility envelope
+        # (deepest-level output block (B·f_tile, N·K) f32);
+        # ops/hist.py's guard raises a clear error with guidance when a
+        # deep-tree/many-stat config exceeds it — set
+        # split_impl="dense" there.
         bytes_per = 1 + jnp.dtype(self.hist_dtype).itemsize
         if (
             jax.default_backend() == "tpu"

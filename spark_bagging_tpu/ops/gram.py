@@ -28,6 +28,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _ROW_TILE = 512
+# conservative budget for the kernel's concurrently-resident VMEM
+# blocks (v5e VMEM ≈ 16 MiB total)
+_MAX_VMEM_BYTES = 12 * 1024 * 1024
 
 
 def _scaled_gram_kernel(x_ref, s_ref, out_ref, *, n_pairs, op_dtype):
@@ -85,6 +88,19 @@ def scaled_grams(
         # CPU interpreter lacks fast bf16 dots; operands are cast for
         # numerics only on TPU
         dt = jnp.dtype(jnp.float32)
+    # VMEM feasibility: the kernel holds the (ROW_TILE, d) rows, the
+    # (ROW_TILE, P·d) scaled wide operand it builds on-chip, and the
+    # (d, P·d) f32 accumulator concurrently; past the envelope Mosaic
+    # fails with an opaque compile error mid-fit, so reject up front
+    # with guidance (packed does the same math with an HBM temp).
+    vmem_bytes = 4 * (_ROW_TILE * (d + P + P * d) + d * P * d)
+    if not interpret and vmem_bytes > _MAX_VMEM_BYTES:
+        raise ValueError(
+            f"pallas scaled-Gram needs ~{vmem_bytes >> 20} MiB VMEM at "
+            f"d={d}, P={P} — beyond the kernel's envelope; use "
+            "hessian_impl='packed' (same math, HBM temp bounded by "
+            "row_tile) or 'blocked'"
+        )
     pad = (-n) % _ROW_TILE
     if pad:
         X = jnp.pad(X, ((0, pad), (0, 0)))

@@ -36,6 +36,9 @@ _ROW_TILE = 512
 # F_tile chosen so the on-chip indicator block (_ROW_TILE × F_tile·B)
 # stays ~2 MB in bf16 — far under VMEM while keeping MXU tiles full.
 _MAX_FB_TILE = 2048
+# conservative cap on the kernel's f32 output block (v5e VMEM ≈ 16 MiB
+# shared across all concurrently-resident blocks)
+_MAX_OUT_BLOCK_BYTES = 8 * 1024 * 1024
 
 
 def _hist_kernel(x_ref, e_ref, node_ref, s_ref, out_ref, *, n_nodes,
@@ -137,6 +140,19 @@ def binned_left_stats(
         op_dtype = jnp.dtype(jnp.float32)
 
     f_tile = max(1, min(F, _MAX_FB_TILE // B))
+    # VMEM feasibility: the output block is (B·f_tile, N·K) f32 —
+    # _MAX_FB_TILE caps only the indicator width, so a deep level with
+    # many per-row stats (e.g. depth 12, K=7 → N·K = 14336) would
+    # otherwise hand Mosaic an impossible block and crash mid-fit with
+    # an opaque compile error.
+    out_block_bytes = 4 * B * f_tile * n_nodes * K
+    if out_block_bytes > _MAX_OUT_BLOCK_BYTES:
+        raise ValueError(
+            f"fused split search needs a ({B * f_tile}, {n_nodes * K}) "
+            f"f32 VMEM output block (~{out_block_bytes >> 20} MiB) — "
+            "beyond the kernel's envelope at this depth/stat width; "
+            "use split_impl='dense' (or a shallower tree / fewer bins)"
+        )
     Xp = _pad_axis(_pad_axis(X, 0, _ROW_TILE, 0.0), 1, f_tile, 0.0)
     # padded feature columns produce out rows that are sliced away
     # below; padded data rows carry S == 0 — both inert.

@@ -270,3 +270,21 @@ def test_streamed_aft_scores_its_own_training_source():
     np.testing.assert_allclose(
         reg.predict_stream(wrapped), preds, rtol=1e-5
     )
+
+
+def test_aft_reports_final_loss_and_curve():
+    """The reported loss is evaluated AT the final params (not one Adam
+    step stale) and the curve rides along like every other learner."""
+    import jax
+    import jax.numpy as jnp
+
+    X, y, delta = _weibull_data(n=300, censor_frac=0.2, seed=3)
+    aft = AFTSurvivalRegression(max_iter=50)
+    p0 = aft.init_params(jax.random.key(0), X.shape[1], 1)
+    params, aux = aft.fit(
+        p0, jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)),
+        jax.random.key(1), aux=jnp.asarray(delta),
+    )
+    assert aux["loss_curve"].shape == (50,)
+    # final loss should not exceed the last pre-update evaluation
+    assert float(aux["loss"]) <= float(aux["loss_curve"][-1]) + 1e-5

@@ -777,3 +777,53 @@ def test_pallas_ignores_row_tile():
     assert lr._row_tiles(Xj, yj, jnp.ones(len(y))) is None
     p, aux = lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
     assert np.isfinite(float(aux["loss"]))
+
+
+class TestKernelEnvelopeGuards:
+    def test_pallas_gram_rejects_oversized_vmem(self):
+        import jax.numpy as jnp
+
+        from spark_bagging_tpu.ops.gram import scaled_grams
+
+        X = jnp.ones((64, 500))
+        S = jnp.ones((64, 6))
+        with pytest.raises(ValueError, match="VMEM"):
+            scaled_grams(X, S, interpret=False)
+
+    def test_fused_hist_rejects_oversized_out_block(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_bagging_tpu.ops.hist import binned_left_stats
+
+        X = jnp.ones((64, 64))
+        edges = jnp.ones((64, 32))
+        node = jnp.zeros((64,), jnp.int32)
+        S = jnp.ones((64, 7))
+        with pytest.raises(ValueError, match="envelope"):
+            binned_left_stats(X, edges, node, S, n_nodes=2048,
+                              interpret=True)
+
+    def test_logistic_workset_models_wide_hessians(self):
+        from spark_bagging_tpu.models.logistic import LogisticRegression
+
+        n, d, C = 10_000, 54, 10
+        blocked = LogisticRegression(hessian_impl="blocked")
+        fused = LogisticRegression(hessian_impl="fused")
+        packed = LogisticRegression(hessian_impl="packed")
+        b = blocked.fit_workset_bytes(n, d, C)
+        # the wide assemblies' HBM temps must be modeled, not free
+        assert fused.fit_workset_bytes(n, d, C) > b + 4 * n * C * d * 0.9
+        assert packed.fit_workset_bytes(n, d, C) > b
+        # auto resolves to fused at C=10 and must be modeled identically
+        auto = LogisticRegression(hessian_impl="auto")
+        assert auto.fit_workset_bytes(n, d, C) == \
+            fused.fit_workset_bytes(n, d, C)
+
+    def test_fm_workset_modeled(self):
+        from spark_bagging_tpu.models.fm import FMClassifier
+
+        fm = FMClassifier(factor_size=8)
+        small = fm.fit_workset_bytes(1_000, 54, 3)
+        big = fm.fit_workset_bytes(100_000, 54, 3)
+        assert small > 0 and big > 50 * small

@@ -159,3 +159,32 @@ class TestMLPBagging:
         )
         reg.fit(X, y)
         assert reg.score(X, y) > 0.5
+
+
+def test_full_batch_size_degenerates_to_exact_path():
+    """batch_size >= n must use the exact full-batch branch, not
+    with-replacement draws of n rows."""
+    import jax
+
+    from spark_bagging_tpu.models.mlp import MLPClassifier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(60, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    k = jax.random.key(0)
+
+    def fit(bs):
+        m = MLPClassifier(hidden=4, max_iter=10, batch_size=bs)
+        p = m.init_params(jax.random.key(1), 4, 2)
+        return m.fit(p, jnp.asarray(X), jnp.asarray(y),
+                     jnp.ones(60), k)
+
+    pa, _ = fit(None)
+    pb, _ = fit(60)      # == n
+    pc, _ = fit(1000)    # > n
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, c in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pc)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
