@@ -19,6 +19,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <utility>
 #include <vector>
@@ -88,13 +89,30 @@ inline uint32_t crc32_update(uint32_t crc, const char* buf, size_t len) {
 // the Python FeatureHasher (Criteo columns reach 10M+ uniques)
 constexpr size_t kMemoCap = 1u << 20;
 
+// transparent hashing so memo probes take a string_view — the hot
+// ingestion loop must not heap-allocate a std::string per categorical
+// field just to check the memo (C++20 heterogeneous lookup)
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view sv) const noexcept {
+    return std::hash<std::string_view>{}(sv);
+  }
+};
+struct SvEq {
+  using is_transparent = void;
+  bool operator()(std::string_view a, std::string_view b) const noexcept {
+    return a == b;
+  }
+};
+
 struct HashedSpec {
   std::vector<int64_t> numeric, categorical;
   int64_t n_hash = 0;
   uint32_t seed = 0;
   char delim = ',';
   int64_t max_col = 0;
-  std::vector<std::unordered_map<std::string, std::pair<int64_t, float>>>
+  std::vector<std::unordered_map<std::string, std::pair<int64_t, float>,
+                                 SvHash, SvEq>>
       memo;
 };
 
@@ -179,10 +197,21 @@ inline void split_fields(const char* line, char delim,
 // (C99 hex floats); underscored literals are rejected on BOTH paths
 // (the fallback mirrors this) so native and Python never diverge.
 inline bool parse_field_float(const char* s, size_t len, float* out) {
-  std::string tmp(s, len);  // NUL-terminate for strtof
-  for (char ch : tmp)
-    if (ch == 'x' || ch == 'X' || ch == '_') return false;
-  const char* p = tmp.c_str();
+  // stack buffer: numeric fields are short, and the hot path must not
+  // heap-allocate per field; oversized fields take the slow copy
+  char stack[64];
+  std::string heap;
+  const char* p;
+  if (len < sizeof(stack)) {
+    std::memcpy(stack, s, len);
+    stack[len] = 0;
+    p = stack;
+  } else {
+    heap.assign(s, len);
+    p = heap.c_str();
+  }
+  for (size_t i = 0; i < len; ++i)
+    if (p[i] == 'x' || p[i] == 'X' || p[i] == '_') return false;
   char* end = nullptr;
   *out = strtof(p, &end);
   if (end == p) return false;
@@ -214,7 +243,7 @@ inline int hashed_parse_row(
   float* hash_base = xrow + h->numeric.size();
   for (size_t j = 0; j < h->categorical.size(); ++j) {
     auto [fp, fl] = fields[h->categorical[j]];
-    std::string value(fp, fl);
+    std::string_view value(fp, fl);  // no allocation on memo hits
     auto& memo = h->memo[j];
     auto it = memo.find(value);
     int64_t slot;
@@ -225,12 +254,14 @@ inline int hashed_parse_row(
     } else {
       // token layout matches utils/hashing.py: "<j>=<value>" where j
       // is the position within the categorical list
-      std::string token = std::to_string(j) + "=" + value;
+      std::string token = std::to_string(j);
+      token += '=';
+      token.append(value.data(), value.size());
       slot = crc32_update(h->seed, token.data(), token.size()) % h->n_hash;
       token.push_back('#');
       sign = (crc32_update(h->seed, token.data(), token.size()) & 1)
                  ? 1.0f : -1.0f;
-      if (memo.size() < kMemoCap) memo.emplace(std::move(value),
+      if (memo.size() < kMemoCap) memo.emplace(std::string(value),
                                                std::make_pair(slot, sign));
     }
     hash_base[slot] += sign;

@@ -28,6 +28,13 @@ def initialize_distributed(
     process). On a pod slice, JAX auto-detects everything from the TPU
     runtime environment.
     """
+    if coordinator_address is None and (
+        num_processes is not None or process_id is not None
+    ):
+        raise ValueError(
+            "num_processes/process_id require coordinator_address — "
+            "without it they would be silently ignored"
+        )
     try:
         if coordinator_address is not None:
             jax.distributed.initialize(
@@ -35,10 +42,21 @@ def initialize_distributed(
                 num_processes=num_processes,
                 process_id=process_id,
             )
-        elif jax.process_count() > 1:
-            pass  # already initialized by the runtime
-    except RuntimeError as e:  # already initialized
+        else:
+            # the auto-detect path MUST actually call initialize —
+            # JAX reads the pod topology from the TPU runtime env; on a
+            # plain single host it raises and we fall through to
+            # single-process. (Probing jax.process_count() first would
+            # both dead-code this branch — it is 1 before init — and
+            # initialize the backend, breaking any later init attempt.)
+            jax.distributed.initialize()
+    except RuntimeError as e:
+        # already initialized, or no cluster environment to detect
         log.debug("jax.distributed.initialize skipped: %s", e)
+    except ValueError as e:
+        # jax raises ValueError when no coordinator can be inferred
+        # from the environment — the single-process case
+        log.debug("jax.distributed auto-detect: single process (%s)", e)
     log.info(
         "distributed: %d process(es), %d global device(s)",
         jax.process_count(),

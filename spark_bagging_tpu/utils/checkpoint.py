@@ -190,10 +190,31 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
         tree["oob_prediction"] = np.asarray(model.oob_prediction_)
     if jax.process_index() != 0:
         return
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "manifest.json"), "w") as f:
+    # Atomic install (streaming.py's checkpointer pattern): build the
+    # whole checkpoint in a temp dir, then swap it in. A direct
+    # overwrite had two stale-read hazards: (a) manifest written before
+    # arrays — a crash in between leaves new-manifest/old-arrays that
+    # LOADS without error; (b) a re-save under a different compression
+    # setting left the other format's arrays file behind, and
+    # _read_arrays prefers .zst — silently loading the older weights.
+    import shutil
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
-    _write_arrays(path, serialization.msgpack_serialize(tree), compress)
+    _write_arrays(tmp, serialization.msgpack_serialize(tree), compress)
+    if os.path.exists(path):
+        old = f"{path}.old.{os.getpid()}"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old)
+    else:
+        os.replace(tmp, path)
 
 
 def load_model(path: str, *, mesh=None) -> Any:

@@ -62,7 +62,9 @@ class FeatureHasher:
         memo = self._memo.setdefault(col, {})
         hit = memo.get(value)
         if hit is None:
-            token = f"{col}={value}".encode()
+            # surrogateescape restores any non-UTF-8 input bytes
+            # verbatim, keeping token bytes native-reader-identical
+            token = f"{col}={value}".encode("utf-8", "surrogateescape")
             h = zlib.crc32(token, self.seed & 0xFFFFFFFF)
             idx = h % self.n_features
             # The sign must come from a hash of DIFFERENT BYTES, not a
@@ -238,7 +240,13 @@ class HashedCSVChunks(ChunkSource):
                 if not skipped:
                     skipped = True
                     continue
-                line = raw.decode("utf-8").rstrip("\r\n")
+                # surrogateescape keeps non-UTF-8 bytes round-trippable
+                # so the hashed token bytes stay identical to the
+                # byte-agnostic native reader's — the differential
+                # parity contract must hold for any input bytes
+                line = raw.decode(
+                    "utf-8", "surrogateescape"
+                ).rstrip("\r\n")
                 buf.append(line.split(self._delim))
                 if len(buf) == self.chunk_rows:
                     yield self._encode(buf)

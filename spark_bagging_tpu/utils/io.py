@@ -273,22 +273,35 @@ class CSVChunks(ChunkSource):
         self.chunk_rows = int(chunk_rows)
         self._label_col = label_col
         self._skip_header = skip_header
-        dims = self._native_dims()
-        if dims is not None:
-            counted_rows, n_cols = dims
-        else:
-            # mirror the native csv_dims exactly: blank lines never
-            # count, and n_cols comes from the first NON-blank line
-            n_cols = counted_rows = 0
+        counted_rows = 0
+        if n_rows is not None:
+            # the counting pass exists only to learn n_rows; with it
+            # supplied, only the first non-blank line is needed for
+            # n_cols — a Criteo-scale file must not be read twice
+            # (LibsvmChunks/HashedCSVChunks make the same promise)
+            n_cols = 0
             with open(path) as f:
                 for line in f:
-                    if not line.strip():
-                        continue
-                    if n_cols == 0:
+                    if line.strip():
                         n_cols = len(line.split(","))
-                    counted_rows += 1
-            if skip_header and counted_rows > 0:
-                counted_rows -= 1
+                        break
+        else:
+            dims = self._native_dims()
+            if dims is not None:
+                counted_rows, n_cols = dims
+            else:
+                # mirror the native csv_dims exactly: blank lines never
+                # count, and n_cols comes from the first NON-blank line
+                n_cols = counted_rows = 0
+                with open(path) as f:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        if n_cols == 0:
+                            n_cols = len(line.split(","))
+                        counted_rows += 1
+                if skip_header and counted_rows > 0:
+                    counted_rows -= 1
         lc = label_col + n_cols if label_col < 0 else label_col
         if n_cols < 2 or lc < 0 or lc >= n_cols:
             raise ValueError(

@@ -84,4 +84,8 @@ def auto_chunk_size(
         budget_bytes = device_memory_budget()
     if per * reps_local <= budget_bytes:
         return None  # everything fits: keep the vmap fast path
-    return max(1, int(budget_bytes // per))
+    # the chunk is a batch size over the GLOBAL replica axis, but the
+    # budget bounds PER-DEVICE residency — a replica-sharded mesh holds
+    # only chunk/replica of each batch per device, so scale back up or
+    # the fit runs `replica`× more scan steps than HBM requires
+    return max(1, min(n_replicas, int(budget_bytes // per) * replica))

@@ -37,7 +37,7 @@ def _build() -> bool:
     # poisons the mtime-based staleness check
     tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp,
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++20", _SRC, "-o", tmp,
     ]
     try:
         proc = subprocess.run(

@@ -720,3 +720,11 @@ class TestLibraryAuditFixes:
         assert q1.shape == (10, 3) and q2.shape == (10, 3)
         after = _jitted_predict_quantiles.cache_info()
         assert after.misses == before + 1 and after.hits >= 1
+
+    def test_distributed_args_validated(self):
+        from spark_bagging_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        with pytest.raises(ValueError, match="coordinator_address"):
+            initialize_distributed(num_processes=2)

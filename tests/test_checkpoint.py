@@ -150,3 +150,19 @@ def test_auto_chunk_resolution_survives_roundtrip(tmp_path, iris):
     loaded = load_model(str(tmp_path / "m"))
     assert loaded._eff_chunk() == 3
     np.testing.assert_array_equal(loaded.predict(X), clf.predict(X))
+
+
+def test_resave_under_other_compression_never_loads_stale(tmp_path, iris):
+    """A re-save must atomically replace the whole checkpoint dir: the
+    old run's arrays file in the OTHER compression format must not
+    survive to shadow the new weights at load time."""
+    X, y = iris
+    path = str(tmp_path / "m")
+    a = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    save_model(a, path, compress=True)   # writes arrays.msgpack.zst
+    b = BaggingClassifier(n_estimators=4, seed=1).fit(X, y)
+    save_model(b, path, compress=False)  # raw msgpack, same dir
+    import os
+    assert not os.path.exists(os.path.join(path, "arrays.msgpack.zst"))
+    loaded = load_model(path)
+    np.testing.assert_array_equal(loaded.predict(X), b.predict(X))

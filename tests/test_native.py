@@ -256,3 +256,17 @@ def test_fuzz_csv_native_matches_python(lib, tmp_path):
                     f"header={header} label_col={label_col})",
         )
         np.testing.assert_allclose(yn, yp, rtol=1e-6, atol=1e-7)
+
+
+def test_csv_chunks_supplied_n_rows_skips_counting(csv_file):
+    """With n_rows supplied the init reads only the first line (for
+    n_cols) — and the stream still yields identical chunks."""
+    full = CSVChunks(csv_file, chunk_rows=7, skip_header=True)
+    fast = CSVChunks(csv_file, chunk_rows=7, skip_header=True,
+                     n_rows=full.n_rows)
+    assert fast.n_rows == full.n_rows
+    assert fast.n_features == full.n_features
+    for (Xa, ya, na), (Xb, yb, nb) in zip(full.chunks(), fast.chunks()):
+        np.testing.assert_array_equal(Xa, Xb)
+        np.testing.assert_array_equal(ya, yb)
+        assert na == nb
