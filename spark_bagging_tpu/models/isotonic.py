@@ -82,8 +82,13 @@ class IsotonicRegression(BaseLearner):
     def flops_per_fit(self, n_rows, n_features, n_outputs):
         del n_features, n_outputs
         B = self.n_bins
-        # binning one-hot matmul + the O(B²) minimax table
-        return float(4 * n_rows * B + 6 * B * B)
+        # O(n) segment-sum binning (searchsorted ~log B + two adds per
+        # row — the dense one-hot matmul this replaced must NOT be
+        # charged, or reported MFU inflates ~B-fold) + the O(B²)
+        # minimax table
+        import math
+
+        return float(n_rows * (math.ceil(math.log2(B)) + 4) + 6 * B * B)
 
     def fit(self, params, X, y, sample_weight, key, *, axis_name=None,
             prepared=None):

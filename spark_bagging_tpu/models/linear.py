@@ -2,8 +2,9 @@
 
 The reference's regression config plugs Spark ML LinearRegression into
 ``BaggingRegressor`` [B:8]. The TPU-native learner solves the weighted
-ridge normal equations ``(Xᵀ diag(w) X + l2·I) β = Xᵀ diag(w) y`` with a
-Cholesky solve — one ``(d, n) @ (n, d)`` matmul per replica, ideal MXU
+ridge normal equations ``(Xᵀ diag(w) X + l2·Σw·I) β = Xᵀ diag(w) y``
+(the mean-loss parameterization — sklearn's ``Ridge(alpha)`` maps to
+``l2 = alpha / Σw``) with a Cholesky solve — one ``(d, n) @ (n, d)`` matmul per replica, ideal MXU
 shape, closed-form (no iteration), trivially ``vmap``-able. Row
 reductions go through ``maybe_psum`` so a data-sharded fit returns the
 identical solution [SURVEY §5 comms backend].
@@ -59,8 +60,11 @@ class LinearRegression(BaseLearner):
 
     def fit_workset_bytes(self, n_rows, n_features, n_outputs):
         del n_outputs
-        # normal equations: √w-scaled design copy (n, d+1) + weights
-        return float(4 * n_rows * (n_features + 3))
+        # normal equations materialize TWO (n, d+1) design temps (the
+        # bias-augmented Xb and the w-scaled Xw) plus the per-replica
+        # subspace gather and the weight vector — modeling only one
+        # copy let auto_chunk_size admit ~2-3x too many replicas
+        return float(4 * n_rows * (3 * (n_features + 1) + 2))
 
     def row_loss(self, params, X, y):
         return 0.5 * (self.predict_scores(params, X) - y) ** 2
@@ -82,17 +86,21 @@ class LinearRegression(BaseLearner):
             )
             d = Xb.shape[1]
             Xw = Xb * w[:, None]
+            w_sum = maybe_psum(jnp.sum(w), axis_name)
             A = maybe_psum(Xw.T @ Xb, axis_name)
             b = maybe_psum(Xw.T @ y, axis_name)
             pen = jnp.concatenate(
                 [jnp.full(d - 1, self.l2), jnp.full(1, _BIAS_JITTER)]
             )
+            # penalty scales with Σw: the solve minimizes the MEAN
+            # weighted loss + 0.5·l2·‖β‖² (the streaming objective),
+            # equivalently (XᵀWX + l2·Σw·I)β = XᵀWy — sklearn's
+            # Ridge(alpha) corresponds to l2 = alpha / Σw
             beta = jax.scipy.linalg.solve(
-                A + jnp.diag(pen) * maybe_psum(jnp.sum(w), axis_name),
+                A + jnp.diag(pen) * w_sum,
                 b,
                 assume_a="pos",
             )
             resid = Xb @ beta - y
-            w_sum = maybe_psum(jnp.sum(w), axis_name)
             mse = maybe_psum(jnp.sum(w * resid**2), axis_name) / w_sum
         return {"beta": beta}, {"loss": mse, "loss_curve": mse[None]}

@@ -81,10 +81,9 @@ class GaussianNB(BaseLearner):
             "log_prior": log_prior, "shift": gmean, "mean": dmean,
             "var": var,
         }
-        # weighted mean NLL, for the loss curve/report
-        logp = jax.nn.log_softmax(self.predict_scores(params, X), axis=-1)
-        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
-        loss = maybe_psum(jnp.sum(w * nll), axis_name) / w_sum
+        # weighted mean NLL, for the loss curve/report (the shared
+        # helper — one NLL definition per module)
+        loss = _weighted_nll(self, params, X, y, w, w_sum, axis_name)
         return params, {"loss": loss, "loss_curve": loss[None]}
 
     def predict_scores(self, params, X):

@@ -32,6 +32,10 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if data < 1 or (replica is not None and replica < 1):
+        raise ValueError(
+            f"mesh axes must be >= 1, got data={data}, replica={replica}"
+        )
     if replica is None:
         if n % data != 0:
             raise ValueError(f"{n} devices not divisible by data={data}")

@@ -55,8 +55,8 @@ def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     arithmetic — no per-unique-value scan (a continuous-score 400k-row
     test set must cost seconds, not hours).
     """
-    y_true = np.asarray(y_true)
-    scores = np.asarray(scores, np.float64)
+    y_true = np.asarray(y_true).ravel()  # column vectors welcome,
+    scores = np.asarray(scores, np.float64).ravel()  # like every sibling
     n = len(scores)
     order = np.argsort(scores, kind="mergesort")
     s = scores[order]

@@ -146,3 +146,14 @@ def test_device_peak_tflops_known_kinds():
     assert device_peak_tflops(FakeDev("TPU v6 lite")) == 918.0
     assert device_peak_tflops(FakeDev("TPU v4")) == 275.0
     assert device_peak_tflops(FakeDev("cpu")) is None
+
+
+def test_roc_auc_accepts_column_vectors():
+    from spark_bagging_tpu.utils.metrics import roc_auc
+
+    rng = np.random.default_rng(0)
+    y = (rng.random(200) > 0.5).astype(int)
+    s = rng.random(200) + 0.5 * y
+    flat = roc_auc(y, s)
+    assert roc_auc(y.reshape(-1, 1), s.reshape(-1, 1)) == flat
+    assert roc_auc(y.reshape(-1, 1), s) == flat

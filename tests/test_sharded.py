@@ -191,3 +191,10 @@ def test_hard_vote_on_mesh(breast_cancer):
         n_estimators=16, voting="hard", seed=5, mesh=mesh
     ).fit(X, y)
     assert clf.score(X, y) > 0.95
+
+
+def test_make_mesh_rejects_nonpositive_axes():
+    with pytest.raises(ValueError, match=">= 1"):
+        make_mesh(data=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_mesh(data=1, replica=-1)
