@@ -60,8 +60,15 @@ def parse_libsvm(
             labels.append(float(parts[0]))
             entries: dict[int, float] = {}
             for item in parts[1:]:
-                idx_s, val_s = item.split(":")
-                idx = int(idx_s) - (0 if zero_based else 1)
+                idx_s, val_s = item.split(":", 1)
+                try:
+                    idx = int(idx_s) - (0 if zero_based else 1)
+                except ValueError:
+                    raise ValueError(
+                        f"unsupported libsvm token {item!r} (ranking "
+                        "extensions like 'qid:' are not supported — "
+                        "strip them before loading)"
+                    ) from None
                 if idx < 0:  # match native parser: drop invalid indices
                     continue
                 entries[idx] = float(val_s)
@@ -94,11 +101,33 @@ def load_csv(
         # malformed fields behave identically (NaN) with or without a
         # toolchain
         pass
+    # mirror the native parser: the header is the first NON-blank
+    # line, and n_cols comes from the first data line — genfromtxt's
+    # raw-line skip_header would otherwise consume a leading blank and
+    # parse the real header into an all-NaN data row
+    skip = 0
+    n_cols = 0
+    with open(path) as f:
+        pending_header = skip_header
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            if pending_header:
+                skip = i + 1
+                pending_header = False
+                continue
+            n_cols = len(line.split(","))
+            break
+    if n_cols < 2:
+        raise ValueError(
+            f"CSV needs >= 2 columns (features + label), got {n_cols}"
+        )
     data = np.genfromtxt(
-        path, delimiter=",", skip_header=1 if skip_header else 0,
-        dtype=np.float32,
+        path, delimiter=",", skip_header=skip, dtype=np.float32,
     )
     if data.ndim == 1:
+        # exactly one data row (a single-COLUMN file cannot reach here
+        # — n_cols >= 2 was checked above)
         data = data[None, :]
     y = data[:, label_col]
     X = np.delete(data, label_col % data.shape[1], axis=1)

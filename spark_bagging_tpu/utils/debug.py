@@ -50,12 +50,16 @@ def disable_debug() -> None:
 
 @contextlib.contextmanager
 def debug_mode() -> Iterator[None]:
-    """Scoped :func:`enable_debug`/:func:`disable_debug`."""
+    """Scoped :func:`enable_debug`; restores the PRIOR state on exit,
+    so nesting inside a process-wide ``enable_debug()`` cannot silently
+    switch the user's debugging off."""
+    was_active = debug_active()
     enable_debug()
     try:
         yield
     finally:
-        disable_debug()
+        if not was_active:
+            disable_debug()
 
 
 def check_bootstrap_weights(w: jax.Array) -> None:

@@ -79,3 +79,55 @@ def test_load_dataset_from_file(tmp_path):
     p.write_text("1 1:1.0 2:2.0\n0 1:3.0 2:4.0\n")
     X, y = load_dataset(str(p))
     assert X.shape == (2, 2)
+
+
+class TestLoaderEdgeCases:
+    def test_csv_single_column_rejected_not_transposed(self, tmp_path):
+        """A multi-row single-column CSV must error, not silently load
+        as one transposed row."""
+        p = tmp_path / "col.csv"
+        p.write_text("1\n2\n3\n4\n5\n")
+        with pytest.raises(ValueError, match=">= 2 columns"):
+            load_csv(str(p))
+
+    def test_csv_header_after_blank_line(self, tmp_path):
+        """The header is the first NON-blank line (the native parser's
+        rule); the fallback must not parse it into an all-NaN row."""
+        p = tmp_path / "blank.csv"
+        p.write_text("\na,b,label\n1,2,3\n4,5,6\n")
+        X, y = load_csv(str(p), skip_header=True)
+        assert X.shape == (2, 2)
+        assert np.isfinite(X).all() and np.isfinite(y).all()
+        np.testing.assert_array_equal(y, [3.0, 6.0])
+
+    def test_libsvm_qid_clear_error(self, tmp_path):
+        p = tmp_path / "rank.svm"
+        p.write_text("3 qid:1 1:0.5 2:1.0\n")
+        from spark_bagging_tpu.utils.datasets import parse_libsvm
+
+        with pytest.raises(ValueError, match="qid"):
+            # force the Python fallback path deterministically
+            import spark_bagging_tpu.utils.native as nat
+            orig = nat.parse_libsvm_native
+            nat.parse_libsvm_native = lambda *a, **k: None
+            try:
+                parse_libsvm(str(p))
+            finally:
+                nat.parse_libsvm_native = orig
+
+
+def test_debug_mode_restores_prior_state():
+    from spark_bagging_tpu.utils import debug
+
+    debug.enable_debug()
+    try:
+        with debug.debug_mode():
+            assert debug.debug_active()
+        # a scoped block inside a process-wide enable must NOT turn
+        # the user's debugging off
+        assert debug.debug_active()
+    finally:
+        debug.disable_debug()
+    with debug.debug_mode():
+        assert debug.debug_active()
+    assert not debug.debug_active()
