@@ -7,6 +7,11 @@ TPU-native family on the same data and prints train/OOB scores.
 Run:  python examples/06_learner_zoo.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 from sklearn.datasets import load_breast_cancer, load_diabetes
 from sklearn.preprocessing import StandardScaler
