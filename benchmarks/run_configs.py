@@ -465,6 +465,18 @@ CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4,
            5: config_5, 6: config_6, 7: config_7}
 
 
+def merge_rows(results: list[dict],
+               prior_tpu: dict[int, dict]) -> list[dict]:
+    """Rows to persist after each config: this run's results first,
+    then every prior TPU row the run has not (re)measured — including
+    rows outside the resume set (stale generator, or a config whose
+    re-measure failed), which are immutable until a TPU run actually
+    replaces them [VERDICT r3 weak#2]."""
+    emitted = {r["config"] for r in results}
+    return results + [r for c2, r in sorted(prior_tpu.items())
+                      if c2 not in emitted]
+
+
 def _run_config_child(c: int, args, timeout_s: float):
     """Run one config isolated — an in-process hang would burn the
     watcher's whole suite timeout (7200 s at full scale) on one config;
@@ -546,27 +558,60 @@ def main() -> None:
     child_timeout = args.config_timeout or (
         600.0 if args.scale == "smoke" else 1800.0
     )
-    out = args.json_out or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        f"results_{args.scale}.json",
-    )
+    # TPU rows are immutable [VERDICT r3 weak#2]: a CPU rehearsal must
+    # never replace a captured TPU artifact in place (round 3 lost its
+    # r2 TPU smoke rows exactly this way). Non-TPU runs default to a
+    # separate *_cpu.json file; writing a non-TPU run over a file that
+    # holds ANY backend=="tpu" row is an error, not a silent skip.
+    if args.json_out is None and backend != "tpu":
+        out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"results_{args.scale}_{backend}.json",
+        )
+        print(json.dumps({
+            "note": f"backend is {backend!r}, not tpu — rehearsal "
+            f"rows go to {os.path.basename(out)}; the canonical "
+            f"results_{args.scale}.json holds TPU rows only",
+        }), file=sys.stderr)
+    else:
+        out = args.json_out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"results_{args.scale}.json",
+        )
     from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
 
     prior: dict[int, dict] = {}
-    if args.resume and os.path.exists(out):
+    prior_tpu: dict[int, dict] = {}  # ALL tpu rows, stale-gen included
+    prior_doc: dict = {}
+    if os.path.exists(out):
         try:
             with open(out) as f:
-                for r in json.load(f).get("results", []):
-                    # only real-accelerator results measured on the
-                    # CURRENT synthetic generator carry over — a
-                    # CPU-fallback or stale-generator row must be
-                    # re-measured
-                    if (r.get("backend") == "tpu"
-                            and r.get("datasets_version")
-                            == SYNTHETICS_VERSION):
-                        prior[r["config"]] = r
+                prior_doc = json.load(f)
+            for r in prior_doc.get("results", []):
+                if r.get("backend") == "tpu":
+                    prior_tpu[r["config"]] = r
+                # only real-accelerator results measured on the
+                # CURRENT synthetic generator carry over on
+                # --resume — a CPU-fallback or stale-generator row
+                # must be re-measured
+                if (args.resume and r.get("backend") == "tpu"
+                        and r.get("datasets_version")
+                        == SYNTHETICS_VERSION):
+                    prior[r["config"]] = r
         except Exception:  # noqa: BLE001 — corrupt file: start fresh
-            pass
+            prior_doc = {}
+    if backend != "tpu" and prior_tpu:
+        print(json.dumps({
+            "error": f"{out} holds TPU-captured rows; refusing to "
+            f"overwrite them with backend={backend!r} rows — point "
+            "--json-out at a rehearsal file instead",
+        }))
+        sys.exit(1)
+    # unknown top-level keys (e.g. a restored capture's provenance
+    # note) ride through every rewrite — this file is an accumulating
+    # artifact, not this run's scratch space
+    carry = {k: v for k, v in prior_doc.items()
+             if k not in ("scale", "results", "failures")}
     results, failures = [], []
     for c in wanted:
         if c in prior:
@@ -577,6 +622,17 @@ def main() -> None:
         res, error = _run_config_child(c, args, child_timeout)
         if error is None and res.get("error"):
             error, res = res["error"], None
+        # per-row immutability backstop: a child that silently fell
+        # off-TPU (tunnel died between probe and run) must not write a
+        # non-TPU row into a TPU-probed run's file — whether it would
+        # replace a captured row or pollute a first capture
+        if (error is None and backend == "tpu"
+                and res.get("backend") != "tpu"):
+            error, res = (
+                f"config {c} ran on backend={res.get('backend')!r} "
+                "in a TPU-probed suite (tunnel fell over mid-run?); "
+                "discarding the off-TPU row", None,
+            )
         if error is not None:
             # a dropped TPU tunnel, OOM, or hang on one config must not
             # lose the finished ones
@@ -589,12 +645,10 @@ def main() -> None:
         # INCLUDING prior-window rows the loop has not reached yet — a
         # kill mid-suite must not lose cross-window progress (the
         # sweep's `rest` rule, applied to config rows)
-        emitted = {r["config"] for r in results}
-        rest = [r for c2, r in sorted(prior.items())
-                if c2 not in emitted]
         with open(out, "w") as f:
             json.dump(
-                {"scale": args.scale, "results": results + rest,
+                {**carry, "scale": args.scale,
+                 "results": merge_rows(results, prior_tpu),
                  "failures": failures},
                 f, indent=2,
             )

@@ -99,10 +99,15 @@ while true; do
       echo "tune try=$tries_tune rc=$rc $(date -u +%H:%M:%S)" >> "$log"
     fi
     if ! settled bench_done "$tries_bench" && alive; then
-      # 1800 > bench.py's --measure-timeout (1500) + probe + baselines:
-      # let bench.py's own child isolation report a wedge as a JSON
-      # error line rather than being killed from outside mid-write
-      timeout 1800 python bench.py > benchmarks/bench_latest.json 2>/dev/null
+      # the watcher just confirmed aliveness, so bench gets a SHORT
+      # probe deadline (the driver-default 1500s poll is for the
+      # driver's one-shot invocation). Outer budget: probe phase worst
+      # case ~600s (each attempt = up to 120s flock wait + 120s init,
+      # plus the inter-attempt sleep), cold CPU-baseline re-measure
+      # ~400s, measure-timeout 1500s → 3000 leaves headroom so
+      # bench.py's own child isolation reports a wedge as a JSON error
+      # line rather than being killed from outside mid-write
+      timeout 3000 python bench.py --probe-deadline 240 > benchmarks/bench_latest.json 2>/dev/null
       rc=$?
       tries_bench=$((tries_bench + $(count_if_real_failure bench_done)))
       echo "bench try=$tries_bench rc=$rc $(date -u +%H:%M:%S)" >> "$log"

@@ -26,8 +26,24 @@ def order_cells(grid, prior_err):
     """Never-attempted cells first, previously-errored cells last: a
     persistently hanging early cell must not starve the rest of the
     grid under the watcher's outer timeout (each errored retry can
-    cost CELL_TIMEOUT_S). Stable within each group."""
-    return sorted(grid, key=lambda k: k in prior_err)
+    cost CELL_TIMEOUT_S). Within the never-attempted group, one cell
+    per untried impl leads (pallas, then packed): the first real
+    Mosaic compile of ops/gram.py is an untested event, so it must
+    happen while the window still has time to fall back — not after
+    the blocked grid has consumed it. Stable within each group."""
+    first_of_impl = {}
+    for spec in grid:
+        if spec not in prior_err:
+            first_of_impl.setdefault(spec[0], spec)
+    derisk_impls = ("pallas", "packed")
+    derisk = {first_of_impl[i]: rank
+              for rank, i in enumerate(derisk_impls)
+              if i in first_of_impl}
+    # default rank is a constant PAST every promotion rank — len(derisk)
+    # would tie with the last promoted cell when an impl has no untried
+    # cells left, silently demoting the other impl's promotion
+    return sorted(grid, key=lambda k: (k in prior_err,
+                                       derisk.get(k, len(derisk_impls))))
 
 
 # cell = (impl, chunk, row_tile, max_iter, init)

@@ -937,7 +937,8 @@ class _BaseBagging(ParamsMixin):
             ratio=ratio, replacement=replacement,
         ))
 
-    def _stream_chunks(self, source, chunk_rows=None, prefetch: int = 2):
+    def _stream_chunks(self, source, chunk_rows=None, prefetch: int = 2,
+                       drop_aux_col: bool | None = None):
         """Validated chunk iterator for the streaming predict/score
         paths (the reference's ``transform`` over a distributed
         DataFrame [SURVEY §3.2] — here any ChunkSource / (X, y) pair;
@@ -955,19 +956,51 @@ class _BaseBagging(ParamsMixin):
         # and OOB passes do (split_aux_col's convention). An explicitly
         # prefetch-wrapped source gets the drop spliced INSIDE the wrap
         # (keeping its configured depth) — the contract must not depend
-        # on whether the caller wrapped first.
+        # on whether the caller wrapped first. The trigger is a WIDTH
+        # heuristic, so auto mode (drop_aux_col=None) warns when it
+        # engages and ``drop_aux_col=False`` turns it off for callers
+        # scoring a genuinely (n_features_in_+1)-wide dataset.
         aux_col = getattr(self, "_stream_aux_col", None)
-        if (aux_col is not None
+        if (aux_col is not None and drop_aux_col is not False
                 and source.n_features == self.n_features_in_ + 1):
             from spark_bagging_tpu.utils.io import DropColumnChunks
 
+            if drop_aux_col is None:
+                import sys
+                import warnings
+
+                # attribute the warning to the first frame OUTSIDE
+                # this module — the public stream methods sit at
+                # different depths above here (predict_stream routes
+                # through predict_proba_stream), so a fixed stacklevel
+                # would blame library code for some call paths
+                level, frame = 1, sys._getframe(0)
+                while (frame.f_back is not None
+                       and frame.f_globals.get("__name__") == __name__):
+                    frame = frame.f_back
+                    level += 1
+                warnings.warn(
+                    f"source is one column wider than the fit; "
+                    f"dropping column {aux_col} as the aux channel the "
+                    "model was stream-fitted with (pass "
+                    "drop_aux_col=False if this is a different "
+                    "dataset, or drop_aux_col=True to silence)",
+                    stacklevel=level,
+                )
             if already_wrapped:
-                source = PrefetchChunks(
-                    DropColumnChunks(source._inner, aux_col),
-                    depth=source._depth,
+                source = source.rewrap(
+                    lambda inner: DropColumnChunks(inner, aux_col)
                 )
             else:
                 source = DropColumnChunks(source, aux_col)
+        elif drop_aux_col:
+            raise ValueError(
+                "drop_aux_col=True but the model was not stream-fitted "
+                "with an aux column" if aux_col is None else
+                f"drop_aux_col=True needs a source with "
+                f"{self.n_features_in_ + 1} columns (fitted features + "
+                f"aux), got {source.n_features}"
+            )
         if source.n_features != self.n_features_in_:
             raise ValueError(
                 f"source has {source.n_features} features; the ensemble "
@@ -1210,13 +1243,17 @@ class BaggingClassifier(_BaseBagging):
         return proba
 
     def predict_proba_stream(self, source, chunk_rows=None, *,
-                             prefetch: int = 2) -> np.ndarray:
+                             prefetch: int = 2,
+                             drop_aux_col: bool | None = None) -> np.ndarray:
         """Out-of-core ``predict_proba``: aggregate chunk by chunk —
-        only one chunk is ever resident on device."""
+        only one chunk is ever resident on device. ``drop_aux_col``:
+        None = auto-drop a stream-fitted aux column (with a warning)
+        when the source is one column wider than the fit; True/False
+        force the behavior either way."""
         out = [
             self.predict_proba(Xc[:n])
             for Xc, _, n in self._stream_chunks(
-                source, chunk_rows, prefetch
+                source, chunk_rows, prefetch, drop_aux_col
             ).chunks()
         ]
         if not out:
@@ -1224,18 +1261,21 @@ class BaggingClassifier(_BaseBagging):
         return np.concatenate(out)
 
     def predict_stream(self, source, chunk_rows=None, *,
-                       prefetch: int = 2) -> np.ndarray:
+                       prefetch: int = 2,
+                       drop_aux_col: bool | None = None) -> np.ndarray:
         proba = self.predict_proba_stream(
-            source, chunk_rows, prefetch=prefetch
+            source, chunk_rows, prefetch=prefetch,
+            drop_aux_col=drop_aux_col,
         )
         return self.classes_[proba.argmax(axis=1)]
 
     def score_stream(self, source, chunk_rows=None, *,
-                     prefetch: int = 2) -> float:
+                     prefetch: int = 2,
+                     drop_aux_col: bool | None = None) -> float:
         """Out-of-core accuracy over a labeled ChunkSource."""
         correct = total = 0
         for Xc, yc, n in self._stream_chunks(
-            source, chunk_rows, prefetch
+            source, chunk_rows, prefetch, drop_aux_col
         ).chunks():
             pred = self.predict(Xc[:n])
             correct += int((np.asarray(yc[:n]) == pred).sum())
@@ -1425,12 +1465,16 @@ class BaggingRegressor(_BaseBagging):
         return np.asarray(agg(self.ensemble_, self.subspaces_, X))
 
     def predict_stream(self, source, chunk_rows=None, *,
-                       prefetch: int = 2) -> np.ndarray:
-        """Out-of-core ``predict``: one chunk resident at a time."""
+                       prefetch: int = 2,
+                       drop_aux_col: bool | None = None) -> np.ndarray:
+        """Out-of-core ``predict``: one chunk resident at a time.
+        ``drop_aux_col``: None = auto-drop a stream-fitted aux column
+        (with a warning) when the source is one column wider than the
+        fit; True/False force the behavior either way."""
         out = [
             self.predict(Xc[:n])
             for Xc, _, n in self._stream_chunks(
-                source, chunk_rows, prefetch
+                source, chunk_rows, prefetch, drop_aux_col
             ).chunks()
         ]
         if not out:
@@ -1438,7 +1482,8 @@ class BaggingRegressor(_BaseBagging):
         return np.concatenate(out)
 
     def score_stream(self, source, chunk_rows=None, *,
-                     prefetch: int = 2) -> float:
+                     prefetch: int = 2,
+                     drop_aux_col: bool | None = None) -> float:
         """Out-of-core R² from one-pass accumulated moments, shifted
         by the first chunk's target mean — raw Σy² − (Σy)²/n cancels
         catastrophically for large-mean targets."""
@@ -1446,7 +1491,7 @@ class BaggingRegressor(_BaseBagging):
         shift = None
         s_yd = s_yd2 = s_res = 0.0
         for Xc, yc, n in self._stream_chunks(
-            source, chunk_rows, prefetch
+            source, chunk_rows, prefetch, drop_aux_col
         ).chunks():
             yv = np.asarray(yc[:n], np.float64)
             pred = np.asarray(self.predict(Xc[:n]), np.float64)

@@ -197,24 +197,50 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
     # LOADS without error; (b) a re-save under a different compression
     # setting left the other format's arrays file behind, and
     # _read_arrays prefers .zst — silently loading the older weights.
+    import glob
     import shutil
 
+    # Reap tmp debris from DEAD processes only: a live concurrent
+    # saver's in-progress tmp dir must not be pulled out from under it
+    # (saves to the same path are serialized by the multihost
+    # single-writer rule above; cross-process-tree writers should use
+    # distinct paths).
     tmp = f"{path}.tmp.{os.getpid()}"
+    for stale in glob.glob(glob.escape(path) + ".tmp.*"):
+        suffix = stale.rsplit(".", 1)[1]
+        # only dirs save_model itself names (integer pid suffix) are
+        # candidates — anything else is the user's, not debris
+        if (stale == tmp or not suffix.isdigit()
+                or not os.path.isdir(stale)):
+            continue
+        try:
+            os.kill(int(suffix), 0)  # raises if no such process
+        except ProcessLookupError:
+            shutil.rmtree(stale, ignore_errors=True)
+        except PermissionError:
+            pass  # pid exists under another uid: leave it
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     _write_arrays(tmp, serialization.msgpack_serialize(tree), compress)
+    # `path + ".old"` is the pid-INDEPENDENT crash-recovery slot: a
+    # crash between the two swap renames leaves the previous complete
+    # checkpoint there, where load_model falls back to. It is only
+    # removed once a newer complete checkpoint is installed at `path`
+    # — never before (the new tmp build above can itself crash).
+    old = f"{path}.old"
     if os.path.exists(path):
-        old = f"{path}.old.{os.getpid()}"
-        if os.path.exists(old):
-            shutil.rmtree(old)
+        if os.path.isdir(old):
+            shutil.rmtree(old)  # `path` is intact: the slot is stale
         os.replace(path, old)
         os.replace(tmp, path)
         shutil.rmtree(old)
     else:
         os.replace(tmp, path)
+        if os.path.isdir(old):
+            shutil.rmtree(old)  # recovery slot superseded by this save
 
 
 def load_model(path: str, *, mesh=None) -> Any:
@@ -224,6 +250,19 @@ def load_model(path: str, *, mesh=None) -> Any:
     """
     from flax import serialization  # lazy: keep flax off the import path
 
+    if (not os.path.exists(os.path.join(path, "manifest.json"))
+            and os.path.isdir(f"{path}.old")):
+        # a save that crashed between its two swap renames leaves the
+        # previous complete checkpoint at `path + ".old"` — recover it
+        # rather than failing on the empty slot
+        import warnings
+
+        warnings.warn(
+            f"checkpoint missing at {path!r}; loading the previous "
+            f"version from {path + '.old'!r} (a save crashed mid-swap)",
+            stacklevel=2,
+        )
+        path = f"{path}.old"
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     if manifest["format_version"] > _FORMAT_VERSION:

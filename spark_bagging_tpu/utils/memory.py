@@ -84,8 +84,10 @@ def auto_chunk_size(
         budget_bytes = device_memory_budget()
     if per * reps_local <= budget_bytes:
         return None  # everything fits: keep the vmap fast path
-    # the chunk is a batch size over the GLOBAL replica axis, but the
-    # budget bounds PER-DEVICE residency — a replica-sharded mesh holds
-    # only chunk/replica of each batch per device, so scale back up or
-    # the fit runs `replica`× more scan steps than HBM requires
-    return max(1, min(n_replicas, int(budget_bytes // per) * replica))
+    # chunk_size reaches lax.map INSIDE the shard_map body
+    # (sharded.py in_specs shard replica ids P(REPLICA_AXIS) before
+    # ensemble.map_replicas batches them), so `chunk` replicas are
+    # resident PER DEVICE — the budget bounds the chunk directly, with
+    # no replica-axis scale-up, and a chunk ≥ the local replica count
+    # degenerates to vmap-all of the local shard
+    return max(1, min(reps_local, int(budget_bytes // per)))

@@ -51,6 +51,13 @@ class PrefetchChunks(ChunkSource):
     def n_chunks(self) -> int:
         return self._inner.n_chunks
 
+    def rewrap(self, transform) -> "PrefetchChunks":
+        """New ``PrefetchChunks`` at the same depth over
+        ``transform(inner_source)`` — the public way to splice a chunk
+        transformation INSIDE an existing wrap (bagging's aux-column
+        drop) without coupling callers to this class's internals."""
+        return PrefetchChunks(transform(self._inner), depth=self._depth)
+
     def chunks(self):
         q: queue.Queue[Any] = queue.Queue(maxsize=self._depth)
         stop = threading.Event()

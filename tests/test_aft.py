@@ -7,6 +7,8 @@ the aux threading through the ensemble engine (validation, bagging,
 replica-mesh equality, persistence).
 """
 
+import warnings
+
 import jax
 import numpy as np
 import pytest
@@ -252,11 +254,30 @@ def test_streamed_aft_scores_its_own_training_source():
         n_estimators=3, seed=0,
     ).fit_stream((Xs, y), chunk_rows=256, n_epochs=5, aux_col=-1)
 
-    preds = reg.predict_stream((Xs, y), chunk_rows=256)
+    # the width-heuristic auto-drop warns when it engages (round-3
+    # advisor: a genuinely-wider different dataset would otherwise be
+    # silently mis-scored); drop_aux_col=True opts in silently
+    with pytest.warns(UserWarning, match="dropping column"):
+        preds = reg.predict_stream((Xs, y), chunk_rows=256)
     assert preds.shape == (len(y),)
     # matches predicting on the narrow matrix directly
     np.testing.assert_allclose(preds, reg.predict(X), rtol=1e-5)
-    assert np.isfinite(reg.score_stream((Xs, y), chunk_rows=256))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        np.testing.assert_allclose(
+            reg.predict_stream((Xs, y), chunk_rows=256,
+                               drop_aux_col=True),
+            preds, rtol=1e-5,
+        )
+        assert np.isfinite(reg.score_stream((Xs, y), chunk_rows=256,
+                                            drop_aux_col=True))
+    # the escape hatch: a caller scoring a dataset that HAPPENS to be
+    # one column wider gets the width error, not a silent column drop
+    with pytest.raises(ValueError, match="features"):
+        reg.predict_stream((Xs, y), chunk_rows=256, drop_aux_col=False)
+    # ...and force-drop on a narrow source is an explicit error too
+    with pytest.raises(ValueError, match="drop_aux_col"):
+        reg.predict_stream((X, y), chunk_rows=256, drop_aux_col=True)
     # a narrow (already aux-free) source keeps working too
     np.testing.assert_allclose(
         reg.predict_stream((X, y), chunk_rows=256), preds, rtol=1e-5

@@ -152,6 +152,52 @@ def test_auto_chunk_resolution_survives_roundtrip(tmp_path, iris):
     np.testing.assert_array_equal(loaded.predict(X), clf.predict(X))
 
 
+def test_crash_mid_swap_recovers_previous_checkpoint(tmp_path, iris):
+    """The save swap is two renames; a crash between them leaves the
+    previous complete checkpoint at the pid-INDEPENDENT ``path.old``,
+    which load_model falls back to (round-3 advisor finding) and the
+    next successful save cleans up along with any stale tmp debris."""
+    import os
+    import shutil
+
+    X, y = iris
+    path = str(tmp_path / "m")
+    a = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    save_model(a, path)
+    # simulate the crash window: path renamed away, replacement not in
+    shutil.move(path, path + ".old")
+    # plus tmp debris from a DEAD process (reaping is pid-liveness
+    # gated so a live concurrent saver's tmp is never pulled away)
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen([sys.executable, "-c", ""])
+    proc.wait()
+    debris = f"{path}.tmp.{proc.pid}"
+    os.makedirs(debris)
+    # and tmp debris from a LIVE process, which must survive the save
+    live = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    live_tmp = f"{path}.tmp.{live.pid}"
+    os.makedirs(live_tmp)
+    with pytest.warns(UserWarning, match="mid-swap"):
+        loaded = load_model(path)
+    np.testing.assert_array_equal(loaded.predict(X), a.predict(X))
+    # a later save from ANY process heals the slot and clears dead
+    # debris — but only AFTER its own install (the recovery slot must
+    # survive a crash during the new save's build), and never a live
+    # process's tmp
+    b = BaggingClassifier(n_estimators=4, seed=1).fit(X, y)
+    try:
+        save_model(b, path)
+        assert not os.path.exists(path + ".old")
+        assert not os.path.exists(debris)
+        assert os.path.exists(live_tmp)
+    finally:
+        live.kill()
+        live.wait()
+    np.testing.assert_array_equal(load_model(path).predict(X), b.predict(X))
+
+
 def test_resave_under_other_compression_never_loads_stale(tmp_path, iris):
     """A re-save must atomically replace the whole checkpoint dir: the
     old run's arrays file in the OTHER compression format must not

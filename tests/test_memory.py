@@ -38,6 +38,30 @@ def test_headline_calibration_v5e():
     assert chunk is not None and 100 <= chunk < 500
 
 
+def test_replica_mesh_budget_bounds_per_device_chunk():
+    """chunk_size batches replicas INSIDE the shard_map body, after
+    the replica axis is sharded — so a tight budget must bound the
+    per-DEVICE chunk with no replica-axis scale-up (regression for the
+    round-3 advisor's over-admission finding)."""
+    import jax
+
+    from spark_bagging_tpu.parallel.mesh import make_mesh
+
+    learner = LogisticRegression()
+    per = learner.fit_workset_bytes(100_000, 54, 7)
+    mesh = make_mesh(data=1, replica=4, devices=jax.devices()[:4])
+    # budget admits exactly 12 replicas' worksets per device
+    chunk = auto_chunk_size(
+        learner, 100_000, 54, 7, 1000, mesh=mesh, budget_bytes=per * 12
+    )
+    assert chunk == 12
+    # chunk never exceeds the local replica count (vmap-all beyond it)
+    chunk = auto_chunk_size(
+        learner, 100_000, 54, 7, 16, mesh=mesh, budget_bytes=per * 12
+    )
+    assert chunk is None or chunk <= 4
+
+
 def test_unmodeled_learner_stays_legacy():
     class Custom(LogisticRegression):
         def fit_workset_bytes(self, n_rows, n_features, n_outputs):
