@@ -49,8 +49,9 @@ sys.path.insert(0, ".")
 from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
 d = json.load(open("benchmarks/results_smoke.json"))
 rs = d.get("results", [])
-# CPU-fallback or stale-generator rows must not settle the stage
-ok = len(rs) >= 7 and all(
+# CPU-fallback or stale-generator rows must not settle the stage;
+# 8 = the five BASELINE configs + forest + bagged GBT + out-of-core
+ok = len(rs) >= 8 and all(
     r.get("backend") == "tpu"
     and r.get("datasets_version") == SYNTHETICS_VERSION for r in rs)
 sys.exit(0 if ok else 1)
@@ -64,8 +65,9 @@ sys.path.insert(0, ".")
 from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
 d = json.load(open("benchmarks/results_full.json"))
 rs = d.get("results", [])
-# CPU-fallback or stale-generator rows must not settle the stage
-ok = len(rs) >= 7 and all(
+# CPU-fallback or stale-generator rows must not settle the stage;
+# 8 = the five BASELINE configs + forest + bagged GBT + out-of-core
+ok = len(rs) >= 8 and all(
     r.get("backend") == "tpu"
     and r.get("datasets_version") == SYNTHETICS_VERSION for r in rs)
 sys.exit(0 if ok else 1)

@@ -103,6 +103,26 @@ class TestSweepOrdering:
         )
         assert order3[0][0] == "packed"
 
+    def test_watcher_suite_done_checks_cover_all_configs(self):
+        # the smoke/full done-checks must demand a row for EVERY config
+        # run_configs defines — a new config must not let a shorter
+        # capture settle the stage
+        import run_configs
+
+        n = len(run_configs.CONFIGS)
+        assert n == 8  # 5 BASELINE + forest + bagged GBT + out-of-core
+        src = open(os.path.join(REPO, "benchmarks", "tpu_watch.sh")).read()
+        assert src.count(f"len(rs) >= {n}") == 2, (
+            "smoke_done/full_done thresholds out of step with CONFIGS"
+        )
+        parser_default = [
+            ln for ln in open(
+                os.path.join(REPO, "benchmarks", "run_configs.py")
+            ) if '"--configs"' in ln
+        ][0]
+        assert ",".join(str(c) for c in sorted(run_configs.CONFIGS)) \
+            in parser_default
+
     def test_watcher_done_check_derives_from_grid(self):
         # tune_done must stay coupled to the actual grid and workload
         # stamp — a hardcoded count or stamp-blind count would let a
@@ -342,6 +362,42 @@ class TestConfigResumePersist:
         )
         rows6 = [r for r in merged if r["config"] == 6]
         assert len(rows6) == 1 and rows6[0]["datasets_version"] == "v-now"
+
+    def test_cpu_run_refuses_canonical_name_even_when_missing(self, tmp_path):
+        """The watcher passes --json-out results_full.json explicitly;
+        a CPU-fallback run must refuse the canonical NAME outright —
+        a first capture must not be seeded with cpu rows."""
+        import subprocess
+
+        out = tmp_path / "results_smoke.json"  # does not exist
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "run_configs.py"),
+             "--configs", "1", "--platform", "cpu",
+             "--json-out", str(out)],
+            capture_output=True, text=True, timeout=500, cwd=REPO,
+        )
+        assert proc.returncode == 1
+        assert "canonical" in proc.stdout
+        assert not out.exists()
+
+    def test_cpu_run_refuses_corrupt_artifact(self, tmp_path):
+        """An unreadable artifact may be a damaged TPU capture — a
+        rehearsal refuses rather than paving over it."""
+        import subprocess
+
+        out = tmp_path / "results.json"
+        out.write_text('{"scale": "smoke", "results": [{"backe')
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "run_configs.py"),
+             "--configs", "1", "--platform", "cpu",
+             "--json-out", str(out)],
+            capture_output=True, text=True, timeout=500, cwd=REPO,
+        )
+        assert proc.returncode == 1
+        assert "cannot be parsed" in proc.stdout
+        assert out.read_text().startswith('{"scale"')  # untouched
 
     def test_non_tpu_backend_redirects_default_out(self):
         """Without --json-out, a non-TPU run must land in
