@@ -708,9 +708,10 @@ def main() -> None:
     # rows when the tunnel dies between the watcher's liveness check
     # and the probe)
     if (backend != "tpu"
-            and os.path.basename(out) == f"results_{args.scale}.json"):
+            and os.path.basename(out) in ("results_smoke.json",
+                                          "results_full.json")):
         print(json.dumps({
-            "error": f"{out} is the canonical TPU capture file; "
+            "error": f"{out} is a canonical TPU capture file name; "
             f"refusing to write backend={backend!r} rows to it — "
             f"rehearsals belong in results_{args.scale}_{backend}.json",
         }))

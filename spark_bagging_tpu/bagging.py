@@ -30,6 +30,7 @@ from __future__ import annotations
 import functools
 import logging
 import time
+from contextlib import closing
 from typing import Any
 
 import jax
@@ -220,7 +221,11 @@ class _EncodedChunks:
         return self._inner.n_chunks
 
     def chunks(self):
-        for X, y, n_valid in self._inner.chunks():
+        return self.chunks_from(0)
+
+    def chunks_from(self, start: int):
+        # delegate the seek (O(1) on random-access inner sources)
+        for X, y, n_valid in self._inner.chunks_from(start):
             idx = np.searchsorted(self._classes, y)
             idx_c = np.minimum(idx, len(self._classes) - 1)
             bad = self._classes[idx_c[:n_valid]] != y[:n_valid]
@@ -1178,8 +1183,9 @@ class BaggingClassifier(_BaseBagging):
         source = as_chunk_source(source, chunk_rows)
         if classes is None:
             seen: set = set()
-            for _, y, n_valid in source.chunks():
-                seen.update(np.unique(y[:n_valid]).tolist())
+            with closing(source.chunks()) as chunk_iter:
+                for _, y, n_valid in chunk_iter:
+                    seen.update(np.unique(y[:n_valid]).tolist())
             classes = sorted(seen)
         classes = np.asarray(classes)
         if classes.ndim != 1 or len(classes) < 2:
@@ -1250,12 +1256,12 @@ class BaggingClassifier(_BaseBagging):
         None = auto-drop a stream-fitted aux column (with a warning)
         when the source is one column wider than the fit; True/False
         force the behavior either way."""
-        out = [
-            self.predict_proba(Xc[:n])
-            for Xc, _, n in self._stream_chunks(
+        with closing(
+            self._stream_chunks(
                 source, chunk_rows, prefetch, drop_aux_col
             ).chunks()
-        ]
+        ) as chunk_iter:
+            out = [self.predict_proba(Xc[:n]) for Xc, _, n in chunk_iter]
         if not out:
             raise ValueError("source yielded no chunks")
         return np.concatenate(out)
@@ -1274,12 +1280,15 @@ class BaggingClassifier(_BaseBagging):
                      drop_aux_col: bool | None = None) -> float:
         """Out-of-core accuracy over a labeled ChunkSource."""
         correct = total = 0
-        for Xc, yc, n in self._stream_chunks(
-            source, chunk_rows, prefetch, drop_aux_col
-        ).chunks():
-            pred = self.predict(Xc[:n])
-            correct += int((np.asarray(yc[:n]) == pred).sum())
-            total += int(n)
+        with closing(
+            self._stream_chunks(
+                source, chunk_rows, prefetch, drop_aux_col
+            ).chunks()
+        ) as chunk_iter:
+            for Xc, yc, n in chunk_iter:
+                pred = self.predict(Xc[:n])
+                correct += int((np.asarray(yc[:n]) == pred).sum())
+                total += int(n)
         if total == 0:
             raise ValueError("source yielded no chunks")
         return correct / total
@@ -1471,12 +1480,12 @@ class BaggingRegressor(_BaseBagging):
         ``drop_aux_col``: None = auto-drop a stream-fitted aux column
         (with a warning) when the source is one column wider than the
         fit; True/False force the behavior either way."""
-        out = [
-            self.predict(Xc[:n])
-            for Xc, _, n in self._stream_chunks(
+        with closing(
+            self._stream_chunks(
                 source, chunk_rows, prefetch, drop_aux_col
             ).chunks()
-        ]
+        ) as chunk_iter:
+            out = [self.predict(Xc[:n]) for Xc, _, n in chunk_iter]
         if not out:
             raise ValueError("source yielded no chunks")
         return np.concatenate(out)
@@ -1490,18 +1499,21 @@ class BaggingRegressor(_BaseBagging):
         n_tot = 0
         shift = None
         s_yd = s_yd2 = s_res = 0.0
-        for Xc, yc, n in self._stream_chunks(
-            source, chunk_rows, prefetch, drop_aux_col
-        ).chunks():
-            yv = np.asarray(yc[:n], np.float64)
-            pred = np.asarray(self.predict(Xc[:n]), np.float64)
-            if shift is None:
-                shift = float(yv.mean()) if n else 0.0
-            yd = yv - shift
-            n_tot += int(n)
-            s_yd += float(yd.sum())
-            s_yd2 += float((yd**2).sum())
-            s_res += float(((yv - pred) ** 2).sum())
+        with closing(
+            self._stream_chunks(
+                source, chunk_rows, prefetch, drop_aux_col
+            ).chunks()
+        ) as chunk_iter:
+            for Xc, yc, n in chunk_iter:
+                yv = np.asarray(yc[:n], np.float64)
+                pred = np.asarray(self.predict(Xc[:n]), np.float64)
+                if shift is None:
+                    shift = float(yv.mean()) if n else 0.0
+                yd = yv - shift
+                n_tot += int(n)
+                s_yd += float(yd.sum())
+                s_yd2 += float((yd**2).sum())
+                s_res += float(((yv - pred) ** 2).sum())
         if n_tot == 0:
             raise ValueError("source yielded no chunks")
         ss_tot = s_yd2 - s_yd**2 / n_tot

@@ -31,6 +31,7 @@ import json
 import os
 import shutil
 import time
+from contextlib import closing
 from typing import Any
 
 import jax
@@ -104,8 +105,11 @@ def _save_stream_checkpoint(
         "opt_state": serialization.to_state_dict(
             jax.tree.map(to_host, opt_state)
         ),
+        # losses arrive pre-gathered (the caller keeps a host mirror,
+        # extended incrementally — re-gathering the whole list per
+        # snapshot was quadratic in chunk count)
         "final_epoch_losses": (
-            np.stack([to_host(l) for l in losses])
+            np.stack([np.asarray(l) for l in losses])
             if losses else np.zeros((0, 0), np.float32)
         ),
     }
@@ -143,7 +147,21 @@ def save_snapshot(path: str, tree: Any, meta: dict) -> None:
 
     if jax.process_index() != 0:
         return
+    import glob
+
     tmp = f"{path}.tmp.{os.getpid()}"
+    # reap multi-GB tmp debris left by DEAD processes' mid-write kills
+    # (pid-liveness gated, exactly as utils/checkpoint.py does)
+    for stale in glob.glob(glob.escape(path) + ".tmp.*"):
+        suffix = stale.rsplit(".", 1)[1]
+        if stale == tmp or not suffix.isdigit() or not os.path.isdir(stale):
+            continue
+        try:
+            os.kill(int(suffix), 0)
+        except ProcessLookupError:
+            shutil.rmtree(stale, ignore_errors=True)
+        except PermissionError:
+            pass
     os.makedirs(tmp, exist_ok=True)
     with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
         f.write(serialization.msgpack_serialize(tree))
@@ -152,14 +170,21 @@ def save_snapshot(path: str, tree: Any, meta: dict) -> None:
     # Never leave a window with no valid snapshot: move the previous
     # one aside, install the new one, then drop the old. A kill between
     # the two renames leaves `path.old`, which load falls back to.
+    # After a PRIOR mid-swap crash (`path` missing, `path.old` the only
+    # survivor), `.old` must outlive everything until the new snapshot
+    # is INSTALLED — rmtree'ing it up front would reopen the
+    # zero-valid-snapshot window this dance exists to close.
     old = f"{path}.old"
-    if os.path.isdir(old):
-        shutil.rmtree(old)
     if os.path.isdir(path):
+        if os.path.isdir(old):
+            shutil.rmtree(old)  # `path` is intact: the slot is stale
         os.replace(path, old)
-    os.replace(tmp, path)
-    if os.path.isdir(old):
+        os.replace(tmp, path)
         shutil.rmtree(old)
+    else:
+        os.replace(tmp, path)
+        if os.path.isdir(old):
+            shutil.rmtree(old)  # superseded by the snapshot just installed
 
 
 def _load_stream_checkpoint(path: str) -> tuple[dict, dict]:
@@ -285,12 +310,21 @@ def fit_ensemble_stream(
         "bootstrap_features": bootstrap_features,
         "chunk_rows": chunk_rows,
         "n_features": n_features,
+        # stream length is part of the fit's identity: resuming against
+        # a shorter/longer source would silently skip (or double-visit)
+        # chunks while passing every other check (round-4 audit)
+        "n_rows": source.n_rows,
+        "n_chunks": source.n_chunks,
         "aux_col": aux_col,
         "learner": learner_fingerprint(learner),
     }
 
     start_epoch, start_chunk = 0, 0
     final_epoch_losses: list[jax.Array] = []
+    # host-side mirror of final_epoch_losses, extended lazily at
+    # snapshot time: re-gathering the whole list per snapshot was
+    # O(n_chunks²/checkpoint_every) device syncs (round-4 audit)
+    host_losses: list[np.ndarray] = []
     if resume_from is not None:
         from flax import serialization
 
@@ -303,6 +337,11 @@ def fit_ensemble_stream(
         saved_cfg.setdefault("aux_col", None)
         if saved_cfg["aux_col"] is not None:
             saved_cfg["aux_col"] %= source.n_features
+        # pre-round-4 snapshots predate stream-length validation:
+        # accept them at the current source's values (no worse than
+        # their own era), so only NEW snapshots enforce the length
+        saved_cfg.setdefault("n_rows", source.n_rows)
+        saved_cfg.setdefault("n_chunks", source.n_chunks)
         check_resume_config(meta, config, resume_from)
         params = serialization.from_state_dict(params, tree["params"])
         opt_state = serialization.from_state_dict(
@@ -312,6 +351,7 @@ def fit_ensemble_stream(
         final_epoch_losses = [
             jnp.asarray(l) for l in tree["final_epoch_losses"]
         ]
+        host_losses = [np.asarray(l) for l in tree["final_epoch_losses"]]
     # Learners pin MXU matmul precision (the TPU bf16-default hazard —
     # see models/logistic.py); the streamed gradient steps honor the
     # same knob.
@@ -395,9 +435,15 @@ def fit_ensemble_stream(
     compile_seconds = None
     steps_done = 0
     for epoch in range(start_epoch, n_epochs):
-        for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
-            if epoch == start_epoch and c < start_chunk:
-                continue  # replay: already consumed before the snapshot
+        # resume seeks straight to the cursor (O(1) on random-access
+        # sources; discard-scan elsewhere) instead of re-ingesting and
+        # dropping every pre-cursor chunk; `closing` makes prefetch
+        # teardown deterministic when a chunk step raises
+        offset = start_chunk if epoch == start_epoch else 0
+        seen = offset - 1
+        with closing(source.chunks_from(offset)) as chunk_iter:
+          for c, (Xc, yc, n_valid) in enumerate(chunk_iter, start=offset):
+            seen = c
             Xc, auxc = split_aux_col(Xc, aux_col)
             if x_sharding is not None:
                 # host chunk → ONE global placement (multihost-safe:
@@ -431,8 +477,15 @@ def fit_ensemble_stream(
                 nxt_epoch, nxt_chunk = epoch, c + 1
                 if nxt_chunk >= n_chunks:
                     nxt_epoch, nxt_chunk = epoch + 1, 0
+                # gather only losses recorded since the last snapshot
+                # (the to_host calls are collective: every process
+                # appends identically, so the mirrors stay in step)
+                host_losses.extend(
+                    to_host(l)
+                    for l in final_epoch_losses[len(host_losses):]
+                )
                 _save_stream_checkpoint(
-                    checkpoint_dir, params, opt_state, final_epoch_losses,
+                    checkpoint_dir, params, opt_state, host_losses,
                     {
                         "config": config,
                         "epoch": nxt_epoch,
@@ -440,6 +493,18 @@ def fit_ensemble_stream(
                         "steps_done": steps_done,
                     },
                 )
+        # the declared n_chunks drives the resume cursor's epoch
+        # rollover; a source that yields a different count than it
+        # declares would silently skip or double-visit chunks across a
+        # resume — fail the fit loudly instead (round-4 audit)
+        if seen + 1 != n_chunks:
+            raise ValueError(
+                f"source yielded {seen + 1 - offset} chunk(s) for an "
+                f"epoch spanning chunks [{offset}, {n_chunks}) — it "
+                f"declares n_chunks={n_chunks} (n_rows={source.n_rows}, "
+                f"chunk_rows={chunk_rows}); a miscounted source breaks "
+                "checkpoint-resume exactness"
+            )
     if not final_epoch_losses:
         raise ValueError("source yielded no chunks")
     # per-replica mean over the final epoch's chunks (reporting only)
@@ -527,15 +592,16 @@ def oob_scores_stream(
         return contrib.sum(axis=0), votes.sum(axis=0)
 
     aggs, votes_all, ys = [], [], []
-    for c, (Xc, yc, n_valid) in enumerate(source.chunks()):
-        Xc, _ = split_aux_col(Xc, aux_col)
-        a, v = chunk_oob(
-            stacked_params, subspaces, jnp.asarray(Xc, jnp.float32),
-            jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
-        )
-        aggs.append(np.asarray(a)[:n_valid])
-        votes_all.append(np.asarray(v)[:n_valid])
-        ys.append(np.asarray(yc)[:n_valid])
+    with closing(source.chunks()) as chunk_iter:
+        for c, (Xc, yc, n_valid) in enumerate(chunk_iter):
+            Xc, _ = split_aux_col(Xc, aux_col)
+            a, v = chunk_oob(
+                stacked_params, subspaces, jnp.asarray(Xc, jnp.float32),
+                jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
+            )
+            aggs.append(np.asarray(a)[:n_valid])
+            votes_all.append(np.asarray(v)[:n_valid])
+            ys.append(np.asarray(yc)[:n_valid])
     return (
         np.concatenate(aggs),
         np.concatenate(votes_all),

@@ -34,6 +34,7 @@ equivalent bagging.
 from __future__ import annotations
 
 import time
+from contextlib import closing
 from typing import Any
 
 import jax
@@ -136,6 +137,12 @@ def fit_tree_ensemble_stream(
         "bootstrap_features": bootstrap_features,
         "chunk_rows": chunk_rows,
         "n_features": n_features,
+        # stream length is part of the fit's identity: a resumed pass
+        # over a different-length source would compute level histograms
+        # over different data than the snapshotted passes (round-4
+        # audit; matches fit_ensemble_stream's fingerprint)
+        "n_rows": source.n_rows,
+        "n_chunks": source.n_chunks,
         # the weight stream folds the data-shard index, so a resumed
         # run must use the same data-axis size or its remaining passes
         # would draw different bootstrap weights than the snapshot's
@@ -147,6 +154,11 @@ def fit_tree_ensemble_stream(
     resumed_state: dict | None = None
     if resume_from is not None:
         meta, tree_state = _load_stream_checkpoint(resume_from)
+        # pre-round-4 snapshots predate stream-length validation:
+        # accept them at the current source's values
+        saved_cfg = meta.setdefault("config", {})
+        saved_cfg.setdefault("n_rows", source.n_rows)
+        saved_cfg.setdefault("n_chunks", source.n_chunks)
         check_resume_config(meta, config, resume_from)
         start_pass = meta["next_pass"]
         resumed_state = tree_state
@@ -182,15 +194,17 @@ def fit_tree_ensemble_stream(
         e_sum = jnp.zeros((n_features, B - 1), jnp.float32)
         e_cnt = jnp.zeros((), jnp.float32)
         n_chunks = 0
-        for Xc, _, n_valid in source.chunks():
-            e, has = edge_chunk(
-                jnp.asarray(Xc, jnp.float32), jnp.asarray(n_valid, jnp.int32)
-            )
-            e_sum, e_cnt = e_sum + e, e_cnt + has
-            n_chunks += 1
-            if first_step_seconds is None:
-                jax.block_until_ready(e)
-                first_step_seconds = time.perf_counter() - t0
+        with closing(source.chunks()) as chunk_iter:
+            for Xc, _, n_valid in chunk_iter:
+                e, has = edge_chunk(
+                    jnp.asarray(Xc, jnp.float32),
+                    jnp.asarray(n_valid, jnp.int32),
+                )
+                e_sum, e_cnt = e_sum + e, e_cnt + has
+                n_chunks += 1
+                if first_step_seconds is None:
+                    jax.block_until_ready(e)
+                    first_step_seconds = time.perf_counter() - t0
         if n_chunks == 0:
             raise ValueError("source yielded no chunks")
         interior = e_sum / jnp.maximum(e_cnt, 1.0)
@@ -265,7 +279,8 @@ def fit_tree_ensemble_stream(
     def _accumulate(step_fn, acc, stats_src):
         """Run one pass over the stream, folding chunks into ``acc``."""
         nonlocal first_step_seconds
-        for c, (Xc, yc, n_valid) in enumerate(stats_src.chunks()):
+        with closing(stats_src.chunks()) as chunk_iter:
+          for c, (Xc, yc, n_valid) in enumerate(chunk_iter):
             if mesh is not None:
                 Xd = global_put(
                     np.asarray(Xc, np.float32), mesh, P(DATA_AXIS, None)

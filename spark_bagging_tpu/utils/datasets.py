@@ -23,11 +23,14 @@ from typing import Callable
 import numpy as np
 
 # Bump when ANY synthetic generator's distribution changes (v2→v3
-# recalibrated covtype for tree-recoverable structure, 2026-07-30).
+# recalibrated covtype for tree-recoverable structure, 2026-07-30;
+# v3→v4 SyntheticChunks chunk seeds became SeedSequence-mixed instead
+# of additive, 2026-07-31 — in-memory generator output is unchanged but
+# every STREAMED synthetic dataset's rows differ).
 # Benchmark rows are stamped with this so results captured under an
 # older generator can't resume, settle a capture stage, or be compared
 # against newer quality proxies.
-SYNTHETICS_VERSION = "v3"
+SYNTHETICS_VERSION = "v4"
 
 # ---------------------------------------------------------------------
 # File parsers

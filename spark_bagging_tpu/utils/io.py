@@ -59,10 +59,32 @@ class ChunkSource:
 
     def chunks(self) -> Iterator[Chunk]:
         """Yield fixed-shape padded chunks for one epoch."""
+        return self._chunks_over(self._iter_raw())
+
+    def chunks_from(self, start: int) -> Iterator[Chunk]:
+        """Yield padded chunks beginning at chunk index ``start`` — the
+        checkpoint-resume fast path. Sources with random access define
+        ``_iter_raw_from(start_chunk)`` (raw blocks from that chunk
+        boundary on) and seek in O(1); everything else falls back to
+        consuming and discarding the first ``start`` chunks, which is
+        correct but pays the skipped ingestion."""
+        if start <= 0:
+            yield from self.chunks()
+            return
+        raw_from = getattr(self, "_iter_raw_from", None)
+        if raw_from is not None:
+            yield from self._chunks_over(raw_from(start))
+            return
+        it = self.chunks()
+        for i, item in enumerate(it):
+            if i >= start:
+                yield item
+
+    def _chunks_over(self, raw) -> Iterator[Chunk]:
         buf_X: list[np.ndarray] = []
         buf_y: list[np.ndarray] = []
         buffered = 0
-        for X, y in self._iter_raw():
+        for X, y in raw:
             X = np.ascontiguousarray(X, np.float32)
             y = np.asarray(y)
             buf_X.append(X)
@@ -109,6 +131,11 @@ class DropColumnChunks(ChunkSource):
         for X, y in self.inner._iter_raw():
             yield np.delete(np.asarray(X, np.float32), self.col, axis=1), y
 
+    def chunks_from(self, start: int) -> Iterator[Chunk]:
+        # delegate the seek to the inner source (which may be O(1))
+        for X, y, n in self.inner.chunks_from(start):
+            yield np.delete(np.asarray(X, np.float32), self.col, axis=1), y, n
+
 
 class ArrayChunks(ChunkSource):
     """Chunk view over in-memory arrays (or np.memmap for on-disk)."""
@@ -124,7 +151,12 @@ class ArrayChunks(ChunkSource):
         self.chunk_rows = int(chunk_rows)
 
     def _iter_raw(self):
-        for start in range(0, self.n_rows, self.chunk_rows):
+        yield from self._iter_raw_from(0)
+
+    def _iter_raw_from(self, start_chunk: int):
+        for start in range(
+            start_chunk * self.chunk_rows, self.n_rows, self.chunk_rows
+        ):
             yield (
                 self._X[start : start + self.chunk_rows],
                 self._y[start : start + self.chunk_rows],
@@ -143,6 +175,12 @@ class SyntheticChunks(ChunkSource):
     accepts a ``structure_seed`` kwarg (the ``utils.datasets``
     generators do), it is pinned to the source's ``seed`` automatically;
     otherwise ``make_fn`` itself must guarantee chunk-invariance.
+
+    Chunk seeds are ``SeedSequence``-mixed from ``(seed, chunk_id)``,
+    not additive: with ``seed + 1 + c`` two sources at nearby base
+    seeds (train seed=0, eval seed=5) would generate row-identical
+    chunks offset by 5 — silently leaking train rows into held-out
+    data at any realistic chunk count (round-4 audit finding).
     """
 
     def __init__(
@@ -173,11 +211,20 @@ class SyntheticChunks(ChunkSource):
         X0, _ = self._make_fn(1, seed=seed)
         self.n_features = int(X0.shape[1])
 
+    def _chunk_seed(self, c: int) -> int:
+        # chunk-id-keyed and hash-mixed: epoch-stable, order-
+        # independent, and collision-free across nearby base seeds
+        return int(
+            np.random.SeedSequence((self._seed, c)).generate_state(1)[0]
+        )
+
     def _iter_raw(self):
-        for c in range(self.n_chunks):
+        yield from self._iter_raw_from(0)
+
+    def _iter_raw_from(self, start_chunk: int):
+        for c in range(start_chunk, self.n_chunks):
             n = min(self.chunk_rows, self.n_rows - c * self.chunk_rows)
-            # chunk-id-keyed seed: epoch-stable, order-independent
-            yield self._make_fn(n, seed=self._seed + 1 + c)
+            yield self._make_fn(n, seed=self._chunk_seed(c))
 
 
 class LibsvmChunks(ChunkSource):
@@ -337,7 +384,13 @@ class CSVChunks(ChunkSource):
         if reader is not None:  # native C++ streaming parser
             yield from reader
             return
-        rows: list[list[float]] = []
+        # parse into a preallocated f32 buffer row by row (as the
+        # libsvm fallback does): a list-of-lists of boxed floats costs
+        # ~8x the chunk's array size transiently — several GB per
+        # Criteo-width chunk (round-4 audit finding)
+        n_cols = self.n_features + 1
+        buf = np.empty((self.chunk_rows, n_cols), np.float32)
+        filled = 0
         with open(self.path) as f:
             if self._skip_header:
                 # discard the first non-blank line (the header), as the
@@ -349,16 +402,17 @@ class CSVChunks(ChunkSource):
                 line = line.strip()
                 if not line:
                     continue
-                rows.append([float(v) for v in line.split(",")])
-                if len(rows) == self.chunk_rows:
-                    yield self._to_xy(rows)
-                    rows = []
-        if rows:
-            yield self._to_xy(rows)
+                buf[filled] = line.split(",")
+                filled += 1
+                if filled == self.chunk_rows:
+                    yield self._to_xy(buf, filled)
+                    filled = 0
+        if filled:
+            yield self._to_xy(buf, filled)
 
-    def _to_xy(self, rows: list[list[float]]):
-        data = np.asarray(rows, np.float32)
-        y = data[:, self._label_col]
+    def _to_xy(self, buf: np.ndarray, n: int):
+        data = buf[:n]
+        y = data[:, self._label_col].copy()
         X = np.delete(data, self._label_col % data.shape[1], axis=1)
         return np.ascontiguousarray(X), y
 

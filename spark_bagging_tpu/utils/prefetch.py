@@ -59,6 +59,9 @@ class PrefetchChunks(ChunkSource):
         return PrefetchChunks(transform(self._inner), depth=self._depth)
 
     def chunks(self):
+        return self.chunks_from(0)
+
+    def chunks_from(self, start: int):
         q: queue.Queue[Any] = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
 
@@ -79,7 +82,7 @@ class PrefetchChunks(ChunkSource):
 
         def produce() -> None:
             try:
-                for item in self._inner.chunks():
+                for item in self._inner.chunks_from(start):
                     if not put_or_stop(item):
                         return
                 put_or_stop(_DONE)
@@ -106,3 +109,12 @@ class PrefetchChunks(ChunkSource):
             except queue.Empty:
                 pass
             t.join(timeout=5.0)
+            if t.is_alive():
+                import warnings
+
+                warnings.warn(
+                    "prefetch producer thread did not exit within 5s "
+                    "of consumer teardown (a chunk read may be "
+                    "blocked); its buffers stay alive until it does",
+                    stacklevel=2,
+                )

@@ -533,6 +533,121 @@ def test_stream_resume_rejects_config_mismatch(cancer, tmp_path):
         ))
 
 
+def test_stream_resume_rejects_different_length_source(cancer, tmp_path):
+    """The fingerprint includes the stream length: resuming against a
+    shorter source would silently train zero further steps (round-4
+    audit finding)."""
+    X, y = cancer
+    ckpt = str(tmp_path / "snap")
+    make = lambda: BaggingClassifier(
+        base_learner=LogisticRegression(), n_estimators=8, seed=4
+    )
+    make().fit_stream(ArrayChunks(X, y, 128), **_stream_kw(
+        checkpoint_dir=ckpt, checkpoint_every=2,
+    ))
+    with pytest.raises(ValueError, match="different fit configuration"):
+        make().fit_stream(
+            ArrayChunks(X[:300], y[:300], 128), **_stream_kw(
+                resume_from=ckpt,
+            )
+        )
+
+
+def test_stream_rejects_miscounting_source(cancer):
+    """A source that yields a different chunk count than its declared
+    n_chunks corrupts the resume cursor's epoch rollover — the fit
+    fails loudly instead (round-4 audit finding)."""
+    X, y = cancer
+
+    class Undercounts(ArrayChunks):
+        @property
+        def n_chunks(self):
+            return super().n_chunks - 1
+
+    with pytest.raises(ValueError, match="miscounted source"):
+        BaggingClassifier(
+            base_learner=LogisticRegression(), n_estimators=4, seed=0
+        ).fit_stream(Undercounts(X, y, 128), **_stream_kw())
+
+
+def test_snapshot_old_slot_survives_until_next_install(tmp_path):
+    """After a crash mid-swap (path missing, only path.old left), the
+    next snapshot must keep .old alive until ITS install completes —
+    and clean it plus dead-pid tmp debris afterwards."""
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    from spark_bagging_tpu.streaming import (
+        _load_stream_checkpoint,
+        save_snapshot,
+    )
+
+    path = str(tmp_path / "snap")
+    save_snapshot(path, {"v": np.arange(3)}, {"n": 1})
+    # simulate the crash window: only .old remains
+    shutil.move(path, path + ".old")
+    # dead-pid tmp debris from the killed writer
+    dead = subprocess.Popen([_sys.executable, "-c", ""])
+    dead.wait()
+    os.makedirs(f"{path}.tmp.{dead.pid}")
+    # load falls back to .old
+    meta, tree = _load_stream_checkpoint(path)
+    assert meta["n"] == 1
+    # next snapshot installs, then cleans both
+    save_snapshot(path, {"v": np.arange(4)}, {"n": 2})
+    assert not os.path.isdir(path + ".old")
+    assert not os.path.isdir(f"{path}.tmp.{dead.pid}")
+    meta, tree = _load_stream_checkpoint(path)
+    assert meta["n"] == 2
+
+
+def test_synthetic_chunks_nearby_seeds_do_not_collide():
+    """Additive chunk seeds made train chunk c+k row-identical to an
+    eval source's chunk c at base-seed offset k; seeds are now
+    SeedSequence-mixed (round-4 audit finding)."""
+    train = SyntheticChunks(make_classification_2f, 2000, 500, seed=0)
+    evals = SyntheticChunks(make_classification_2f, 2000, 500, seed=1)
+    tr = [X for X, _, _ in train.chunks()]
+    ev = [X for X, _, _ in evals.chunks()]
+    for a in tr:
+        for b in ev:
+            assert not np.array_equal(a, b)
+
+
+def make_classification_2f(n, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 2)).astype(np.float32)
+    return X, (X[:, 0] > 0).astype(np.int32)
+
+
+def test_chunks_from_seeks_equal_suffix():
+    """chunks_from(k) must yield exactly list(chunks())[k:] on every
+    source shape — the checkpoint-resume seek fast path."""
+    from spark_bagging_tpu.utils.io import DropColumnChunks
+    from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((1030, 5)).astype(np.float32)  # ragged tail
+    y = rng.integers(0, 2, 1030).astype(np.int32)
+    sources = [
+        ArrayChunks(X, y, 128),
+        SyntheticChunks(make_classification_2f, 1030, 128, seed=3),
+        DropColumnChunks(ArrayChunks(X, y, 128), 2),
+        PrefetchChunks(ArrayChunks(X, y, 128), depth=2),
+    ]
+    for src in sources:
+        full = list(src.chunks())
+        for k in (0, 3, len(full) - 1, len(full)):
+            suffix = list(src.chunks_from(k))
+            assert len(suffix) == len(full) - k, type(src).__name__
+            for (Xa, ya, na), (Xb, yb, nb) in zip(suffix, full[k:]):
+                np.testing.assert_array_equal(Xa, Xb)
+                np.testing.assert_array_equal(ya, yb)
+                assert na == nb
+
+
 def test_stream_checkpoint_resume_on_mesh(cancer, tmp_path):
     """Snapshots gather sharded state to host; resume re-shards onto the
     mesh — the sharded resumed fit must equal the sharded straight-through
