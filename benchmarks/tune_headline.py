@@ -68,10 +68,14 @@ GRID = [
     ("packed", 100, 8192, 3, "zeros"),
     ("packed", 100, 8192, 1, "pooled"),
     ("packed", 200, 4096, 1, "pooled"),
-    # pallas: packed math, wide operand built in VMEM (no HBM temp)
-    ("pallas", 200, None, 3, "zeros"),
-    ("pallas", 200, None, 1, "pooled"),
-    ("pallas", 400, None, 1, "pooled"),
+    # pallas: packed math, wide operand built in VMEM — but its
+    # (tile, P) scale-matrix input is an HBM temp per replica, so
+    # row_tile is REQUIRED at headline scale (untiled S is ~65 MB x
+    # chunk replicas; round-4 audit). Tiles are multiples of the
+    # kernel's 512-row grid tile.
+    ("pallas", 200, 65536, 3, "zeros"),
+    ("pallas", 200, 65536, 1, "pooled"),
+    ("pallas", 400, 32768, 1, "pooled"),
 ]
 
 

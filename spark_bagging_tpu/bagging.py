@@ -562,7 +562,8 @@ class _BaseBagging(ParamsMixin):
 
             chunk_size = auto_chunk_size(
                 learner, int(X.shape[0]), n_subspace, n_outputs, n_new,
-                mesh=self.mesh,
+                mesh=self.mesh, n_features=int(X.shape[1]),
+                bootstrap_features=self.bootstrap_features,
             )
         self._chunk_resolved = chunk_size
         if self.mesh is not None:

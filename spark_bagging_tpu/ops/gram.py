@@ -29,8 +29,23 @@ from jax.experimental import pallas as pl
 
 _ROW_TILE = 512
 # conservative budget for the kernel's concurrently-resident VMEM
-# blocks (v5e VMEM ≈ 16 MiB total)
+# blocks (v5e VMEM ≈ 16 MiB total; leave headroom for Mosaic's own
+# scratch and pipelining)
 _MAX_VMEM_BYTES = 12 * 1024 * 1024
+
+
+def _kernel_vmem_bytes(tile: int, d: int, P: int) -> int:
+    """Concurrent VMEM residency of one grid step: the THREE
+    (tile, P·d) f32 expansions the kernel materializes (xrep, s_rep,
+    rhs — Mosaic may fuse some, but budget for all), double-buffered
+    (tile, d)/(tile, P) input blocks, and the (d, P·d) f32 accumulator.
+    Counting only one wide block under-reported real residency ~3x and
+    passed configs that would blow VMEM on silicon (round-4 audit)."""
+    return 4 * (
+        3 * tile * P * d          # xrep + s_rep + rhs
+        + 2 * tile * (d + P)      # double-buffered x/s input blocks
+        + d * P * d               # f32 accumulator block
+    )
 
 
 def _scaled_gram_kernel(x_ref, s_ref, out_ref, *, n_pairs, op_dtype):
@@ -88,20 +103,22 @@ def scaled_grams(
         # CPU interpreter lacks fast bf16 dots; operands are cast for
         # numerics only on TPU
         dt = jnp.dtype(jnp.float32)
-    # VMEM feasibility: the kernel holds the (ROW_TILE, d) rows, the
-    # (ROW_TILE, P·d) scaled wide operand it builds on-chip, and the
-    # (d, P·d) f32 accumulator concurrently; past the envelope Mosaic
-    # fails with an opaque compile error mid-fit, so reject up front
-    # with guidance (packed does the same math with an HBM temp).
-    vmem_bytes = 4 * (_ROW_TILE * (d + P + P * d) + d * P * d)
+    # VMEM feasibility: shrink the grid's row tile until one step's
+    # concurrent blocks fit the envelope; past the smallest tile Mosaic
+    # would fail with an opaque compile error mid-fit, so reject up
+    # front with guidance (packed does the same math with an HBM temp).
+    tile = _ROW_TILE
+    while tile > 64 and _kernel_vmem_bytes(tile, d, P) > _MAX_VMEM_BYTES:
+        tile //= 2
+    vmem_bytes = _kernel_vmem_bytes(tile, d, P)
     if not interpret and vmem_bytes > _MAX_VMEM_BYTES:
         raise ValueError(
             f"pallas scaled-Gram needs ~{vmem_bytes >> 20} MiB VMEM at "
-            f"d={d}, P={P} — beyond the kernel's envelope; use "
-            "hessian_impl='packed' (same math, HBM temp bounded by "
-            "row_tile) or 'blocked'"
+            f"d={d}, P={P} even at a {tile}-row grid tile — beyond the "
+            "kernel's envelope; use hessian_impl='packed' (same math, "
+            "HBM temp bounded by row_tile) or 'blocked'"
         )
-    pad = (-n) % _ROW_TILE
+    pad = (-n) % tile
     if pad:
         X = jnp.pad(X, ((0, pad), (0, 0)))
         S = jnp.pad(S, ((0, pad), (0, 0)))
@@ -110,10 +127,10 @@ def scaled_grams(
         functools.partial(
             _scaled_gram_kernel, n_pairs=P, op_dtype=dt
         ),
-        grid=(n_pad // _ROW_TILE,),
+        grid=(n_pad // tile,),
         in_specs=[
-            pl.BlockSpec((_ROW_TILE, d), lambda r: (r, 0)),
-            pl.BlockSpec((_ROW_TILE, P), lambda r: (r, 0)),
+            pl.BlockSpec((tile, d), lambda r: (r, 0)),
+            pl.BlockSpec((tile, P), lambda r: (r, 0)),
         ],
         out_specs=pl.BlockSpec((d, P * d), lambda r: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((d, P * d), jnp.float32),

@@ -61,12 +61,23 @@ def auto_chunk_size(
     n_replicas: int,
     mesh=None,
     budget_bytes: float | None = None,
+    n_features: int | None = None,
+    bootstrap_features: bool = False,
 ) -> int | None:
     """Resolve ``chunk_size=None`` → a concrete chunk or None (vmap-all).
 
     Accounts for the mesh: rows shard over the data axis (per-device
     row count shrinks the per-replica temps) and replicas shard over
     the replica axis (fewer concurrent replicas per device).
+
+    ``n_features``: the FULL feature count. When the subspace gather is
+    active (``n_subspace < n_features``, or ``bootstrap_features`` —
+    mirroring ``ensemble.py``'s ``identity_subspace`` condition, since
+    with-replacement draws gather even at full width) every replica
+    gathers its own ``(rows, n_subspace)`` copy of X inside the vmap —
+    a per-replica cost the learner bytes models deliberately exclude
+    (their contract covers solver temps only), so it is added here
+    [round-4 audit].
     """
     data = replica = 1
     if mesh is not None:
@@ -74,11 +85,13 @@ def auto_chunk_size(
 
         data = mesh.shape.get(DATA_AXIS, 1)
         replica = mesh.shape.get(REPLICA_AXIS, 1)
-    per = learner.fit_workset_bytes(
-        -(-n_rows // data), n_subspace, n_outputs
-    )
+    rows_local = -(-n_rows // data)
+    per = learner.fit_workset_bytes(rows_local, n_subspace, n_outputs)
     if per is None:
         return None  # unmodeled learner: legacy vmap-all
+    if n_features is not None and (n_subspace < n_features
+                                   or bootstrap_features):
+        per += 4.0 * rows_local * n_subspace
     reps_local = -(-n_replicas // replica)
     if budget_bytes is None:
         budget_bytes = device_memory_budget()

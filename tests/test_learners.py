@@ -769,26 +769,58 @@ def test_pallas_hessian_in_ensemble_vmap():
     )
 
 
-def test_pallas_ignores_row_tile():
-    """row_tile would wrap the kernel in an outer scan of zero-padded
-    512-row launches; the pallas path must tile internally instead."""
+def test_pallas_row_tile_rounds_to_kernel_grid():
+    """The pallas path DOES row-tile (its (tile, P) scale-matrix input
+    is a per-replica HBM temp that must be bounded — round-4 audit),
+    but the outer tile rounds UP to a multiple of the kernel's 512-row
+    grid tile so no grid step runs zero-padded."""
     lr = LogisticRegression(hessian_impl="pallas", row_tile=64)
     Xj, yj, _, y = _iris()
+    # iris (150 rows) is under one rounded tile: single pass
     assert lr._row_tiles(Xj, yj, jnp.ones(len(y))) is None
     p, aux = lr.fit_from_init(KEY, Xj, yj, jnp.ones(len(y)), 3)
     assert np.isfinite(float(aux["loss"]))
+    # at scale the rounded tiling engages, in 512-multiples...
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.standard_normal((1200, 4)), jnp.float32)
+    yb = jnp.asarray(rng.integers(0, 3, 1200), jnp.int32)
+    tiles = lr._row_tiles(Xb, yb, jnp.ones(1200))
+    assert tiles is not None and tiles[0].shape[1] == 512
+    # ...and the tiled fit matches blocked exactly (same math)
+    w = jnp.ones(1200)
+    pp, _ = LogisticRegression(
+        max_iter=3, hessian_impl="pallas", row_tile=512
+    ).fit_from_init(KEY, Xb, yb, w, 3)
+    pb, _ = LogisticRegression(
+        max_iter=3, hessian_impl="blocked"
+    ).fit_from_init(KEY, Xb, yb, w, 3)
+    np.testing.assert_allclose(
+        np.asarray(pp["W"]), np.asarray(pb["W"]), rtol=2e-4, atol=1e-4
+    )
 
 
 class TestKernelEnvelopeGuards:
     def test_pallas_gram_rejects_oversized_vmem(self):
         import jax.numpy as jnp
 
-        from spark_bagging_tpu.ops.gram import scaled_grams
+        from spark_bagging_tpu.ops.gram import (
+            _MAX_VMEM_BYTES,
+            _kernel_vmem_bytes,
+            scaled_grams,
+        )
 
+        # the (d, P·d) f32 accumulator alone exceeds the envelope, so
+        # no row-tile shrink can save it — must raise, not hand Mosaic
+        # an impossible block
         X = jnp.ones((64, 500))
-        S = jnp.ones((64, 6))
+        S = jnp.ones((64, 26))
+        assert _kernel_vmem_bytes(64, 500, 26) > _MAX_VMEM_BYTES
         with pytest.raises(ValueError, match="VMEM"):
             scaled_grams(X, S, interpret=False)
+        # headline shape (d=55, P=28) must fit WITHOUT shrinking below
+        # the full 512-row grid tile — the envelope model must not
+        # regress the known-good config
+        assert _kernel_vmem_bytes(512, 55, 28) <= _MAX_VMEM_BYTES
 
     def test_fused_hist_rejects_oversized_out_block(self):
         import jax
