@@ -211,6 +211,21 @@ class BaseLearner(ParamsMixin):
         del n_rows, n_features, n_outputs
         return None
 
+    def subspace_gather_bytes(
+        self, n_rows: int, n_subspace: int, n_features: int | None = None
+    ) -> float:
+        """Per-replica bytes of the feature-subspace gather built
+        inside the replica vmap — the ``X[:, idx]`` f32 copy by
+        default. Learners whose ``prepare()`` product is ALSO gathered
+        per replica (trees' ``T`` indicator slice) override with the
+        larger figure [round-4 audit]; such overrides get the FULL
+        ``n_features`` because ``prepare()`` decides what exists at
+        full width. Added to ``fit_workset_bytes`` by
+        ``utils.memory.auto_chunk_size`` whenever the gather is active;
+        not part of the workset model itself."""
+        del n_features
+        return 4.0 * n_rows * n_subspace
+
     # -- convenience used by the ensemble engine ------------------------
 
     def fit_from_init(

@@ -201,13 +201,18 @@ class _GBTBase(DecisionTreeRegressor):
         return "\n".join(out)
 
     def fit_workset_bytes(self, n_rows, n_features, n_outputs):
-        del n_features
         # per-round regression-tree temps (K=3 moments; buffers reuse
-        # across the scanned rounds), ×C concurrent trees for
-        # multiclass, + the (n, C) running-score state
+        # across the scanned rounds): the (n, N·3) row-stat operand,
+        # the (F, B, N, 3) f32 histogram + its right copy, the (n, 2^d)
+        # leaf one-hot [round-4 audit — mirrors DecisionTree's model],
+        # ×C concurrent trees for the class-vmapped multiclass engine,
+        # + the (n, C) running-score state
         hist_bytes = 2 if self.hist_dtype == "bfloat16" else 4
+        N = 2 ** (self.max_depth - 1)
         per_tree = (
-            hist_bytes * n_rows * (2 ** (self.max_depth - 1)) * 3
+            hist_bytes * n_rows * N * 3
+            + 2 * 4.0 * n_features * self.n_bins * N * 3
+            + 4.0 * n_rows * (2 ** self.max_depth)
             + 8 * n_rows
         )
         n_trees = (
@@ -226,7 +231,11 @@ class _GBTBase(DecisionTreeRegressor):
             prepared = self.prepare(X, axis_name=axis_name)
         yf = y.astype(jnp.float32)
         w = sample_weight.astype(jnp.float32)
-        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # _EPS guard: an all-zero bootstrap draw (probability e^-λ per
+        # replica at small max_samples) would make f0 = 0/0 = NaN and
+        # poison the whole bagged ensemble's mean vote — the single
+        # trees guard their w_tot the same way (round-4 audit)
+        w_sum = jnp.maximum(maybe_psum(jnp.sum(w), axis_name), _EPS)
         f0 = self._init_margin(yf, w, w_sum, axis_name)
         n = X.shape[0]
 
@@ -342,7 +351,8 @@ class GBTClassifier(_GBTBase):
     def _fit_multiclass(self, params, X, y, w, key, axis_name, prepared):
         C = params["leaf"].shape[1]
         yf32 = jax.nn.one_hot(y, C, dtype=jnp.float32)       # (n, C)
-        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # _EPS: see the binary fit — clip(0/0) propagates the NaN
+        w_sum = jnp.maximum(maybe_psum(jnp.sum(w), axis_name), _EPS)
         prior = jnp.clip(
             maybe_psum(w @ yf32, axis_name) / w_sum, 1e-6, 1.0
         )

@@ -269,16 +269,35 @@ class _TreeBase(BaseLearner):
         )
 
     def fit_workset_bytes(self, n_rows, n_features, n_outputs):
-        del n_features  # T indicators are shared (prepare), not per-replica
-        # dominant per-replica temp: the (n, N·K) row-stat operand at
-        # the deepest level (N = 2^(d−1) nodes), in hist_dtype, plus
-        # weight/assignment vectors
+        # per-replica temps at the deepest level (N = 2^(d−1) nodes):
+        # the (n, N·K) row-stat operand in hist_dtype; the (F, B, N, K)
+        # f32 left-stats histogram PLUS its same-shape `right = total −
+        # hist` copy in _select_splits; the (n, 2^d) f32 leaf one-hot
+        # from _leaf_stats; weight/assignment vectors. The histogram
+        # and one-hot were unmodeled and let auto_chunk_size admit
+        # severalfold too many replicas at wide F [round-4 audit].
         K = n_outputs if self.task == "classification" else 3
         hist_bytes = 2 if self.hist_dtype == "bfloat16" else 4
+        N = 2 ** (self.max_depth - 1)
         return float(
-            hist_bytes * n_rows * (2 ** (self.max_depth - 1)) * K
+            hist_bytes * n_rows * N * K
+            + 2 * 4.0 * n_features * self.n_bins * N * K
+            + 4.0 * n_rows * (2 ** self.max_depth)
             + 8 * n_rows
         )
+
+    def subspace_gather_bytes(self, n_rows, n_subspace, n_features=None):
+        # under bagging subspaces the dense impl gathers a per-replica
+        # T[:, idx, :] int8 slice plus its hist_dtype Tf copy in _grow
+        # — ~(1 + hist_bytes)·B× the X gather alone [round-4 audit].
+        # Whether T exists is prepare()'s decision at the FULL feature
+        # width, so resolve the impl with n_features, not the subspace.
+        base = 4.0 * n_rows * n_subspace
+        width = n_features if n_features is not None else n_subspace
+        if self._resolved_impl(n_rows, width) == "dense":
+            hist_bytes = 2 if self.hist_dtype == "bfloat16" else 4
+            base += (1 + hist_bytes) * n_rows * n_subspace * self.n_bins
+        return base
 
     # -- growth ---------------------------------------------------------
 
@@ -499,8 +518,13 @@ class _TreeBase(BaseLearner):
             )
 
         lines: list[str] = []
+        n_splits = 0  # REACHABLE splits only: empty nodes inside an
+        # unsplit ancestor's dead subtree keep finite thresholds
+        # (gain 0 passes min_info_gain=0), so a flat isfinite count
+        # would overstate what the dump renders [round-4 audit]
 
         def walk(level: int, rel: int, indent: int) -> None:
+            nonlocal n_splits
             pad = " " * indent
             if level == self.max_depth:
                 lines.append(pad + self._leaf_str(params, rel))
@@ -511,6 +535,7 @@ class _TreeBase(BaseLearner):
                 # reachable subtree without the phantom split
                 walk(level + 1, 2 * rel, indent)
                 return
+            n_splits += 1
             lines.append(
                 pad + f"If ({name(int(feat[node]))} <= {thr[node]:.6g})"
             )
@@ -521,10 +546,9 @@ class _TreeBase(BaseLearner):
             walk(level + 1, 2 * rel + 1, indent + 1)
 
         walk(0, 0, 1)
-        n_nodes = int(np.isfinite(thr).sum())
         header = (
             f"{type(self).__name__} (depth={self.max_depth}, "
-            f"splits={n_nodes})"
+            f"splits={n_splits})"
         )
         return "\n".join([header] + lines)
 

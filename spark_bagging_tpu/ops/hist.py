@@ -36,9 +36,29 @@ _ROW_TILE = 512
 # F_tile chosen so the on-chip indicator block (_ROW_TILE × F_tile·B)
 # stays ~2 MB in bf16 — far under VMEM while keeping MXU tiles full.
 _MAX_FB_TILE = 2048
-# conservative cap on the kernel's f32 output block (v5e VMEM ≈ 16 MiB
-# shared across all concurrently-resident blocks)
-_MAX_OUT_BLOCK_BYTES = 8 * 1024 * 1024
+# conservative budget for ONE grid step's total concurrent VMEM
+# residency (v5e VMEM ≈ 16 MiB; headroom for Mosaic scratch). Counting
+# only the output block under-reported residency ~3x and admitted
+# configs that blow VMEM on silicon (round-4 audit — the same defect
+# class fixed in ops/gram.py this round).
+_MAX_VMEM_BYTES = 12 * 1024 * 1024
+
+
+def _kernel_vmem_bytes(rows: int, f_tile: int, n_bins: int,
+                       n_nodes: int, K: int) -> int:
+    """Concurrent residency of one grid step: the indicator expansions
+    (xrep + T2), the statistics expansions (onehot, oh_rep, s_rep, R2),
+    double-buffered input blocks, and the f32 output accumulator. All
+    counted at f32 width — T2/R2 may be bf16, but Mosaic scratch and
+    fusion slack eat the difference."""
+    fb = f_tile * n_bins
+    nk = n_nodes * K
+    return 4 * (
+        2 * rows * fb                 # xrep + T2
+        + rows * n_nodes + 3 * rows * nk  # onehot + oh_rep/s_rep/R2
+        + 2 * (rows * f_tile + fb + rows + rows * K)  # buffered inputs
+        + fb * nk                     # f32 output accumulator
+    )
 
 
 def _hist_kernel(x_ref, e_ref, node_ref, s_ref, out_ref, *, n_nodes,
@@ -139,26 +159,32 @@ def binned_left_stats(
         # either dtype.
         op_dtype = jnp.dtype(jnp.float32)
 
+    # VMEM feasibility: shrink the feature tile, then the row tile,
+    # until one grid step's concurrent blocks fit the envelope —
+    # hard-raising rejected deep-tree configs that were actually
+    # servable at smaller tiles (round-4 audit; gram.py's pattern).
     f_tile = max(1, min(F, _MAX_FB_TILE // B))
-    # VMEM feasibility: the output block is (B·f_tile, N·K) f32 —
-    # _MAX_FB_TILE caps only the indicator width, so a deep level with
-    # many per-row stats (e.g. depth 12, K=7 → N·K = 14336) would
-    # otherwise hand Mosaic an impossible block and crash mid-fit with
-    # an opaque compile error.
-    out_block_bytes = 4 * B * f_tile * n_nodes * K
-    if out_block_bytes > _MAX_OUT_BLOCK_BYTES:
-        raise ValueError(
-            f"fused split search needs a ({B * f_tile}, {n_nodes * K}) "
-            f"f32 VMEM output block (~{out_block_bytes >> 20} MiB) — "
-            "beyond the kernel's envelope at this depth/stat width; "
-            "use split_impl='dense' (or a shallower tree / fewer bins)"
-        )
-    Xp = _pad_axis(_pad_axis(X, 0, _ROW_TILE, 0.0), 1, f_tile, 0.0)
+    rows = _ROW_TILE
+    while _kernel_vmem_bytes(rows, f_tile, B, n_nodes, K) > _MAX_VMEM_BYTES:
+        if f_tile > 1:
+            f_tile = max(1, f_tile // 2)
+        elif rows > 64:
+            rows //= 2
+        else:
+            vmem = _kernel_vmem_bytes(rows, f_tile, B, n_nodes, K)
+            raise ValueError(
+                f"fused split search needs ~{vmem >> 20} MiB VMEM per "
+                f"grid step at B={B}, n_nodes={n_nodes}, K={K} even at "
+                "minimal tiles — beyond the kernel's envelope at this "
+                "depth/stat width; use split_impl='dense' (or a "
+                "shallower tree / fewer bins)"
+            )
+    Xp = _pad_axis(_pad_axis(X, 0, rows, 0.0), 1, f_tile, 0.0)
     # padded feature columns produce out rows that are sliced away
     # below; padded data rows carry S == 0 — both inert.
     Ep = _pad_axis(edges, 0, f_tile, jnp.inf)
-    nodep = _pad_axis(node.astype(jnp.int32)[:, None], 0, _ROW_TILE, 0)
-    Sp = _pad_axis(S.astype(jnp.float32), 0, _ROW_TILE, 0.0)
+    nodep = _pad_axis(node.astype(jnp.int32)[:, None], 0, rows, 0)
+    Sp = _pad_axis(S.astype(jnp.float32), 0, rows, 0.0)
     n_pad, F_pad = Xp.shape
     n_ft = F_pad // f_tile
     NK = n_nodes * K
@@ -167,17 +193,17 @@ def binned_left_stats(
         Ep.reshape(n_ft, f_tile, B).transpose(0, 2, 1).reshape(1, -1)
     )
 
-    grid = (n_ft, n_pad // _ROW_TILE)
+    grid = (n_ft, n_pad // rows)
     out = pl.pallas_call(
         functools.partial(
             _hist_kernel, n_nodes=n_nodes, n_bins=B, op_dtype=op_dtype
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_ROW_TILE, f_tile), lambda f, r: (r, f)),
+            pl.BlockSpec((rows, f_tile), lambda f, r: (r, f)),
             pl.BlockSpec((1, B * f_tile), lambda f, r: (0, f)),
-            pl.BlockSpec((_ROW_TILE, 1), lambda f, r: (r, 0)),
-            pl.BlockSpec((_ROW_TILE, K), lambda f, r: (r, 0)),
+            pl.BlockSpec((rows, 1), lambda f, r: (r, 0)),
+            pl.BlockSpec((rows, K), lambda f, r: (r, 0)),
         ],
         out_specs=pl.BlockSpec(
             (B * f_tile, NK), lambda f, r: (f, 0)

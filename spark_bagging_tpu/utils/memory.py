@@ -91,7 +91,9 @@ def auto_chunk_size(
         return None  # unmodeled learner: legacy vmap-all
     if n_features is not None and (n_subspace < n_features
                                    or bootstrap_features):
-        per += 4.0 * rows_local * n_subspace
+        per += learner.subspace_gather_bytes(
+            rows_local, n_subspace, n_features
+        )
     reps_local = -(-n_replicas // replica)
     if budget_bytes is None:
         budget_bytes = device_memory_budget()

@@ -836,6 +836,45 @@ class TestKernelEnvelopeGuards:
             binned_left_stats(X, edges, node, S, n_nodes=2048,
                               interpret=True)
 
+    def test_fused_hist_shrinks_tiles_for_deep_levels(self):
+        """A depth that the old output-block guard hard-rejected must
+        now run at shrunken (f_tile, rows) tiles — and still match the
+        brute-force left-stats computation (round-4 audit)."""
+        import jax.numpy as jnp
+
+        from spark_bagging_tpu.ops.hist import (
+            _MAX_VMEM_BYTES,
+            _kernel_vmem_bytes,
+            binned_left_stats,
+        )
+
+        n_nodes, K, B, F = 1024, 7, 32, 8
+        # infeasible at the default tiles, feasible at minimal ones
+        assert _kernel_vmem_bytes(512, 64, B, n_nodes, K) > _MAX_VMEM_BYTES
+        assert _kernel_vmem_bytes(64, 1, B, n_nodes, K) <= _MAX_VMEM_BYTES
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.standard_normal((96, F)), jnp.float32)
+        edges = jnp.sort(
+            jnp.asarray(rng.standard_normal((F, B)), jnp.float32), axis=1
+        ).at[:, -1].set(jnp.inf)
+        node = jnp.asarray(rng.integers(0, n_nodes, 96), jnp.int32)
+        S = jnp.asarray(rng.random((96, K)), jnp.float32)
+        out = binned_left_stats(
+            X, edges, node, S, n_nodes=n_nodes, hist_dtype="float32",
+            interpret=True,
+        )
+        assert out.shape == (F, B, n_nodes, K)
+        # brute-force check on a few (f, b) cells
+        Xn, En, Nn, Sn = map(np.asarray, (X, edges, node, S))
+        for f, b in [(0, 0), (3, 17), (7, 31)]:
+            ind = (Xn[:, f] <= En[f, b]).astype(np.float32)
+            ref = np.zeros((n_nodes, K), np.float32)
+            for i in range(96):
+                ref[Nn[i]] += ind[i] * Sn[i]
+            np.testing.assert_allclose(
+                np.asarray(out[f, b]), ref, rtol=1e-4, atol=1e-4
+            )
+
     def test_logistic_workset_models_wide_hessians(self):
         from spark_bagging_tpu.models.logistic import LogisticRegression
 

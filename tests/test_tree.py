@@ -499,6 +499,77 @@ def test_to_debug_string_matches_predictions():
     assert pl == 0 and pr == 1
 
 
+def test_debug_string_split_count_matches_rendered_tree():
+    """The header's splits= count must equal the number of rendered
+    'If (' lines — phantom finite-threshold nodes inside unreachable
+    subtrees must not inflate it (round-4 audit)."""
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+
+    # one feature where a pure split at the root leaves empty subtrees
+    rng = np.random.default_rng(0)
+    X = np.concatenate(
+        [np.full((50, 1), -1.0), np.full((50, 1), 1.0)]
+    ).astype(np.float32)
+    X = np.concatenate([X, rng.standard_normal((100, 2)).astype(np.float32)], 1)
+    y = (X[:, 0] > 0).astype(np.int32)
+    tree = DecisionTreeClassifier(max_depth=4)
+    p, _ = tree.fit_from_init(
+        jax.random.key(0), jnp.asarray(X), jnp.asarray(y),
+        jnp.ones(100), 2,
+    )
+    s = tree.to_debug_string(p)
+    rendered = s.count("If (")
+    import re
+
+    header_count = int(re.search(r"splits=(\d+)", s).group(1))
+    assert header_count == rendered
+
+
+def test_gbt_all_zero_bootstrap_weights_stay_finite():
+    """A replica whose Poisson draw is all zeros (probability e^-λ at
+    small max_samples) must not NaN-poison the bagged mean vote
+    (round-4 audit: f0 was 0/0)."""
+    from spark_bagging_tpu import BaggingRegressor, GBTRegressor
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((80, 3)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    reg = BaggingRegressor(
+        base_learner=GBTRegressor(n_rounds=3, max_depth=2),
+        n_estimators=16, max_samples=0.02, seed=0,
+    ).fit(X, y)
+    assert np.isfinite(reg.predict(X)).all()
+    # classifier path (binary + the clip(0/0) multiclass prior)
+    from spark_bagging_tpu import BaggingClassifier, GBTClassifier
+
+    yc = (X[:, 0] > 0).astype(np.int32)
+    clf = BaggingClassifier(
+        base_learner=GBTClassifier(n_rounds=3, max_depth=2),
+        n_estimators=16, max_samples=0.02, seed=0,
+    ).fit(X, yc)
+    assert np.isfinite(clf.predict_proba(X)).all()
+    y3 = rng.integers(0, 3, 80).astype(np.int32)
+    clf3 = BaggingClassifier(
+        base_learner=GBTClassifier(n_rounds=2, max_depth=2),
+        n_estimators=16, max_samples=0.02, seed=0,
+    ).fit(X, y3)
+    assert np.isfinite(clf3.predict_proba(X)).all()
+
+
+def test_tree_workset_model_scales_with_features():
+    """The (F, B, N, K) histogram + right copy are per-replica temps:
+    the bytes model must grow with the feature count, and the dense
+    subspace gather must charge the T-slice copies (round-4 audit)."""
+    from spark_bagging_tpu.models import DecisionTreeClassifier
+
+    t = DecisionTreeClassifier(max_depth=5, n_bins=32)
+    narrow = t.fit_workset_bytes(100_000, 54, 7)
+    wide = t.fit_workset_bytes(100_000, 8192, 7)
+    assert wide > narrow + 2 * 4 * (8192 - 54) * 32 * 16 * 7 * 0.99
+    g = t.subspace_gather_bytes(100_000, 50)
+    assert g >= (1 + 2) * 100_000 * 50 * 32  # T int8 + bf16 Tf copy
+
+
 def test_gbt_debug_string_binary_and_multiclass():
     from spark_bagging_tpu import GBTClassifier
 
