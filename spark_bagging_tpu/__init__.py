@@ -16,7 +16,11 @@ sections instead of reference file:line):
   compose with pipelines [SURVEY §3.4].
 """
 
-from spark_bagging_tpu.bagging import BaggingClassifier, BaggingRegressor
+from spark_bagging_tpu.bagging import (
+    BaggingClassifier,
+    BaggingRegressor,
+    clear_compiled_caches,
+)
 from spark_bagging_tpu.forest import (
     RandomForestClassifier,
     RandomForestRegressor,
@@ -57,6 +61,7 @@ __version__ = "0.2.0"
 
 __all__ = [
     "BaggingClassifier",
+    "clear_compiled_caches",
     "BaggingRegressor",
     "RandomForestClassifier",
     "RandomForestRegressor",

@@ -204,6 +204,30 @@ def _jitted_sharded_oob(learner, mesh, n_replicas, ratio, replacement,
     )
 
 
+_JIT_CACHES = (
+    _jitted_fit, _jitted_sharded_fit, _jitted_sharded_predict_clf,
+    _jitted_sharded_predict_reg, _jitted_predict_clf, _jitted_predict_reg,
+    _jitted_predict_quantiles, _jitted_oob, _jitted_sharded_oob,
+)
+
+
+def clear_compiled_caches() -> int:
+    """Drop every cached compiled-ensemble executable.
+
+    The module-level jit caches key on (learner, mesh, shapes, …) and
+    live for the process lifetime; loops that grow an ensemble in many
+    warm-start increments, or long-lived services cycling estimator
+    configs, accumulate up to 256 executables per cache (each pinning
+    its learner/Mesh and XLA state). Call this to release them — the
+    next fit/predict simply recompiles. Returns the number of entries
+    dropped."""
+    dropped = 0
+    for cache in _JIT_CACHES:
+        dropped += cache.cache_info().currsize
+        cache.cache_clear()
+    return dropped
+
+
 class _EncodedChunks:
     """Label-encoding view over a ChunkSource: maps raw labels to class
     indices chunk-by-chunk (the streaming analog of the ``np.unique``
@@ -437,7 +461,20 @@ class _BaseBagging(ParamsMixin):
         total = imp.sum()
         return imp / total if total > 0 else imp
 
-    def _warm_start_from(self, X, learner) -> int:
+    @staticmethod
+    def _row_vector_digest(arr) -> str | None:
+        """Small stable digest of a per-row vector (sample_weight/aux)
+        for warm-start validation — storing the vectors themselves
+        would double fit memory."""
+        if arr is None:
+            return None
+        import hashlib
+
+        a = np.ascontiguousarray(np.asarray(arr, np.float32))
+        return hashlib.sha1(a.tobytes()).hexdigest()
+
+    def _warm_start_from(self, X, learner, sample_weight=None,
+                         aux=None) -> int:
         """Validate a warm start and return the first NEW replica id.
 
         Replica streams are keyed by (seed, id), so fitting ids
@@ -456,10 +493,20 @@ class _BaseBagging(ParamsMixin):
                 f"warm_start X has {X.shape[1]} features; fitted on "
                 f"{self.n_features_in_}"
             )
-        if learner != self._fitted_learner:
+        from spark_bagging_tpu.streaming import learner_fingerprint
+
+        # no fallback to fingerprinting self._fitted_learner: that is
+        # the SAME mutated instance under validation (set_params
+        # aliasing), so it would tautologically pass — a missing
+        # fit-time snapshot is a mismatch
+        if learner_fingerprint(learner) != getattr(
+            self, "_fitted_learner_fp", None
+        ):
             raise ValueError(
                 "warm_start requires the same base learner "
-                "hyperparameters as the original fit"
+                "hyperparameters as the original fit (set_params on "
+                "the base learner after fit changes them; ensembles "
+                "fitted before the fingerprint existed cannot extend)"
             )
         if not np.array_equal(
             np.asarray(jax.random.key_data(jax.random.key(self.seed))),
@@ -508,7 +555,40 @@ class _BaseBagging(ParamsMixin):
                 "replicas from different stream families and silently "
                 "corrupt OOB replay"
             )
+        # per-row semantics must match too: a warm fit under different
+        # (or forgotten) sample_weight / aux censor flags would splice
+        # replicas trained on a different weighted objective — the
+        # 'exact cold-fit reproduction' contract would silently break
+        # [round-4 audit]
+        if self._row_vector_digest(sample_weight) != getattr(
+            self, "_fit_sw_digest", None
+        ):
+            raise ValueError(
+                "warm_start requires the same sample_weight as the "
+                "original fit (pass it again, identically)"
+            )
+        if self._row_vector_digest(aux) != getattr(
+            self, "_fit_aux_digest", None
+        ):
+            raise ValueError(
+                "warm_start requires the same aux column as the "
+                "original fit (pass it again, identically)"
+            )
         return self.n_estimators_
+
+    def _reject_warm_stream(self) -> None:
+        """``fit_stream`` cannot extend an ensemble: stream fits use
+        chunk-keyed replica streams. Silently discarding the fitted
+        replicas of a ``warm_start=True`` estimator would look like the
+        growth the in-memory ``fit`` performs — raise the explicit
+        error instead [round-4 audit]."""
+        if self.warm_start and hasattr(self, "ensemble_"):
+            raise ValueError(
+                "warm_start cannot extend an ensemble via fit_stream "
+                "(stream fits use chunk-keyed replica streams): grow "
+                "with fit(), or set warm_start=False to refit from "
+                "scratch"
+            )
 
     def _fit_engine(self, X: jnp.ndarray, y: jnp.ndarray, n_outputs: int,
                     sample_weight=None, id_start: int = 0, aux=None):
@@ -671,10 +751,22 @@ class _BaseBagging(ParamsMixin):
         self.n_estimators_ = int(self.n_estimators)
         self._fit_key = key
         self._fitted_learner = learner
+        # hyperparameter SNAPSHOT, not the (mutable) instance:
+        # set_params(base_learner__x=...) mutates the same object
+        # _fitted_learner points at, so an identity/equality check
+        # against it can never fail [round-4 audit]
+        from spark_bagging_tpu.streaming import learner_fingerprint
+
+        self._fitted_learner_fp = learner_fingerprint(learner)
         self._fit_sampling = (ratio, bool(self.bootstrap))
         self._fit_subspace_cfg = (n_subspace, bool(self.bootstrap_features))
         self._fit_n_rows = int(X.shape[0])
         self._fit_mesh_layout = self._mesh_layout()
+        self._fit_sw_digest = self._row_vector_digest(sample_weight)
+        self._fit_aux_digest = self._row_vector_digest(aux)
+        # a prior fit_stream's aux-column convention must not leak into
+        # this in-memory fit's stream-scoring paths [round-4 audit]
+        self._stream_aux_col = None
         # replica_weights can only replay draws made from ONE global
         # key stream; a data-sharded fit folds the shard index into
         # each draw (mesh-layout-dependent). Snapshotted at fit time —
@@ -812,12 +904,20 @@ class _BaseBagging(ParamsMixin):
         self.n_estimators_ = int(self.n_estimators)
         self._fit_key = key
         self._fitted_learner = learner
+        from spark_bagging_tpu.streaming import learner_fingerprint
+
+        self._fitted_learner_fp = learner_fingerprint(learner)
         self._fit_sampling = (ratio, bool(self.bootstrap))
         # stream fits use chunk-keyed replica streams — not extendable
         # by the in-memory warm start (guard keys on this attribute)
         self._fit_subspace_cfg = None
         self._fit_n_rows = int(source.n_rows)
         self._fit_weights_replayable = False  # per-chunk weight draws
+        # a prior in-memory fit's resolved chunk must not leak into
+        # this stream fit's OOB/predict maps or checkpoint [r4 audit]
+        self._chunk_resolved = None
+        self._fit_sw_digest = None
+        self._fit_aux_digest = None
         self._identity_subspace = (
             n_subspace == n_feat_data and not self.bootstrap_features
         )
@@ -1124,7 +1224,9 @@ class BaggingClassifier(_BaseBagging):
                     "warm_start requires the same class set as the "
                     "original fit"
                 )
-            id_start = self._warm_start_from(X, self._learner())
+            id_start = self._warm_start_from(
+                X, self._learner(), sample_weight=sample_weight
+            )
             if id_start == self.n_estimators:
                 import warnings
 
@@ -1181,6 +1283,7 @@ class BaggingClassifier(_BaseBagging):
         """
         from spark_bagging_tpu.utils.io import as_chunk_source
 
+        self._reject_warm_stream()
         source = as_chunk_source(source, chunk_rows)
         if classes is None:
             seen: set = set()
@@ -1340,7 +1443,9 @@ class BaggingRegressor(_BaseBagging):
             raise ValueError("X and y row counts differ")
         id_start = 0
         if self.warm_start and hasattr(self, "ensemble_"):
-            id_start = self._warm_start_from(X, self._learner())
+            id_start = self._warm_start_from(
+                X, self._learner(), sample_weight=sample_weight, aux=aux
+            )
             if id_start == self.n_estimators:
                 import warnings
 
@@ -1380,6 +1485,7 @@ class BaggingRegressor(_BaseBagging):
         The fitted model's feature space excludes that column."""
         from spark_bagging_tpu.utils.io import as_chunk_source
 
+        self._reject_warm_stream()
         self.__dict__.pop("_collapsed_beta_cache", None)
         source = as_chunk_source(source, chunk_rows)
         self._fit_stream_engine(source, 1, n_epochs=n_epochs,

@@ -260,15 +260,23 @@ class BaseLearner(ParamsMixin):
 
     # Learners are static (hashable) w.r.t. jit: two instances with equal
     # hyperparams trace to the same compiled program.
+    def _params_key(self) -> tuple:
+        return tuple(
+            sorted((k, repr(v))
+                   for k, v in self.get_params(deep=False).items())
+        )
+
     def __hash__(self) -> int:
-        return hash((type(self),) + tuple(
-            sorted((k, repr(v)) for k, v in self.get_params(deep=False).items())
-        ))
+        return hash((type(self),) + self._params_key())
 
     def __eq__(self, other: object) -> bool:
+        # repr-based on BOTH sides: __eq__ via == with a repr-based
+        # __hash__ broke the hash invariant (max_iter=1 vs 1.0 compared
+        # equal but hashed apart), silently duplicating compiled
+        # executables in bagging.py's lru caches [round-4 audit]
         return (
             type(self) is type(other)
-            and self.get_params(deep=False) == other.get_params(deep=False)  # type: ignore[union-attr]
+            and self._params_key() == other._params_key()  # type: ignore[union-attr]
         )
 
 

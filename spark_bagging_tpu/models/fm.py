@@ -120,7 +120,9 @@ class _FMBase(BaseLearner):
             prepared=None):
         del key, prepared
         w = sample_weight.astype(jnp.float32)
-        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # floor: all-zero bootstrap draws must stay finite
+        # (round-4 audit; see linear.py)
+        w_sum = jnp.maximum(maybe_psum(jnp.sum(w), axis_name), 1e-12)
         opt = optax.adam(self.lr)
 
         with jax.default_matmul_precision(self.precision):

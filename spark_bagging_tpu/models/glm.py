@@ -235,7 +235,9 @@ class GeneralizedLinearRegression(PooledStartMixin, BaseLearner):
         Xb = augment_bias(X.astype(jnp.float32))
         yf = y.astype(jnp.float32)
         w = sample_weight.astype(jnp.float32)
-        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # floor: all-zero bootstrap draws must stay finite
+        # (round-4 audit; see linear.py)
+        w_sum = jnp.maximum(maybe_psum(jnp.sum(w), axis_name), 1e-12)
         d = Xb.shape[1]
         pen = jnp.concatenate(
             [jnp.full((d - 1,), self.l2, jnp.float32),

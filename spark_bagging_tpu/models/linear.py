@@ -86,7 +86,14 @@ class LinearRegression(BaseLearner):
             )
             d = Xb.shape[1]
             Xw = Xb * w[:, None]
-            w_sum = maybe_psum(jnp.sum(w), axis_name)
+            # floor: an all-zero bootstrap draw (probability e^-λ
+            # per replica at small max_samples) would otherwise
+            # solve a 0-matrix and NaN-poison the ensemble mean
+            # [round-4 audit]; with w=0 the RHS is 0 too, so the
+            # floored solve returns an inert β=0
+            w_sum = jnp.maximum(
+                maybe_psum(jnp.sum(w), axis_name), 1e-12
+            )
             A = maybe_psum(Xw.T @ Xb, axis_name)
             b = maybe_psum(Xw.T @ y, axis_name)
             pen = jnp.concatenate(
@@ -96,11 +103,23 @@ class LinearRegression(BaseLearner):
             # weighted loss + 0.5·l2·‖β‖² (the streaming objective),
             # equivalently (XᵀWX + l2·Σw·I)β = XᵀWy — sklearn's
             # Ridge(alpha) corresponds to l2 = alpha / Σw
+            # LU, not Cholesky: a near-degenerate bootstrap draw (one
+            # or two surviving rows) leaves A rank-deficient, and f32
+            # matmul rounding can push an eigenvalue below the tiny
+            # penalty diagonal — Cholesky then NaNs and poisons the
+            # ensemble mean, while partial-pivot LU solves the (exactly
+            # nonsingular) system finitely [round-4 audit]
             beta = jax.scipy.linalg.solve(
                 A + jnp.diag(pen) * w_sum,
                 b,
-                assume_a="pos",
             )
+            # an EMPTY draw (w_sum at its floor) with l2=0 leaves the
+            # system exactly singular (zero feature pivots) — the
+            # correct fit for zero rows of evidence is the inert β=0,
+            # not LU's NaNs
+            # w_sum, not a local sum: it is psum'd, so every data
+            # shard takes the same branch
+            beta = jnp.where(w_sum > 1e-9, beta, jnp.zeros_like(beta))
             resid = Xb @ beta - y
             mse = maybe_psum(jnp.sum(w * resid**2), axis_name) / w_sum
         return {"beta": beta}, {"loss": mse, "loss_curve": mse[None]}

@@ -246,7 +246,9 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
         del key, prepared  # deterministic solvers; no precomputation
         Xb = augment_bias(X.astype(jnp.float32))
         w = sample_weight.astype(jnp.float32)
-        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # floor: all-zero bootstrap draws must stay finite
+        # (round-4 audit; see linear.py)
+        w_sum = jnp.maximum(maybe_psum(jnp.sum(w), axis_name), 1e-12)
         # TPU matmuls default to bfloat16 inputs; Newton's Hessian loses
         # PSD-ness in bf16 and Cholesky NaNs. Solver math pins a higher
         # MXU precision (trace-time context — applies to ops below).

@@ -35,14 +35,33 @@ def initialize_distributed(
             "num_processes/process_id require coordinator_address — "
             "without it they would be silently ignored"
         )
-    try:
-        if coordinator_address is not None:
+    if coordinator_address is not None:
+        # EXPLICIT join: failure here (coordinator unreachable, or
+        # initialize called after the first JAX computation touched the
+        # backend) must raise, not degrade to a silent single-process
+        # run where every host believes it is process 0 — concurrent
+        # "single writers" would then tear shared checkpoints [round-4
+        # audit]. Only an already-initialized runtime is tolerated.
+        try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
             )
-        else:
+        except RuntimeError as e:
+            # JAX's double-init message is "...should only be called
+            # once." — match both phrasings across versions
+            msg = str(e).lower()
+            if "already" in msg or "once" in msg:
+                log.debug("jax.distributed already initialized: %s", e)
+            else:
+                raise RuntimeError(
+                    "explicit multi-host join failed (call "
+                    "initialize_distributed BEFORE any jax computation "
+                    f"touches the backend): {e}"
+                ) from e
+    else:
+        try:
             # the auto-detect path MUST actually call initialize —
             # JAX reads the pod topology from the TPU runtime env; on a
             # plain single host it raises and we fall through to
@@ -50,13 +69,13 @@ def initialize_distributed(
             # both dead-code this branch — it is 1 before init — and
             # initialize the backend, breaking any later init attempt.)
             jax.distributed.initialize()
-    except RuntimeError as e:
-        # already initialized, or no cluster environment to detect
-        log.debug("jax.distributed.initialize skipped: %s", e)
-    except ValueError as e:
-        # jax raises ValueError when no coordinator can be inferred
-        # from the environment — the single-process case
-        log.debug("jax.distributed auto-detect: single process (%s)", e)
+        except RuntimeError as e:
+            # already initialized, or no cluster environment to detect
+            log.debug("jax.distributed.initialize skipped: %s", e)
+        except ValueError as e:
+            # jax raises ValueError when no coordinator can be inferred
+            # from the environment — the single-process case
+            log.debug("jax.distributed auto-detect: single process (%s)", e)
     log.info(
         "distributed: %d process(es), %d global device(s)",
         jax.process_count(),

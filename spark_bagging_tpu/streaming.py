@@ -117,11 +117,12 @@ def _save_stream_checkpoint(
 
 
 def learner_fingerprint(learner: BaseLearner) -> str:
-    """Stable hyperparameter fingerprint for resume-config validation
-    (shared by the SGD and tree stream checkpointers)."""
-    return repr(sorted(
-        (k, repr(v)) for k, v in learner.get_params(deep=False).items()
-    )) + type(learner).__qualname__
+    """Stable hyperparameter fingerprint for resume-config and
+    warm-start validation (shared by the SGD and tree stream
+    checkpointers and bagging's warm-start guard). Built on the SAME
+    canonical key as ``BaseLearner.__hash__``/``__eq__`` so jit-cache
+    identity and fingerprint identity can never diverge."""
+    return repr(learner._params_key()) + type(learner).__qualname__
 
 
 def check_resume_config(meta: dict, config: dict, path: str) -> None:

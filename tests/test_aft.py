@@ -293,6 +293,27 @@ def test_streamed_aft_scores_its_own_training_source():
     )
 
 
+def test_stream_aux_convention_does_not_leak_into_memory_refit():
+    """An in-memory refit clears the prior fit_stream's aux column, so
+    a later (D+1)-wide stream source gets the honest width error, not a
+    silent column drop computed for the OLD fit (round-4 audit)."""
+    X, y, delta = _weibull_data(n=800, censor_frac=0.3, seed=7)
+    wide = np.concatenate([X, delta[:, None]], axis=1)
+    reg = BaggingRegressor(
+        base_learner=AFTSurvivalRegression(), n_estimators=2, seed=0,
+    ).fit_stream((wide, y), chunk_rows=256, n_epochs=2, aux_col=-1)
+    # refit in-memory on the WIDE matrix as plain features
+    reg2 = BaggingRegressor(
+        base_learner=AFTSurvivalRegression(), n_estimators=2, seed=0,
+    )
+    reg2.__dict__.update(reg.__dict__)  # same instance state
+    reg2.fit(wide, y, aux=delta)
+    # a (D+2)-wide source is now a genuine mismatch — must raise
+    wider = np.concatenate([wide, delta[:, None]], axis=1)
+    with pytest.raises(ValueError, match="features"):
+        reg2.predict_stream((wider, y), chunk_rows=256)
+
+
 def test_aft_reports_final_loss_and_curve():
     """The reported loss is evaluated AT the final params (not one Adam
     step stale) and the curve rides along like every other learner."""

@@ -590,6 +590,97 @@ def test_warm_start_rejects_different_row_count(breast_cancer):
         clf.fit(X[:-10], y[:-10])
 
 
+def test_warm_start_rejects_mutated_base_learner(breast_cancer):
+    """set_params(base_learner__x=...) mutates the same instance the
+    fit snapshotted, so the guard must compare a fingerprint taken at
+    fit time, not object identity (round-4 audit)."""
+    from spark_bagging_tpu import LogisticRegression
+
+    X, y = breast_cancer
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=10),
+        n_estimators=4, seed=0, warm_start=True,
+    ).fit(X, y)
+    clf.set_params(n_estimators=6, base_learner__max_iter=2)
+    with pytest.raises(ValueError, match="hyperparameters"):
+        clf.fit(X, y)
+
+
+def test_warm_start_rejects_changed_sample_weight(breast_cancer):
+    """A warm fit must use the same per-row weights as the original —
+    splicing replicas trained on a different weighted objective would
+    silently break the exact-cold-fit contract (round-4 audit)."""
+    X, y = breast_cancer
+    sw = np.linspace(0.5, 2.0, len(y)).astype(np.float32)
+    clf = BaggingClassifier(
+        n_estimators=4, seed=0, warm_start=True
+    ).fit(X, y, sample_weight=sw)
+    clf.set_params(n_estimators=6)
+    with pytest.raises(ValueError, match="sample_weight"):
+        clf.fit(X, y)  # forgot the weights
+    with pytest.raises(ValueError, match="sample_weight"):
+        clf.fit(X, y, sample_weight=sw * 2)
+    clf.fit(X, y, sample_weight=sw)  # identical weights: extends
+    assert clf.n_estimators_ == 6
+
+
+def test_warm_start_cannot_extend_via_fit_stream(breast_cancer):
+    from spark_bagging_tpu import ArrayChunks
+
+    X, y = breast_cancer
+    clf = BaggingClassifier(
+        n_estimators=4, seed=0, warm_start=True
+    ).fit(X, y)
+    clf.set_params(n_estimators=8)
+    with pytest.raises(ValueError, match="fit_stream"):
+        clf.fit_stream(ArrayChunks(X, y, 128))
+
+
+def test_all_zero_bootstrap_draws_stay_finite(breast_cancer):
+    """max_samples small enough that some replicas draw all-zero
+    Poisson weights: predictions must stay finite for every learner
+    family that divides by the weight total (round-4 audit)."""
+    from spark_bagging_tpu import BaggingRegressor, LinearRegression
+    from spark_bagging_tpu.models import FMClassifier
+
+    X, y = breast_cancer
+    clf = BaggingClassifier(
+        n_estimators=32, max_samples=0.005, seed=0
+    ).fit(X, y)
+    assert np.isfinite(clf.predict_proba(X)).all()
+    reg = BaggingRegressor(
+        base_learner=LinearRegression(),
+        n_estimators=32, max_samples=0.005, seed=0,
+    ).fit(X, y.astype(np.float32))
+    assert np.isfinite(reg.predict(X)).all()
+    fm = BaggingClassifier(
+        base_learner=FMClassifier(max_iter=5),
+        n_estimators=16, max_samples=0.005, seed=0,
+    ).fit(X, y)
+    assert np.isfinite(fm.predict_proba(X)).all()
+
+
+def test_learner_hash_eq_consistent():
+    """equal ⇒ equal hash (the lru-cache invariant); numerically equal
+    but repr-distinct params are deliberately NOT equal (round-4
+    audit)."""
+    from spark_bagging_tpu import LinearRegression
+
+    a, b = LinearRegression(l2=0), LinearRegression(l2=0)
+    assert a == b and hash(a) == hash(b)
+    c = LinearRegression(l2=0.0)
+    assert (a == c) == (hash(a) == hash(c))
+
+
+def test_clear_compiled_caches(breast_cancer):
+    from spark_bagging_tpu import clear_compiled_caches
+
+    X, y = breast_cancer
+    BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    assert clear_compiled_caches() > 0
+    assert clear_compiled_caches() == 0
+
+
 class TestLinearCollapseInference:
     """Bagged-mean prediction of params-linear learners collapses to
     ONE model with scatter-meaned coefficients — must match the
