@@ -118,8 +118,10 @@ class LinearRegression(BaseLearner):
             # correct fit for zero rows of evidence is the inert β=0,
             # not LU's NaNs
             # w_sum, not a local sum: it is psum'd, so every data
-            # shard takes the same branch
-            beta = jnp.where(w_sum > 1e-9, beta, jnp.zeros_like(beta))
+            # shard takes the same branch; the threshold sits just
+            # above the 1e-12 floor so a genuinely tiny-but-nonzero
+            # weighting still fits normally
+            beta = jnp.where(w_sum > 2e-12, beta, jnp.zeros_like(beta))
             resid = Xb @ beta - y
             mse = maybe_psum(jnp.sum(w * resid**2), axis_name) / w_sum
         return {"beta": beta}, {"loss": mse, "loss_curve": mse[None]}

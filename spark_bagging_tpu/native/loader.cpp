@@ -29,6 +29,12 @@ namespace {
 constexpr int kErrOpen = -1;
 constexpr int kErrParse = -2;
 constexpr int kErrArg = -3;
+// the file holds an embedded NUL byte: every parser here works on
+// NUL-terminated line buffers, which would silently truncate the row
+// and diverge from the Python fallback (round-4 audit) — surface a
+// distinct code so the ctypes layer can fall back to the Python
+// parsers instead of mis-ingesting
+constexpr int kErrNul = -4;
 
 // fast float parse: strtof handles inf/nan/exponents; we just wrap it
 inline bool parse_float(const char*& p, float* out) {
@@ -47,6 +53,7 @@ struct LineReader {
   FILE* f = nullptr;
   char* buf = nullptr;
   size_t cap = 0;
+  bool nul = false;  // an embedded NUL byte ended iteration
 
   explicit LineReader(const char* path) { f = fopen(path, "rb"); }
   ~LineReader() {
@@ -54,12 +61,17 @@ struct LineReader {
     free(buf);
   }
   bool ok() const { return f != nullptr; }
-  // returns nullptr at EOF; strips trailing newline
+  // returns nullptr at EOF or on an embedded NUL (check `nul`);
+  // strips trailing newline
   const char* next() {
-    if (!f) return nullptr;
+    if (!f || nul) return nullptr;
     ssize_t n = getline(&buf, &cap, f);
     if (n < 0) return nullptr;
     while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = 0;
+    if (memchr(buf, 0, static_cast<size_t>(n)) != nullptr) {
+      nul = true;  // parsers are NUL-terminated-string based: bail
+      return nullptr;
+    }
     return buf;
   }
 };
@@ -301,6 +313,7 @@ int svm_dims(const char* path, int zero_based, int64_t* n_rows,
       if (j > maxf) maxf = j;
     }
   }
+  if (lr.nul) return kErrNul;
   *n_rows = rows;
   *max_feature = maxf;
   return 0;
@@ -321,6 +334,7 @@ int svm_fill(const char* path, int zero_based, int64_t n_rows,
     if (rc != 0) return rc;
     ++i;
   }
+  if (lr.nul) return kErrNul;
   return i == n_rows ? 0 : kErrParse;
 }
 
@@ -341,6 +355,7 @@ int64_t csv_count_rows(const char* path, int skip_header) {
     }
     ++n;
   }
+  if (lr.nul) return kErrNul;
   return n;
 }
 
@@ -365,6 +380,7 @@ int csv_dims(const char* path, int skip_header, int64_t* n_rows,
     }
     ++rows;
   }
+  if (lr.nul) return kErrNul;
   *n_rows = rows;
   *n_cols = cols;
   return 0;
@@ -408,6 +424,7 @@ int csv_fill(const char* path, int skip_header, int64_t label_col,
     ++i;
   }
   free(tmp);
+  if (lr.nul) return kErrNul;
   return i == n_rows ? 0 : kErrParse;
 }
 
@@ -544,6 +561,7 @@ int64_t reader_next(void* handle, int64_t max_rows, float* X, float* y) {
     ++i;
   }
   free(tmp);
+  if (r->lr.nul) return kErrNul;
   return i;
 }
 

@@ -122,7 +122,10 @@ def learner_fingerprint(learner: BaseLearner) -> str:
     checkpointers and bagging's warm-start guard). Built on the SAME
     canonical key as ``BaseLearner.__hash__``/``__eq__`` so jit-cache
     identity and fingerprint identity can never diverge."""
-    return repr(learner._params_key()) + type(learner).__qualname__
+    # list(...) preserves the historical string format (repr of a
+    # sorted LIST of pairs) so pre-existing stream checkpoints keep
+    # resuming across this refactor
+    return repr(list(learner._params_key())) + type(learner).__qualname__
 
 
 def check_resume_config(meta: dict, config: dict, path: str) -> None:

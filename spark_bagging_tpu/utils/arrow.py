@@ -95,8 +95,14 @@ def _batch_to_xy(
         for name in feature_names
     ]
     X = np.stack(cols, axis=1).astype(np.float32, copy=False)
+    # y cast matches the docstring contract AND every sibling loader
+    # (csv/libsvm/hashed yield float32 labels) — int64 labels from a
+    # parquet column otherwise ride through chunk padding and host-side
+    # comparisons at a different dtype than the same data via CSV
+    # [round-4 audit]
     y = np.asarray(
-        batch.column(label_name).to_numpy(zero_copy_only=False)
+        batch.column(label_name).to_numpy(zero_copy_only=False),
+        np.float32,
     )
     return np.ascontiguousarray(X), y
 

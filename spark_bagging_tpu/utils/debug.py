@@ -50,16 +50,20 @@ def disable_debug() -> None:
 
 @contextlib.contextmanager
 def debug_mode() -> Iterator[None]:
-    """Scoped :func:`enable_debug`; restores the PRIOR state on exit,
-    so nesting inside a process-wide ``enable_debug()`` cannot silently
-    switch the user's debugging off."""
+    """Scoped :func:`enable_debug`; restores the PRIOR state on exit —
+    including a ``jax_debug_nans`` the user enabled DIRECTLY via
+    ``jax.config`` rather than :func:`enable_debug` (round-4 audit:
+    restoring only the module flag silently switched that off)."""
     was_active = debug_active()
+    prior_nans = bool(jax.config.jax_debug_nans)
     enable_debug()
     try:
         yield
     finally:
         if not was_active:
-            disable_debug()
+            global _active
+            _active = False
+        jax.config.update("jax_debug_nans", prior_nans)
 
 
 def check_bootstrap_weights(w: jax.Array) -> None:

@@ -27,25 +27,57 @@ def _weights(sample_weight, n: int) -> np.ndarray:
 
 def accuracy(y_true, y_pred, sample_weight=None) -> float:
     y_true = np.asarray(y_true).ravel()
-    correct = (y_true == np.asarray(y_pred).ravel()).astype(np.float64)
+    y_pred = np.asarray(y_pred).ravel()
+    # a length-1 y_pred would silently BROADCAST into a plausible
+    # score (round-4 audit)
+    _check_same_length(y_true, y_pred)
+    correct = (y_true == y_pred).astype(np.float64)
     w = _weights(sample_weight, len(correct))
     return float((correct * w).sum() / w.sum())
 
 
+def _check_same_length(y_true, y_pred) -> None:
+    if len(y_true) != len(y_pred):
+        raise ValueError(
+            f"y_true has {len(y_true)} samples, y_pred {len(y_pred)}"
+        )
+
+
 def rmse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
-    d = (np.asarray(y_true, np.float64).ravel()
-         - np.asarray(y_pred, np.float64).ravel())
-    return float(np.sqrt(np.mean(d**2)))
+    y_true = np.asarray(y_true, np.float64).ravel()
+    y_pred = np.asarray(y_pred, np.float64).ravel()
+    _check_same_length(y_true, y_pred)
+    return float(np.sqrt(np.mean((y_true - y_pred) ** 2)))
 
 
 def r2_score(y_true, y_pred, sample_weight=None) -> float:
     y_true = np.asarray(y_true, np.float64).ravel()
     y_pred = np.asarray(y_pred, np.float64).ravel()
+    _check_same_length(y_true, y_pred)
     w = _weights(sample_weight, len(y_true))
     mean = (w * y_true).sum() / w.sum()
     ss_res = float((w * (y_true - y_pred) ** 2).sum())
     ss_tot = float((w * (y_true - mean) ** 2).sum())
-    return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+    if ss_tot > 0:
+        return 1.0 - ss_res / ss_tot
+    # constant target: perfect predictions score 1.0, anything else
+    # 0.0 — sklearn's convention (round-4 audit; a flat 0.0 made a
+    # perfect model indistinguishable from an arbitrary one)
+    return 1.0 if ss_res == 0 else 0.0
+
+
+def _check_binary_labels(y_true: np.ndarray) -> None:
+    """The binary rank metrics treat label==1 as positive and EVERY
+    other value as negative; a {1, 2}-coded dataset would silently
+    score inverted (round-4 audit). Accept the common binary codings
+    only."""
+    vals = np.unique(y_true)
+    if not (np.isin(vals, (0, 1)).all() or np.isin(vals, (-1, 1)).all()
+            or np.isin(vals, (False, True)).all()):
+        raise ValueError(
+            f"binary metric needs labels in {{0,1}} or {{-1,1}}, got "
+            f"{vals[:5]}"
+        )
 
 
 def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
@@ -57,6 +89,7 @@ def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     """
     y_true = np.asarray(y_true).ravel()  # column vectors welcome,
     scores = np.asarray(scores, np.float64).ravel()  # like every sibling
+    _check_binary_labels(y_true)
     n = len(scores)
     order = np.argsort(scores, kind="mergesort")
     s = scores[order]
@@ -95,6 +128,7 @@ def pr_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     Σ (R_k − R_{k−1})·P_k over descending-score thresholds."""
     y_true = np.asarray(y_true).ravel()
     scores = np.asarray(scores, np.float64).ravel()
+    _check_binary_labels(y_true)
     n_pos = int((y_true == 1).sum())
     if n_pos == 0:
         return 0.0

@@ -123,10 +123,27 @@ def _fptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+_ERR_NUL = -4
+
+
+def _nul_fallback(path: str) -> None:
+    """An embedded NUL byte ended the native parse: the C parsers work
+    on NUL-terminated line buffers and would otherwise silently
+    truncate rows, diverging from the Python fallback (round-4 audit).
+    Warn and hand the file to the Python parsers instead."""
+    import warnings
+
+    warnings.warn(
+        f"{path} contains an embedded NUL byte; falling back to the "
+        "Python parser for this file", stacklevel=3,
+    )
+
+
 def parse_libsvm_native(
     path: str, n_features: int | None = None, zero_based: bool = False
 ) -> tuple[np.ndarray, np.ndarray] | None:
-    """Native libsvm parse; None if the library is unavailable."""
+    """Native libsvm parse; None if the library is unavailable (or the
+    file needs the Python fallback's handling)."""
     lib = get_lib()
     if lib is None:
         return None
@@ -135,14 +152,25 @@ def parse_libsvm_native(
         path.encode(), int(zero_based), ctypes.byref(rows),
         ctypes.byref(maxf),
     )
+    if rc == _ERR_NUL:
+        _nul_fallback(path)
+        return None
     if rc != 0:
         raise OSError(f"native svm_dims failed ({rc}) for {path}")
     d = n_features if n_features is not None else int(maxf.value)
+    if d <= 0:
+        # label-only file: svm_fill rejects n_features<=0, but the
+        # Python fallback loads it as (n, 0) — degrade gracefully the
+        # same way [round-4 audit]
+        return None
     X = np.zeros((int(rows.value), d), np.float32)
     y = np.zeros((int(rows.value),), np.float32)
     rc = lib.svm_fill(
         path.encode(), int(zero_based), rows.value, d, _fptr(X), _fptr(y)
     )
+    if rc == _ERR_NUL:
+        _nul_fallback(path)
+        return None
     if rc != 0:
         raise ValueError(f"native svm_fill failed ({rc}) for {path}")
     return X, y
@@ -160,6 +188,9 @@ def load_csv_native(
         path.encode(), int(skip_header), ctypes.byref(rows),
         ctypes.byref(cols),
     )
+    if rc == _ERR_NUL:
+        _nul_fallback(path)
+        return None
     if rc != 0:
         raise OSError(f"native csv_dims failed ({rc}) for {path}")
     n, c = int(rows.value), int(cols.value)
@@ -169,6 +200,9 @@ def load_csv_native(
         path.encode(), int(skip_header), int(label_col), n, c,
         _fptr(X), _fptr(y),
     )
+    if rc == _ERR_NUL:
+        _nul_fallback(path)
+        return None
     if rc != 0:
         raise ValueError(f"native csv_fill failed ({rc}) for {path}")
     return X, y
@@ -267,6 +301,13 @@ class NativeReader:
                 got = lib.reader_next(
                     self._h, self._block_rows, _fptr(X), _fptr(y)
                 )
+                if got == _ERR_NUL:
+                    raise ValueError(
+                        "native reader hit an embedded NUL byte "
+                        "mid-stream; re-open the source with the "
+                        "Python parser (e.g. remove NULs, or use the "
+                        "fallback path)"
+                    )
                 if got < 0:
                     raise ValueError(f"native reader_next failed ({got})")
                 if got == 0:

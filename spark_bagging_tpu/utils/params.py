@@ -36,7 +36,11 @@ class ParamsMixin:
         for name in self._param_names():
             value = getattr(self, name)
             out[name] = value
-            if deep and hasattr(value, "get_params"):
+            # `not isinstance(value, type)`: a CLASS passed as a param
+            # exposes an unbound get_params (sklearn's guard) — calling
+            # it would TypeError [round-4 audit]
+            if (deep and hasattr(value, "get_params")
+                    and not isinstance(value, type)):
                 for sub, sub_val in value.get_params(deep=True).items():
                     out[f"{name}__{sub}"] = sub_val
         return out

@@ -53,3 +53,20 @@ def test_fit_runs_clean_under_debug_mode():
             n_estimators=4, seed=0,
         ).fit(X, y)
     assert clf.score(X, y) > 0.8
+
+
+def test_debug_mode_restores_directly_enabled_nan_flag():
+    """A user enabling jax_debug_nans via jax.config (not
+    enable_debug) must keep it after a debug_mode() scope exits
+    (round-4 audit)."""
+    import jax
+
+    from spark_bagging_tpu.utils.debug import debug_mode
+
+    jax.config.update("jax_debug_nans", True)
+    try:
+        with debug_mode():
+            pass
+        assert bool(jax.config.jax_debug_nans) is True
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -1,6 +1,7 @@
 """Metric helper tests against sklearn references."""
 
 import numpy as np
+import pytest
 from sklearn import metrics as skm
 
 from spark_bagging_tpu.utils.metrics import (
@@ -157,3 +158,33 @@ def test_roc_auc_accepts_column_vectors():
     flat = roc_auc(y, s)
     assert roc_auc(y.reshape(-1, 1), s.reshape(-1, 1)) == flat
     assert roc_auc(y.reshape(-1, 1), s) == flat
+
+
+def test_r2_constant_target_matches_sklearn():
+    """Perfect predictions on a constant target score 1.0, not 0.0
+    (round-4 audit)."""
+    from spark_bagging_tpu.utils.metrics import r2_score
+
+    assert r2_score([3.0, 3.0, 3.0], [3.0, 3.0, 3.0]) == 1.0
+    assert r2_score([3.0, 3.0, 3.0], [2.0, 3.0, 4.0]) == 0.0
+
+
+def test_accuracy_rejects_length_mismatch():
+    from spark_bagging_tpu.utils.metrics import accuracy
+
+    with pytest.raises(ValueError, match="samples"):
+        accuracy([0, 1, 1, 0], [1])
+
+
+def test_binary_metrics_reject_noncanonical_labels():
+    """{1,2}-coded labels would silently score INVERTED (label!=1 is
+    treated negative) — reject them (round-4 audit)."""
+    from spark_bagging_tpu.utils.metrics import pr_auc, roc_auc
+
+    s = [0.1, 0.9, 0.4, 0.7]
+    assert roc_auc([0, 1, 0, 1], s) == 1.0
+    assert roc_auc([-1, 1, -1, 1], s) == 1.0
+    with pytest.raises(ValueError, match="labels"):
+        roc_auc([1, 2, 1, 2], s)
+    with pytest.raises(ValueError, match="labels"):
+        pr_auc([1, 2, 1, 2], s)
