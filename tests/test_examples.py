@@ -21,6 +21,7 @@ FAST_EXAMPLES = [
     "05_custom_learner.py",
     "06_learner_zoo.py",
     "07_survival_aft.py",
+    "08_out_of_core.py",
 ]
 
 
