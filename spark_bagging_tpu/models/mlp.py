@@ -52,6 +52,8 @@ class _MLPBase(BaseLearner):
         activation: str = "relu",
         precision: str = "high",
     ):
+        if max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {max_iter}")
         if activation not in _ACTIVATIONS:
             raise ValueError(
                 f"activation must be one of {sorted(_ACTIVATIONS)}, "

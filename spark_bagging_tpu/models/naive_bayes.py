@@ -75,7 +75,14 @@ class GaussianNB(BaseLearner):
         # the weighted data. One-hot rows partition the weights, so the
         # global second moment is just Σ_c s2 — no extra reduction.
         gvar = jnp.maximum(s2.sum(axis=0) / w_sum, 0.0)
-        var = var + self.var_smoothing * jnp.max(gvar)
+        # floored smoothing: with every selected feature constant
+        # (or an all-zero draw) max(gvar) is exactly 0 and the
+        # smoothing term would vanish, making 1/var inf and the
+        # scores NaN — the finiteness the docstring promises
+        # [round-4 audit]
+        var = var + jnp.maximum(
+            self.var_smoothing * jnp.max(gvar), 1e-12
+        )
         log_prior = jnp.log(jnp.maximum(cls_w, 1e-12) / w_sum)
         params = {
             "log_prior": log_prior, "shift": gmean, "mean": dmean,

@@ -116,7 +116,9 @@ class LinearSVC(PooledStartMixin, BaseLearner):
         del key, prepared  # deterministic solver; no precomputation
         Xb = augment_bias(X.astype(jnp.float32))
         w = sample_weight.astype(jnp.float32)
-        w_sum = maybe_psum(jnp.sum(w), axis_name)
+        # floor: all-zero bootstrap draws must stay finite
+        # (round-4 audit; see linear.py)
+        w_sum = jnp.maximum(maybe_psum(jnp.sum(w), axis_name), 1e-12)
         d = Xb.shape[1]
         C = params["W"].shape[1]
         # L2 on feature rows only; the bias row is conditioned by the

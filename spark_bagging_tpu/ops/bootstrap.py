@@ -72,9 +72,20 @@ def poisson_counts(
     return jnp.searchsorted(cdf, u, side="left").astype(dtype)
 
 # Stream tags folded into the base key so row draws, feature draws, and
-# learner-init keys are independent streams.
+# learner-init keys are independent streams. The ROW stream is tagged
+# too [round-4 audit]: an untagged fold_in(key, replica_id) collides
+# with the other streams' base keys exactly at replica_id == tag
+# (0xF17 = 3863 < the 1000s-of-replicas design scale), which would
+# share counter blocks between replica 3863's row uniforms and every
+# replica's fit keys.
 _FEATURE_STREAM = 0x5EED
 _FIT_STREAM = 0xF17
+_ROW_STREAM = 0xB0B5
+# Bumped whenever the key schedule above changes (schema 2 = the
+# _ROW_STREAM retag): stream checkpoints fingerprint this so a
+# snapshot trained under an older schedule is rejected at resume
+# instead of splicing replicas from two different bootstrap samples.
+RNG_SCHEMA = 2
 
 
 def replica_keys(key: jax.Array, replica_ids: jax.Array) -> jax.Array:
@@ -139,17 +150,21 @@ def bootstrap_weights_one(
     ``ratio`` maps to the reference's row-sampling ratio param
     (``max_samples`` in the sklearn vocabulary).
     """
-    k = jax.random.fold_in(key, replica_id)
+    if ratio <= 0:
+        # validated for BOTH branches: with replacement, Poisson(0)
+        # would silently return all-zero weights for every replica
+        # instead of an error [round-4 audit]; without, m=max(1,·)
+        # could mask a nonsensical ratio as a full-weight sample
+        raise ValueError(f"ratio={ratio} must be positive")
+    k = jax.random.fold_in(
+        jax.random.fold_in(key, _ROW_STREAM), replica_id
+    )
     if replacement:
         if ratio <= _INV_CDF_MAX_LAM:
             counts = poisson_counts(k, ratio, n_rows)
         else:  # rare huge-oversampling case: exact rejection sampler
             counts = jax.random.poisson(k, ratio, (n_rows,))
         return jnp.minimum(counts, _MAX_COUNT).astype(dtype)
-
-    if ratio <= 0:  # before the m computation — m=max(1,·) could
-        # otherwise mask a nonsensical ratio as a full-weight sample
-        raise ValueError(f"ratio={ratio} must be positive")
     m = max(1, int(round(ratio * n_rows)))
     if m >= n_rows:
         return jnp.ones((n_rows,), dtype)

@@ -44,6 +44,7 @@ from spark_bagging_tpu.parallel.multihost import global_put, to_host
 
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.ops.bootstrap import (
+    RNG_SCHEMA,
     bootstrap_weights_one,
     feature_subspaces,
     replica_init_fit_keys,
@@ -319,6 +320,11 @@ def fit_ensemble_stream(
         # chunks while passing every other check (round-4 audit)
         "n_rows": source.n_rows,
         "n_chunks": source.n_chunks,
+        # bootstrap RNG schema: the round-4 _ROW_STREAM retag changed
+        # every weight draw, so a pre-retag snapshot must not resume
+        # under the new scheme (it would splice each replica from two
+        # different bootstrap samples); absent key == schema 1 == reject
+        "rng_schema": RNG_SCHEMA,
         "aux_col": aux_col,
         "learner": learner_fingerprint(learner),
     }

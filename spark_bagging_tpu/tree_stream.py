@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P
 
 from spark_bagging_tpu.models.tree import _TreeBase, _quantile_edges
 from spark_bagging_tpu.ops.bootstrap import (
+    RNG_SCHEMA,
     bootstrap_weights_one,
     feature_subspaces,
     replica_init_fit_keys,
@@ -143,6 +144,8 @@ def fit_tree_ensemble_stream(
         # audit; matches fit_ensemble_stream's fingerprint)
         "n_rows": source.n_rows,
         "n_chunks": source.n_chunks,
+        # see streaming.py: pre-retag snapshots must not resume
+        "rng_schema": RNG_SCHEMA,
         # the weight stream folds the data-shard index, so a resumed
         # run must use the same data-axis size or its remaining passes
         # would draw different bootstrap weights than the snapshot's

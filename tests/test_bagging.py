@@ -658,6 +658,18 @@ def test_all_zero_bootstrap_draws_stay_finite(breast_cancer):
         n_estimators=16, max_samples=0.005, seed=0,
     ).fit(X, y)
     assert np.isfinite(fm.predict_proba(X)).all()
+    from spark_bagging_tpu.models import GaussianNB, LinearSVC
+
+    svc = BaggingClassifier(
+        base_learner=LinearSVC(max_iter=5),
+        n_estimators=16, max_samples=0.005, seed=0,
+    ).fit(X, y)
+    assert np.isfinite(svc.decision_function(X)).all()
+    nb = BaggingClassifier(
+        base_learner=GaussianNB(),
+        n_estimators=16, max_samples=0.005, seed=0,
+    ).fit(X, y)
+    assert np.isfinite(nb.predict_proba(X)).all()
 
 
 def test_learner_hash_eq_consistent():

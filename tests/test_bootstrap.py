@@ -119,3 +119,43 @@ def test_subsample_count_rounds_exactly():
     assert int(np.asarray(w).sum()) == 15
     w1 = bootstrap_weights_one(key, 0, 49, ratio=1 / 49, replacement=False)
     assert int(np.asarray(w1).sum()) == 1
+
+
+def test_nonpositive_ratio_rejected_both_branches():
+    """Poisson(0) with replacement silently produced all-zero weights
+    for every replica (round-4 audit) — both branches now reject."""
+    from spark_bagging_tpu.ops.bootstrap import bootstrap_weights_one
+
+    key = jax.random.key(0)
+    for repl in (True, False):
+        with pytest.raises(ValueError, match="positive"):
+            bootstrap_weights_one(key, 0, 100, ratio=0.0, replacement=repl)
+
+
+def test_row_stream_is_tagged():
+    """Row draws derive via the tagged _ROW_STREAM fold — an untagged
+    fold_in(key, replica_id) collided with the fit-stream base at
+    replica_id 0xF17 = 3863 (round-4 audit)."""
+    from spark_bagging_tpu.ops.bootstrap import (
+        _ROW_STREAM,
+        bootstrap_weights_one,
+    )
+
+    key = jax.random.key(7)
+    w = bootstrap_weights_one(key, 3863, 64, ratio=1.0)
+    manual_key = jax.random.fold_in(
+        jax.random.fold_in(key, _ROW_STREAM), 3863
+    )
+    from spark_bagging_tpu.ops.bootstrap import poisson_counts
+
+    np.testing.assert_array_equal(
+        np.asarray(w), np.asarray(poisson_counts(manual_key, 1.0, 64))
+    )
+    # ...and the fit-stream base no longer shares its counter blocks
+    from spark_bagging_tpu.ops.bootstrap import _FIT_STREAM
+
+    colliding = jax.random.fold_in(key, _FIT_STREAM)
+    assert not np.array_equal(
+        np.asarray(jax.random.key_data(manual_key)),
+        np.asarray(jax.random.key_data(colliding)),
+    )

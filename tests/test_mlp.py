@@ -188,3 +188,12 @@ def test_full_batch_size_degenerates_to_exact_path():
     for a, c in zip(jax.tree_util.tree_leaves(pa),
                     jax.tree_util.tree_leaves(pc)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_mlp_rejects_nonpositive_max_iter():
+    import pytest
+
+    from spark_bagging_tpu.models import MLPClassifier
+
+    with pytest.raises(ValueError, match="max_iter"):
+        MLPClassifier(max_iter=0)
