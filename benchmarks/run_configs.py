@@ -644,15 +644,18 @@ def main() -> None:
     if args.one_config is not None:
         import jax
 
+        import compile_cache
         from spark_bagging_tpu.utils.datasets import SYNTHETICS_VERSION
 
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
+        compile_cache.enable()
         t0 = time.perf_counter()
         try:
             res = CONFIGS[args.one_config](args.scale)
             res["wall_seconds"] = round(time.perf_counter() - t0, 2)
             res["backend"] = jax.default_backend()
+            res["compile_cache"] = compile_cache.stats()
             # rows captured under an older synthetic generator must not
             # resume or settle a capture stage (the sweep's workload-
             # stamp rule, applied to config rows)

@@ -8,6 +8,13 @@
 # losing it. Exits only when everything is captured.
 cd /root/repo || exit 1
 log=benchmarks/tpu_watch.log
+# One persistent XLA compilation cache for every stage child, so a
+# revived tunnel reuses executables compiled in a prior window instead
+# of re-paying 2-14s+ per compile out of a ~3-minute window [VERDICT
+# r4 ask#2]. The measuring children ALSO call compile_cache.enable()
+# (the min-compile-time knob is config-only); this export covers any
+# process the isolation protocol doesn't wrap.
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 echo "watch v2 start $(date -u +%H:%M:%S)" >> "$log"
 
 alive() {

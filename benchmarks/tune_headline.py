@@ -82,6 +82,9 @@ GRID = [
 def run_cell(impl: str, chunk, row_tile, max_iter: int,
              init: str) -> dict:
     """Measure one grid cell (called in the child process)."""
+    import compile_cache
+
+    compile_cache.enable()
     from headline_data import HEADLINE, WORKLOAD, load_headline_data
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
 
@@ -111,6 +114,7 @@ def run_cell(impl: str, chunk, row_tile, max_iter: int,
     cell["chunk_resolved"] = rep.get("chunk_size_resolved", chunk)
     cell["acc"] = round(float(clf.score(X[:100_000], y[:100_000])), 4)
     cell["workload"] = WORKLOAD
+    cell["compile_cache"] = compile_cache.stats()
     return cell
 
 

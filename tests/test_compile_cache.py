@@ -1,0 +1,92 @@
+"""Persistent compilation cache: cross-process reuse [VERDICT r4 ask#2].
+
+The capture machinery's children are freshly spawned interpreters
+(benchmarks/isolation.py), so executable reuse across a tunnel window
+boundary is exactly "a second process hits entries a first process
+wrote". That is what these tests prove on the CPU backend; the TPU-side
+evidence rides the ``compile_cache`` counters every benchmark row now
+records.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import compile_cache  # noqa: E402
+
+
+def test_cross_process_cache_hits(tmp_path):
+    result = compile_cache.probe(str(tmp_path))
+    cold, warm = result["cold"], result["warm"]
+    # first interpreter: real XLA compiles, entries written to disk
+    assert cold["cache"]["misses"] > 0
+    assert cold["cache"]["hits"] == 0
+    assert cold["cache"]["entries"] > 0
+    # second interpreter: the jitted step comes back from disk
+    assert warm["cache"]["hits"] > 0
+    # and no new compile was paid for the step itself (misses can be
+    # nonzero only for trivial sub-0.1s ops excluded by the min-compile
+    # knob; the big entry must hit)
+    assert warm["cache"]["entries"] == cold["cache"]["entries"]
+
+
+def test_enable_idempotent(tmp_path):
+    # enable() in THIS process: the conftest already initialized the
+    # CPU backend, so this exercises the real config path
+    first = compile_cache.enable(str(tmp_path / "a"))
+    again = compile_cache.enable(str(tmp_path / "b"))
+    assert first == again, "second enable() must not re-point the cache"
+    snap = compile_cache.stats()
+    assert set(snap) >= {"hits", "misses", "saved_sec"}
+
+
+def test_env_var_routes_cache_dir(tmp_path):
+    # JAX_COMPILATION_CACHE_DIR is how isolation.py/tpu_watch.sh land
+    # children in the shared cache; a fresh interpreter must pick it up
+    # when enable() gets no explicit dir. (Fresh subprocess because
+    # _enabled_dir is already pinned in this one.)
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    env_dir = str(tmp_path / "from_env")
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(compile_cache.__file__))!r});"
+        "import json, compile_cache;"
+        "print('DIR ' + json.dumps(compile_cache.enable()))"
+    )
+    proc = subprocess.run(
+        [_sys.executable, "-c", code],
+        env=dict(os.environ, JAX_COMPILATION_CACHE_DIR=env_dir),
+        capture_output=True, text=True, timeout=120,
+    )
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith("DIR "))
+    assert _json.loads(line[len("DIR "):]) == env_dir
+
+
+def test_enable_degrades_without_aborting(tmp_path, monkeypatch):
+    # A cache-infrastructure failure must not kill the measurement it
+    # was meant to speed up: point the dir at an uncreatable path in a
+    # fresh subprocess and require rc=0 with the warning on stderr.
+    import subprocess
+    import sys as _sys
+
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("")
+    bad_dir = str(blocker / "child")  # makedirs under a FILE → raises
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(compile_cache.__file__))!r});"
+        "import compile_cache;"
+        f"assert compile_cache.enable({bad_dir!r}) is None;"
+        "print('DEGRADED OK')"
+    )
+    proc = subprocess.run([_sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "DEGRADED OK" in proc.stdout
+    assert "persistent compile cache disabled" in proc.stderr
