@@ -65,6 +65,18 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
     ``l2`` regularization strength, ``max_iter`` solver iterations
     (static, for jit), ``solver`` in {"newton", "adam"}, ``lr`` the Adam
     step size (ignored by Newton).
+
+    ``precision`` sets the MXU matmul precision for the solver's math
+    (a ``jax.default_matmul_precision`` name: "default" = fastest bf16,
+    "high" = 3-pass bf16 ≈ f32 accuracy at ~2.7x the f32 rate,
+    "highest"/"float32" = exact f32). Caveat for
+    ``hessian_impl="pallas"``: the kernel takes the operand dtype
+    directly instead of an XLA precision mode, so "high" maps to
+    SINGLE-pass bf16 there — measurably lower Hessian accuracy than
+    the 3-pass bf16 the XLA impls run at the same setting (the
+    solve-time damping and the parity gate absorb it; see the rationale
+    at the kernel call site). Only "highest"/"float32" pin exact f32
+    operands across every impl [ADVICE r4 low].
     """
 
     task = "classification"

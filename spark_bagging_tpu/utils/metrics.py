@@ -83,6 +83,12 @@ def _check_binary_labels(y_true: np.ndarray) -> None:
 def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     """Binary ROC AUC via the rank statistic (ties get average rank).
 
+    ``y_true`` must use a standard binary coding — {0,1}, {-1,1}, or
+    bool, with 1/True positive; anything else (e.g. {1,2}, NaNs, float
+    probabilities) raises rather than silently scoring inverted
+    [round-4 audit; ADVICE r4 low — previously such inputs returned a
+    number].
+
     O(n log n): one sort, then tie runs are averaged with run-boundary
     arithmetic — no per-unique-value scan (a continuous-score 400k-row
     test set must cost seconds, not hours).
@@ -125,7 +131,11 @@ def pr_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     """Area under the precision-recall curve
     (Spark BinaryClassificationEvaluator metricName=areaUnderPR),
     computed as average precision — the step-function integral
-    Σ (R_k − R_{k−1})·P_k over descending-score thresholds."""
+    Σ (R_k − R_{k−1})·P_k over descending-score thresholds.
+
+    ``y_true`` must use a standard binary coding — {0,1}, {-1,1}, or
+    bool, with 1/True positive; other codings raise (see ``roc_auc``).
+    """
     y_true = np.asarray(y_true).ravel()
     scores = np.asarray(scores, np.float64).ravel()
     _check_binary_labels(y_true)

@@ -212,3 +212,39 @@ def test_resave_under_other_compression_never_loads_stale(tmp_path, iris):
     assert not os.path.exists(os.path.join(path, "arrays.msgpack.zst"))
     loaded = load_model(path)
     np.testing.assert_array_equal(loaded.predict(X), b.predict(X))
+
+
+def test_stale_rng_schema_disables_weight_replay(tmp_path, iris):
+    """A checkpoint saved under an older (or unrecorded) bootstrap
+    key-derivation schema must not silently replay weights that don't
+    match what its replicas were trained on [ADVICE r4 medium]: load
+    warns, replica_weights() raises, predictions are unaffected."""
+    import json
+    import os
+
+    X, y = iris
+    clf = BaggingClassifier(n_estimators=4, seed=1).fit(X, y)
+    path = str(tmp_path / "m")
+    clf.save(path)
+
+    # current-schema load replays fine, no warning
+    loaded = BaggingClassifier.load(path)
+    np.testing.assert_array_equal(
+        loaded.replica_weights(0), clf.replica_weights(0)
+    )
+
+    # simulate a pre-retag save: older schema number, then absent key
+    mpath = os.path.join(path, "manifest.json")
+    manifest = json.load(open(mpath))
+    for stale in (1, None):
+        if stale is None:
+            manifest["fitted"].pop("rng_schema", None)
+        else:
+            manifest["fitted"]["rng_schema"] = stale
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        with pytest.warns(UserWarning, match="RNG schema"):
+            stale_model = BaggingClassifier.load(path)
+        np.testing.assert_array_equal(stale_model.predict(X), clf.predict(X))
+        with pytest.raises(ValueError, match="replayable"):
+            stale_model.replica_weights(0)
