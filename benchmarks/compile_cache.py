@@ -79,6 +79,17 @@ def enable(cache_dir: str | None = None) -> str | None:
         os.makedirs(path, exist_ok=True)
 
         import jax
+        # Listener registration FIRST: it uses a private jax API (the
+        # most likely thing a jax upgrade breaks), and failing AFTER
+        # the config updates would leave the cache active while
+        # enable() reports it disabled — every result row would then
+        # carry hits=0 evidence pointing at the wrong conclusion
+        # (remote-compile defeats caching) when the cache in fact
+        # fired.
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
 
         jax.config.update("jax_compilation_cache_dir", path)
         # The env var spelling of these two knobs is NOT read by this
@@ -89,11 +100,6 @@ def enable(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           MIN_COMPILE_SECS)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
-        from jax._src import monitoring
-
-        monitoring.register_event_listener(_on_event)
-        monitoring.register_event_duration_secs_listener(_on_duration)
         _enabled_dir = path
         return path
     except Exception as e:  # noqa: BLE001 — degrade, never abort
@@ -123,6 +129,10 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {bench_dir!r})
 import compile_cache
 compile_cache.enable({cache_dir!r})
+# probe-only: the probe step compiles near the MIN_COMPILE_SECS write
+# threshold on a fast host, which would flake the cold-writes-entries
+# assertion — cache everything for this child regardless of speed
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 @jax.jit
 def step(x, w):

