@@ -7,7 +7,10 @@ the TPU-native framework accepts it natively:
 
 - :func:`load_arrow` — whole-file parquet / feather / Arrow-IPC →
   ``(X, y)`` float32 host matrices (columnar → dense, zero-copy where
-  the column layout allows).
+  the column layout allows). Per-feature columns decode through a
+  column→row transpose; a single fixed-size-list feature column is the
+  row-major block already and decodes at disk speed — prefer it for
+  wide data you produce yourself.
 - :class:`ArrowChunks` — a :class:`~spark_bagging_tpu.utils.io.ChunkSource`
   streaming record batches for the out-of-core engine (``fit_stream``)
   without materializing the file [SURVEY §7 step 8].
@@ -86,15 +89,24 @@ def _resolve_columns(
     return label, feats
 
 
+def _fsl_width(typ) -> int | None:
+    """Width of a fixed-size-list-of-numbers column, else None."""
+    import pyarrow as pa
+
+    if pa.types.is_fixed_size_list(typ) and (
+        pa.types.is_floating(typ.value_type)
+        or pa.types.is_integer(typ.value_type)
+    ):
+        return int(typ.list_size)
+    return None
+
+
 def _batch_to_xy(
     batch, feature_names: list[str], label_name: str
 ) -> tuple[np.ndarray, np.ndarray]:
     """One Arrow record batch → dense (X, y) float32/float32."""
-    cols = [
-        batch.column(name).to_numpy(zero_copy_only=False)
-        for name in feature_names
-    ]
-    X = np.stack(cols, axis=1).astype(np.float32, copy=False)
+    import pyarrow as pa
+
     # y cast matches the docstring contract AND every sibling loader
     # (csv/libsvm/hashed yield float32 labels) — int64 labels from a
     # parquet column otherwise ride through chunk padding and host-side
@@ -104,6 +116,43 @@ def _batch_to_xy(
         batch.column(label_name).to_numpy(zero_copy_only=False),
         np.float32,
     )
+    cols = [batch.column(name) for name in feature_names]
+    cols = [
+        c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+        for c in cols  # Table path (load_arrow)
+    ]
+    if any(_fsl_width(c.type) is not None for c in cols):
+        # guard shared by BOTH entry points (ArrowChunks also rejects
+        # at init, for the earlier error): a list column mixed with
+        # scalar features would otherwise die in np.stack with a
+        # cryptic "setting an array element with a sequence"
+        if len(cols) > 1:
+            raise ValueError(
+                "a fixed-size-list feature column must be the ONLY "
+                f"feature column, got {feature_names}"
+            )
+        col = cols[0]
+        # Row-major feature block: the values buffer already IS the
+        # (n, d) matrix, so decode skips the column→row transpose
+        # that bounds the per-feature layout at ~150 MB/s for wide
+        # data (measured round 5: 0.55 s vs 0.0006 s on a 200k×256
+        # batch — the difference between starving a TPU stream and
+        # feeding it at disk speed).
+        if col.null_count:
+            raise ValueError(
+                f"feature column {feature_names[0]!r} has "
+                f"{col.null_count} null rows — flatten() would "
+                "silently misalign the reshape"
+            )
+        d = col.type.list_size
+        # flatten() (not .values) honors slice offsets
+        X = col.flatten().to_numpy(zero_copy_only=False)
+        return np.ascontiguousarray(
+            X.reshape(len(col), d).astype(np.float32, copy=False)
+        ), y
+    X = np.stack(
+        [c.to_numpy(zero_copy_only=False) for c in cols], axis=1
+    ).astype(np.float32, copy=False)
     return np.ascontiguousarray(X), y
 
 
@@ -169,6 +218,7 @@ class ArrowChunks(ChunkSource):
                 pf.schema_arrow.field(i).name
                 for i in range(len(pf.schema_arrow))
             ]
+            types = {n: pf.schema_arrow.field(n).type for n in names}
             self.n_rows = int(pf.metadata.num_rows)
         else:
             import pyarrow as pa
@@ -178,6 +228,7 @@ class ArrowChunks(ChunkSource):
             with pa.memory_map(path) as source:
                 reader = pa.ipc.open_file(source)
                 names = reader.schema.names
+                types = {n: reader.schema.field(n).type for n in names}
                 self.n_rows = sum(
                     reader.get_batch(i).num_rows
                     for i in range(reader.num_record_batches)
@@ -185,25 +236,67 @@ class ArrowChunks(ChunkSource):
         self._label, self._features = _resolve_columns(
             names, label_col, columns
         )
-        self.n_features = len(self._features)
+        # Row-major fast path: ONE fixed-size-list feature column is the
+        # whole (n, d) block (decode = reshape, no transpose) — write
+        # wide data this way when you control the producer
+        # (benchmarks/out_of_core_file.py does; measured ~150 MB/s →
+        # disk-speed scan at 1024 features).
+        widths = [_fsl_width(types[f]) for f in self._features]
+        if any(w is not None for w in widths):
+            if len(self._features) > 1:
+                raise ValueError(
+                    "a fixed-size-list feature column must be the ONLY "
+                    f"feature column, got {self._features}"
+                )
+            self.n_features = widths[0]
+        else:
+            self.n_features = len(self._features)
 
     def _iter_raw(self):
-        read_cols = self._features + [self._label]
+        yield from self._iter_raw_from(0)
+
+    def _iter_raw_from(self, start_chunk: int):
+        """Row-exact seek for ``chunks_from`` (checkpoint resume): IPC
+        record batches are randomly accessible and parquet row groups
+        skip by metadata, so resuming late in a big file costs metadata
+        reads instead of re-ingesting (and re-decoding) every chunk
+        before the cursor — the base class's consume-and-discard
+        fallback did exactly that."""
+        skip = start_chunk * self.chunk_rows
         if self._parquet:
             import pyarrow.parquet as pq
 
             pf = pq.ParquetFile(self.path)
+            groups: list[int] = []
+            for g in range(pf.num_row_groups):
+                n = pf.metadata.row_group(g).num_rows
+                if skip >= n:
+                    skip -= n
+                    continue
+                groups = list(range(g, pf.num_row_groups))
+                break
             for batch in pf.iter_batches(
-                batch_size=self.chunk_rows, columns=read_cols
+                batch_size=self.chunk_rows, row_groups=groups,
+                columns=self._features + [self._label],
             ):
+                if skip:
+                    if skip >= batch.num_rows:
+                        skip -= batch.num_rows
+                        continue
+                    batch = batch.slice(skip)
+                    skip = 0
                 yield _batch_to_xy(batch, self._features, self._label)
         else:
             import pyarrow as pa
 
-            del read_cols  # _batch_to_xy picks columns by name
             with pa.memory_map(self.path) as source:
                 reader = pa.ipc.open_file(source)
                 for i in range(reader.num_record_batches):
-                    yield _batch_to_xy(
-                        reader.get_batch(i), self._features, self._label
-                    )
+                    b = reader.get_batch(i)
+                    if skip >= b.num_rows:
+                        skip -= b.num_rows  # metadata-only skip
+                        continue
+                    if skip:
+                        b = b.slice(skip)
+                        skip = 0
+                    yield _batch_to_xy(b, self._features, self._label)

@@ -28,6 +28,26 @@ from spark_bagging_tpu.utils.io import ChunkSource
 _DONE = object()
 
 
+def _touch_pages(item) -> None:
+    """Force each chunk array RESIDENT on the producer thread.
+
+    Zero-copy sources (ArrowChunks' row-major fixed-size-list layout)
+    yield views over a memory map: without this, the producer enqueues
+    untouched views and the disk page-in happens at first access on
+    the CONSUMER thread — silently serializing the I/O this wrapper
+    exists to overlap. One byte per 4 KiB page suffices (no copy, no
+    layout change); non-contiguous or small arrays are already real
+    memory and skip the walk. Measured on the 23.7 GiB cold-cache
+    capture (benchmarks/out_of_core_file.json): this is what makes
+    the prefetch-vs-bare delta structural instead of accidental."""
+    import numpy as np
+
+    for x in item if isinstance(item, tuple) else (item,):
+        if (isinstance(x, np.ndarray) and x.flags.c_contiguous
+                and x.nbytes > (1 << 20)):
+            x.view(np.uint8)[::4096].sum()
+
+
 class PrefetchChunks(ChunkSource):
     """Wrap a ChunkSource so ``chunks()`` is produced on a background
     thread, ``depth`` chunks ahead. Metadata proxies the inner source.
@@ -83,6 +103,7 @@ class PrefetchChunks(ChunkSource):
         def produce() -> None:
             try:
                 for item in self._inner.chunks_from(start):
+                    _touch_pages(item)
                     if not put_or_stop(item):
                         return
                 put_or_stop(_DONE)

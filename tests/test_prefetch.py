@@ -159,3 +159,19 @@ def test_scoring_stream_prefetch_knob():
     assert out is wrapped and out._depth == 5
     acc = clf.score_stream(src, prefetch=0)
     assert acc == clf.score_stream(src)
+
+
+def test_touch_pages_handles_all_array_kinds():
+    """The producer-side page toucher must be safe on every chunk
+    shape a source can yield: contiguous views (the zero-copy Arrow
+    fast path it exists for), non-contiguous slices, small arrays,
+    readonly mmaps, and non-array items."""
+    import numpy as np
+
+    from spark_bagging_tpu.utils.prefetch import _touch_pages
+
+    big = np.zeros((600, 600), np.float32)        # > 1 MiB, contiguous
+    _touch_pages((big, big[:, :3], np.zeros(4), 7, None))
+    ro = np.zeros((600, 600), np.float32)
+    ro.setflags(write=False)
+    _touch_pages((ro, ro[0]))
