@@ -55,6 +55,9 @@ def drop_page_cache() -> bool:
     cache's help. Also keeps the prefetch-vs-bare comparison fair
     (the first fit would otherwise warm the cache for the second)."""
     try:
+        os.sync()  # drop_caches evicts only CLEAN pages: a just-
+        # written dataset's dirty tail would survive and leave the
+        # "cold" scan partially warm [round-5 review]
         with open("/proc/sys/vm/drop_caches", "w") as f:
             f.write("3\n")
         return True

@@ -28,7 +28,7 @@ from spark_bagging_tpu.utils.io import ChunkSource
 _DONE = object()
 
 
-def _touch_pages(item) -> None:
+def _touch_pages(item) -> int:
     """Force each chunk array RESIDENT on the producer thread.
 
     Zero-copy sources (ArrowChunks' row-major fixed-size-list layout)
@@ -37,15 +37,22 @@ def _touch_pages(item) -> None:
     the CONSUMER thread — silently serializing the I/O this wrapper
     exists to overlap. One byte per 4 KiB page suffices (no copy, no
     layout change); non-contiguous or small arrays are already real
-    memory and skip the walk. Measured on the 23.7 GiB cold-cache
-    capture (benchmarks/out_of_core_file.json): this is what makes
-    the prefetch-vs-bare delta structural instead of accidental."""
+    memory and skip the walk. Returns the number of page probes so
+    the stride math is testable (a 2-D slicing bug once made this a
+    0.02%-coverage no-op — round-5 review)."""
     import numpy as np
 
+    touched = 0
     for x in item if isinstance(item, tuple) else (item,):
         if (isinstance(x, np.ndarray) and x.flags.c_contiguous
                 and x.nbytes > (1 << 20)):
-            x.view(np.uint8)[::4096].sum()
+            # reshape(-1) first: on a 2-D view, [::4096] would stride
+            # ROWS, not bytes; the flat view strides one byte per
+            # 4 KiB page. Both are views on c_contiguous input.
+            probes = x.view(np.uint8).reshape(-1)[::4096]
+            probes.sum()
+            touched += probes.size
+    return touched
 
 
 class PrefetchChunks(ChunkSource):

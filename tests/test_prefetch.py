@@ -171,7 +171,12 @@ def test_touch_pages_handles_all_array_kinds():
     from spark_bagging_tpu.utils.prefetch import _touch_pages
 
     big = np.zeros((600, 600), np.float32)        # > 1 MiB, contiguous
-    _touch_pages((big, big[:, :3], np.zeros(4), 7, None))
+    # every 4 KiB page of the 2-D block must be probed — a row-wise
+    # stride once covered 0.02% of pages and silently un-overlapped
+    # the I/O (round-5 review)
+    assert _touch_pages((big,)) == -(-big.nbytes // 4096)
+    assert _touch_pages((big, big[:, :3], np.zeros(4), 7, None)) == \
+        -(-big.nbytes // 4096)
     ro = np.zeros((600, 600), np.float32)
     ro.setflags(write=False)
-    _touch_pages((ro, ro[0]))
+    assert _touch_pages((ro, ro[0])) == -(-ro.nbytes // 4096)
