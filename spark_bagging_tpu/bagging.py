@@ -806,11 +806,21 @@ class _BaseBagging(ParamsMixin):
         """Out-of-core fit over a ChunkSource [SURVEY §7 step 8]."""
         from spark_bagging_tpu.streaming import fit_ensemble_stream
 
-        if prefetch:
-            # outermost wrap — ingestion (parse, hashing, label encode)
-            # runs on a background thread while the device steps
-            from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+        from spark_bagging_tpu.utils.prefetch import (
+            PrefetchChunks,
+            worth_prefetching,
+        )
 
+        if (prefetch and worth_prefetching()
+                and not isinstance(source, PrefetchChunks)):
+            # outermost wrap — ingestion (parse, hashing, label encode)
+            # runs on a background thread while the device steps. On a
+            # host with NO spare core the wrap is skipped: the producer
+            # can only steal cycles from the consumer there (measured
+            # 0-25% net cost). An explicitly-wrapped source is honored
+            # as-is on EVERY host — re-wrapping would clobber the
+            # caller's depth, and it is also the documented way to
+            # force prefetch past the gate.
             source = PrefetchChunks(source, prefetch)
 
         if self.n_estimators < 1:
@@ -1114,8 +1124,11 @@ class _BaseBagging(ParamsMixin):
             )
         # scoring passes overlap ingestion with the device forward the
         # same way streamed fits do; an explicitly-wrapped source keeps
-        # its configured depth, prefetch=0 disables
-        if already_wrapped or not prefetch:
+        # its configured depth, prefetch=0 disables, and a host with no
+        # spare core skips the default wrap (fit_stream's rule)
+        from spark_bagging_tpu.utils.prefetch import worth_prefetching
+
+        if already_wrapped or not prefetch or not worth_prefetching():
             return source
         return PrefetchChunks(source, prefetch)
 

@@ -180,3 +180,15 @@ def test_touch_pages_handles_all_array_kinds():
     ro = np.zeros((600, 600), np.float32)
     ro.setflags(write=False)
     assert _touch_pages((ro, ro[0])) == -(-ro.nbytes // 4096)
+
+
+def test_worth_prefetching_gates_on_spare_core(monkeypatch):
+    """The engines' default wrap is gated on a spare host core —
+    with one core the producer can only steal cycles from the
+    consumer (measured 0-25% net cost on 23.7 GiB cold streams)."""
+    from spark_bagging_tpu.utils import prefetch as pf
+
+    monkeypatch.setattr(pf, "_SPARE_CORE", False)
+    assert not pf.worth_prefetching()
+    monkeypatch.setattr(pf, "_SPARE_CORE", True)
+    assert pf.worth_prefetching()
