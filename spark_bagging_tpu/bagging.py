@@ -799,7 +799,7 @@ class _BaseBagging(ParamsMixin):
 
     def _fit_stream_engine(
         self, source, n_outputs: int, *, n_epochs: int,
-        steps_per_chunk: int, lr: float, prefetch: int = 2,
+        steps_per_chunk: int, lr: float, prefetch: int | None = None,
         checkpoint_dir=None, checkpoint_every: int = 0, resume_from=None,
         aux_col: int | None = None,
     ):
@@ -811,16 +811,18 @@ class _BaseBagging(ParamsMixin):
             worth_prefetching,
         )
 
-        if (prefetch and worth_prefetching()
-                and not isinstance(source, PrefetchChunks)):
+        if prefetch is None:
+            # auto: background ingestion only when a spare host core
+            # exists to produce on — with one core the producer can
+            # only steal cycles from the consumer (measured 0-25% net
+            # cost on 23.7 GiB cold streams). An EXPLICIT int always
+            # forces the choice; 0 disables.
+            prefetch = 2 if worth_prefetching() else 0
+        if prefetch and not isinstance(source, PrefetchChunks):
             # outermost wrap — ingestion (parse, hashing, label encode)
-            # runs on a background thread while the device steps. On a
-            # host with NO spare core the wrap is skipped: the producer
-            # can only steal cycles from the consumer there (measured
-            # 0-25% net cost). An explicitly-wrapped source is honored
-            # as-is on EVERY host — re-wrapping would clobber the
-            # caller's depth, and it is also the documented way to
-            # force prefetch past the gate.
+            # runs on a background thread while the device steps; an
+            # explicitly-wrapped source is honored as-is (re-wrapping
+            # would clobber the caller's depth)
             source = PrefetchChunks(source, prefetch)
 
         if self.n_estimators < 1:
@@ -1053,7 +1055,8 @@ class _BaseBagging(ParamsMixin):
             ratio=ratio, replacement=replacement,
         ))
 
-    def _stream_chunks(self, source, chunk_rows=None, prefetch: int = 2,
+    def _stream_chunks(self, source, chunk_rows=None,
+                       prefetch: int | None = None,
                        drop_aux_col: bool | None = None):
         """Validated chunk iterator for the streaming predict/score
         paths (the reference's ``transform`` over a distributed
@@ -1124,11 +1127,13 @@ class _BaseBagging(ParamsMixin):
             )
         # scoring passes overlap ingestion with the device forward the
         # same way streamed fits do; an explicitly-wrapped source keeps
-        # its configured depth, prefetch=0 disables, and a host with no
-        # spare core skips the default wrap (fit_stream's rule)
+        # its configured depth, prefetch=0 disables, and None (the
+        # default) resolves by fit_stream's spare-core rule
         from spark_bagging_tpu.utils.prefetch import worth_prefetching
 
-        if already_wrapped or not prefetch or not worth_prefetching():
+        if prefetch is None:
+            prefetch = 2 if worth_prefetching() else 0
+        if already_wrapped or not prefetch:
             return source
         return PrefetchChunks(source, prefetch)
 
@@ -1269,7 +1274,7 @@ class BaggingClassifier(_BaseBagging):
         steps_per_chunk: int = 1,
         lr: float = 0.01,
         chunk_rows: int | None = None,
-        prefetch: int = 2,
+        prefetch: int | None = None,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         resume_from: str | None = None,
@@ -1286,7 +1291,12 @@ class BaggingClassifier(_BaseBagging):
 
         ``prefetch`` chunks are produced on a background thread so
         host ingestion (CSV parse, hashing, label encode) overlaps the
-        device steps — the Spark executor-thread analog; 0 disables.
+        device steps — the Spark executor-thread analog. The default
+        (``None``) is adaptive: depth 2 when the process has a spare
+        core to produce on, else no background thread (with one core
+        the producer only steals cycles from the consumer — measured
+        0-25% net cost). Pass an int to force that depth regardless;
+        0 disables.
 
         ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot the fit
         state every N chunk-steps (tree learners instead snapshot at
@@ -1314,7 +1324,18 @@ class BaggingClassifier(_BaseBagging):
         if len(self.classes_) != len(classes):
             raise ValueError("classes contains duplicate values")
         self.n_classes_ = int(len(self.classes_))
-        enc = _EncodedChunks(source, self.classes_)
+        from spark_bagging_tpu.utils.prefetch import PrefetchChunks
+
+        if isinstance(source, PrefetchChunks):
+            # splice the label encoder INSIDE an explicitly-constructed
+            # wrap (keeping the caller's depth) — encoding outside it
+            # would hide the PrefetchChunks from the engine's
+            # honor-the-explicit-wrap rule and double-wrap
+            enc = source.rewrap(
+                lambda inner: _EncodedChunks(inner, self.classes_)
+            )
+        else:
+            enc = _EncodedChunks(source, self.classes_)
         self._fit_stream_engine(
             enc, self.n_classes_,
             n_epochs=n_epochs, steps_per_chunk=steps_per_chunk, lr=lr,
@@ -1366,7 +1387,7 @@ class BaggingClassifier(_BaseBagging):
         return proba
 
     def predict_proba_stream(self, source, chunk_rows=None, *,
-                             prefetch: int = 2,
+                             prefetch: int | None = None,
                              drop_aux_col: bool | None = None) -> np.ndarray:
         """Out-of-core ``predict_proba``: aggregate chunk by chunk —
         only one chunk is ever resident on device. ``drop_aux_col``:
@@ -1384,7 +1405,7 @@ class BaggingClassifier(_BaseBagging):
         return np.concatenate(out)
 
     def predict_stream(self, source, chunk_rows=None, *,
-                       prefetch: int = 2,
+                       prefetch: int | None = None,
                        drop_aux_col: bool | None = None) -> np.ndarray:
         proba = self.predict_proba_stream(
             source, chunk_rows, prefetch=prefetch,
@@ -1393,7 +1414,7 @@ class BaggingClassifier(_BaseBagging):
         return self.classes_[proba.argmax(axis=1)]
 
     def score_stream(self, source, chunk_rows=None, *,
-                     prefetch: int = 2,
+                     prefetch: int | None = None,
                      drop_aux_col: bool | None = None) -> float:
         """Out-of-core accuracy over a labeled ChunkSource."""
         correct = total = 0
@@ -1482,7 +1503,7 @@ class BaggingRegressor(_BaseBagging):
         steps_per_chunk: int = 1,
         lr: float = 0.01,
         chunk_rows: int | None = None,
-        prefetch: int = 2,
+        prefetch: int | None = None,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         resume_from: str | None = None,
@@ -1594,7 +1615,7 @@ class BaggingRegressor(_BaseBagging):
         return np.asarray(agg(self.ensemble_, self.subspaces_, X))
 
     def predict_stream(self, source, chunk_rows=None, *,
-                       prefetch: int = 2,
+                       prefetch: int | None = None,
                        drop_aux_col: bool | None = None) -> np.ndarray:
         """Out-of-core ``predict``: one chunk resident at a time.
         ``drop_aux_col``: None = auto-drop a stream-fitted aux column
@@ -1611,7 +1632,7 @@ class BaggingRegressor(_BaseBagging):
         return np.concatenate(out)
 
     def score_stream(self, source, chunk_rows=None, *,
-                     prefetch: int = 2,
+                     prefetch: int | None = None,
                      drop_aux_col: bool | None = None) -> float:
         """Out-of-core R² from one-pass accumulated moments, shifted
         by the first chunk's target mean — raw Σy² − (Σy)²/n cancels

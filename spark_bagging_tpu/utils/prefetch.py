@@ -134,13 +134,13 @@ class PrefetchChunks(ChunkSource):
         def produce() -> None:
             try:
                 for item in self._inner.chunks_from(start):
-                    if _SPARE_CORE:
-                        # page-in needs a core the consumer isn't
-                        # using: on a 1-core host the touch COMPETES
-                        # with compute and measures 0.76x (bare lazy
-                        # mmap + kernel readahead wins there —
-                        # benchmarks/out_of_core_file.json history)
-                        _touch_pages(item)
+                    # every LIVE wrap does the page-in: the 1-core
+                    # protection lives at the policy layer (the
+                    # engines' default wrap is skipped there via
+                    # worth_prefetching) — a user who explicitly
+                    # constructed this wrapper gets the full
+                    # producer-side I/O they asked for
+                    _touch_pages(item)
                     if not put_or_stop(item):
                         return
                 put_or_stop(_DONE)

@@ -192,3 +192,56 @@ def test_worth_prefetching_gates_on_spare_core(monkeypatch):
     assert not pf.worth_prefetching()
     monkeypatch.setattr(pf, "_SPARE_CORE", True)
     assert pf.worth_prefetching()
+
+
+def test_engine_default_wrap_policy(monkeypatch):
+    """The engine's prefetch policy, end to end [round-5 review]: the
+    None default wraps only with a spare core, an explicit int forces
+    the wrap on any host, and an explicitly-constructed PrefetchChunks
+    is honored (classifier splices its label encoder INSIDE the wrap
+    rather than hiding it)."""
+    import numpy as np
+
+    from spark_bagging_tpu import BaggingClassifier
+    from spark_bagging_tpu.utils import prefetch as pf
+    from spark_bagging_tpu.utils.io import ArrayChunks
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((400, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+
+    created = []
+    real_init = pf.PrefetchChunks.__init__
+
+    def spy_init(self, inner, depth=2):
+        created.append(depth)
+        real_init(self, inner, depth)
+
+    monkeypatch.setattr(pf.PrefetchChunks, "__init__", spy_init)
+
+    def fit(source, **kw):
+        return BaggingClassifier(n_estimators=2, seed=0).fit_stream(
+            source, classes=[0, 1], steps_per_chunk=1, lr=0.1, **kw
+        )
+
+    # no spare core: the None default must not wrap
+    monkeypatch.setattr(pf, "_SPARE_CORE", False)
+    fit(ArrayChunks(X, y, 100))
+    assert created == []
+    # ...but an explicit int forces it at that depth
+    fit(ArrayChunks(X, y, 100), prefetch=3)
+    assert created == [3]
+    # spare core: the default wraps at depth 2
+    monkeypatch.setattr(pf, "_SPARE_CORE", True)
+    created.clear()
+    fit(ArrayChunks(X, y, 100))
+    assert created == [2]
+    # an explicitly-wrapped source keeps its depth: the encoder is
+    # spliced inside (rewrap -> one new wrap at the SAME depth), and
+    # the engine adds nothing on top
+    created.clear()
+    src = pf.PrefetchChunks(ArrayChunks(X, y, 100), depth=5)
+    fit(src)
+    assert created == [5, 5], (
+        "expected construct-at-5 then rewrap-at-5, got " + str(created)
+    )
