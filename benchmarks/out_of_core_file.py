@@ -6,16 +6,18 @@ Config 8 proves beyond-memory streaming with generated chunks; this
 run proves it with the actual file path a reference user would hit:
 a >16 GiB Criteo-shaped dataset written to ONE Arrow IPC file on
 disk, streamed chunk-at-a-time by ``ArrowChunks`` (memory-mapped,
-record-batch granularity — nothing resident beyond one chunk) wrapped
-in ``PrefetchChunks`` so the next chunk's read+decode overlaps the
-device step, into ``BaggingClassifier.fit_stream``.
+record-batch granularity — nothing resident beyond one chunk) into
+``BaggingClassifier.fit_stream`` under the engine's adaptive prefetch
+default, with a forced-prefetch phase pricing the explicit wrap.
 
 Three measured phases, recorded in ``out_of_core_file.json``:
 
 1. ``scan``      — pure ingestion rate (iterate + decode, no fit),
-2. ``fit``       — full streamed fit WITH prefetch (depth 2),
-3. ``fit_noprefetch`` — same fit, bare source: the difference is the
-   measured IO/compute overlap benefit.
+2. ``fit``       — full streamed fit in the SHIPPING configuration
+   (bare source; fit_stream's adaptive default decides the wrap),
+3. ``fit_forced_prefetch`` — same fit with an explicitly-constructed
+   PrefetchChunks (forces the producer thread + page-touch on any
+   host): the delta is what forcing overlap costs or buys HERE.
 
 CPU-only is a valid capture [VERDICT r4 ask#5]: the subject is the
 file-I/O path at scale, which no test exercises beyond toy sizes. On
@@ -156,8 +158,8 @@ def main() -> None:
     path = dataset_path(args.dir)
 
     result: dict = {
-        "source_class": "ArrowChunks (memory-mapped Arrow IPC) "
-                        "+ PrefetchChunks(depth=2)",
+        "source_class": "ArrowChunks (memory-mapped Arrow IPC); "
+                        "engine-default prefetch policy",
         "n_rows": n_rows,
         "n_features": N_FEATURES,
         "chunk_rows": chunk_rows,
@@ -264,19 +266,22 @@ def main() -> None:
                  n_epochs=1, steps_per_chunk=2, lr=0.05)
     del Xw, yw
 
-    # phase 2: the real configuration — prefetch overlaps read+decode
-    # with the device step
+    # phase 2: the SHIPPING configuration — the engine's adaptive
+    # default decides the wrap (no wrap on a 1-core host)
+    run_fit(ArrowChunks(path, chunk_rows), "fit")
+    # phase 3: forced prefetch — explicit wrap engages the producer
+    # thread + page-touch on any host; the delta prices the force
     run_fit(PrefetchChunks(ArrowChunks(path, chunk_rows), depth=2),
-            "fit")
-    # phase 3: bare source — the overlap benefit is the delta
-    run_fit(ArrowChunks(path, chunk_rows), "fit_noprefetch")
+            "fit_forced_prefetch")
     # compile-net walls; the max() guard only matters at smoke sizes
-    # where compile ≈ wall and the ratio is noise anyway
-    net = max(0.1, result["fit"]["wall_seconds"]
-              - result["fit"]["compile_seconds"])
-    net_bare = max(0.1, result["fit_noprefetch"]["wall_seconds"]
-                   - result["fit_noprefetch"]["compile_seconds"])
-    result["prefetch_speedup"] = round(net_bare / net, 3)
+    # where compile ≈ wall and the ratio is noise anyway. >1 means
+    # forcing prefetch BEATS the shipping default on this host.
+    net_default = max(0.1, result["fit"]["wall_seconds"]
+                      - result["fit"]["compile_seconds"])
+    net_forced = max(0.1, result["fit_forced_prefetch"]["wall_seconds"]
+                     - result["fit_forced_prefetch"]["compile_seconds"])
+    result["forced_prefetch_speedup"] = round(
+        net_default / net_forced, 3)
 
     if not args.keep:
         os.remove(path)
@@ -287,8 +292,10 @@ def main() -> None:
 
     with open(args.json_out, "w") as f:
         json.dump(result, f, indent=2)
-    print(json.dumps({"out": args.json_out,
-                      "prefetch_speedup": result["prefetch_speedup"]}))
+    print(json.dumps({
+        "out": args.json_out,
+        "forced_prefetch_speedup": result["forced_prefetch_speedup"],
+    }))
 
 
 if __name__ == "__main__":

@@ -77,9 +77,12 @@ assert auc > 0.9
 # column->row transpose) and a cold scan runs at disk speed — the
 # measured 23.67 GiB capture is benchmarks/out_of_core_file.json.
 try:
-    import pyarrow as pa
+    import pyarrow  # noqa: F401 — the deferred dependency
 
-    from spark_bagging_tpu.utils.arrow import ArrowChunks
+    from spark_bagging_tpu.utils.arrow import (
+        ArrowChunks,
+        write_row_major_ipc,
+    )
 except ImportError:
     print("pyarrow not installed — skipping the Arrow fast-lane demo")
 else:
@@ -88,16 +91,8 @@ else:
     Xd, yd = make(20_000, seed=21, structure_seed=13)
     with tempfile.TemporaryDirectory() as td:
         fpath = os.path.join(td, "rows.arrow")
-        fsl = pa.FixedSizeListArray.from_arrays(
-            pa.array(np.ascontiguousarray(Xd).reshape(-1)), N_FEATURES
-        )
-        table = pa.table({"features": fsl,
-                          "label": yd.astype(np.int32)})
-        with pa.OSFile(fpath, "wb") as sink, pa.ipc.new_file(
-            sink, table.schema
-        ) as w:
-            for b in table.to_batches(max_chunksize=CHUNK_ROWS):
-                w.write_batch(b)
+        write_row_major_ipc(fpath, Xd, yd, chunk_rows=CHUNK_ROWS,
+                            label_dtype=np.int32)
         clf2 = BaggingClassifier(
             base_learner=LogisticRegression(l2=1e-4),
             n_estimators=8, seed=0,

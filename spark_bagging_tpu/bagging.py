@@ -1296,7 +1296,10 @@ class BaggingClassifier(_BaseBagging):
         core to produce on, else no background thread (with one core
         the producer only steals cycles from the consumer — measured
         0-25% net cost). Pass an int to force that depth regardless;
-        0 disables.
+        0 disables. Precedence: a source that is ALREADY a
+        ``PrefetchChunks`` wins over this parameter entirely — its
+        configured depth is kept and ``prefetch=0`` does not unwrap
+        it (unwrap it yourself if you need the producer thread gone).
 
         ``checkpoint_dir`` + ``checkpoint_every=N`` snapshot the fit
         state every N chunk-steps (tree learners instead snapshot at

@@ -189,6 +189,42 @@ def load_arrow(
     return _batch_to_xy(table, feats, label)
 
 
+def write_row_major_ipc(
+    path: str,
+    X: np.ndarray,
+    y: np.ndarray,
+    *,
+    chunk_rows: int | None = None,
+    label_dtype=None,
+) -> None:
+    """Write ``(X, y)`` as the row-major fast-lane Arrow IPC layout:
+    ONE fixed-size-list ``features`` column (the (n, d) block itself —
+    decode is a zero-copy reshape, see ``_batch_to_xy``) plus a
+    ``label`` column, in record batches of ``chunk_rows``.
+
+    This is the canonical producer for the layout every fast-lane
+    consumer (``ArrowChunks``, ``load_arrow``) recognizes; benchmarks,
+    examples, and tests all write through here so the format has one
+    definition."""
+    pa = _pyarrow()
+
+    X = np.ascontiguousarray(X, np.float32)
+    y = np.asarray(y)
+    if label_dtype is not None:
+        y = y.astype(label_dtype)
+    fsl = pa.FixedSizeListArray.from_arrays(
+        pa.array(X.reshape(-1)), X.shape[1]
+    )
+    table = pa.table({"features": fsl, "label": y})
+    with pa.OSFile(path, "wb") as sink, pa.ipc.new_file(
+        sink, table.schema
+    ) as writer:
+        for batch in table.to_batches(
+            max_chunksize=chunk_rows or len(y) or 1
+        ):
+            writer.write_batch(batch)
+
+
 class ArrowChunks(ChunkSource):
     """Stream a parquet/feather file in fixed-shape chunks [SURVEY §7.8].
 

@@ -127,18 +127,17 @@ class TestFixedSizeListFeatures:
     @pytest.fixture(scope="class", params=["feather", "parquet"])
     def fsl_file(self, request, xy, tmp_path_factory):
         X, y = xy
-        fsl = pa.FixedSizeListArray.from_arrays(
-            pa.array(np.ascontiguousarray(X).reshape(-1)), X.shape[1]
-        )
-        table = pa.table({"features": fsl, "label": y})
         path = tmp_path_factory.mktemp("fsl") / f"d.{request.param}"
         if request.param == "parquet":
-            pq.write_table(table, path, row_group_size=128)
+            fsl = pa.FixedSizeListArray.from_arrays(
+                pa.array(np.ascontiguousarray(X).reshape(-1)), X.shape[1]
+            )
+            pq.write_table(pa.table({"features": fsl, "label": y}),
+                           path, row_group_size=128)
         else:
-            with pa.OSFile(str(path), "wb") as sink:
-                with pa.ipc.new_file(sink, table.schema) as writer:
-                    for b in table.to_batches(max_chunksize=128):
-                        writer.write_batch(b)
+            from spark_bagging_tpu.utils.arrow import write_row_major_ipc
+
+            write_row_major_ipc(str(path), X, y, chunk_rows=128)
         return str(path)
 
     def test_load_arrow_fsl(self, fsl_file, xy):

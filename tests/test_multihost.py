@@ -147,3 +147,14 @@ def test_multihost_pooled_warm_start(worker_results):
         b["pooled_accuracy"], abs=1e-6
     )
     assert a["pooled_accuracy"] > 0.95
+
+
+def test_multihost_arrow_stream(worker_results):
+    """File-I/O ingestion joined to real collectives: both processes
+    stream an identical row-major Arrow file through fit_stream on the
+    process-spanning mesh and must land the same ensemble (round 5)."""
+    accs = [r["arrow_stream_accuracy"] for r in worker_results]
+    if accs[0] is None:
+        pytest.skip("pyarrow unavailable in workers")
+    assert accs[0] == accs[1]
+    assert accs[0] > 0.9
