@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.ops.precision import mosaic_dot_precision
 from spark_bagging_tpu.ops.reduce import maybe_psum
 
 _EPS = 1e-12
@@ -394,8 +395,14 @@ class _TreeBase(BaseLearner):
             jax.nn.one_hot(node, N, dtype=hdt)[:, :, None]
             * S.astype(hdt)[:, None, :]
         ).reshape(n, N * K)
+        # Same dot-precision rule as the fused kernel (ops/precision
+        # .py): with hist_dtype=float32 the kernel pins an exact-f32
+        # contract, and a size-dependent split_impl="auto" choice must
+        # not change numerics — so the dense matmul pins it too
+        # instead of inheriting the ambient precision context.
         return jnp.matmul(
-            Tf.T, R, preferred_element_type=jnp.float32
+            Tf.T, R, preferred_element_type=jnp.float32,
+            precision=mosaic_dot_precision(hdt),
         ).reshape(F, B, N, K)
 
     def _grow(self, X, S, prepared, axis_name, key=None):
@@ -449,10 +456,14 @@ class _TreeBase(BaseLearner):
                         * Sh[:, None, :]
                     ).reshape(n, N * K)
                     # (F·B, N·K) left statistics — the level's whole
-                    # split search as one MXU contraction (f32 accum).
+                    # split search as one MXU contraction (f32 accum);
+                    # precision pinned to match the fused kernel so
+                    # impl choice never changes numerics.
                     hist = maybe_psum(
                         jnp.matmul(
-                            Tf.T, R, preferred_element_type=jnp.float32
+                            Tf.T, R,
+                            preferred_element_type=jnp.float32,
+                            precision=mosaic_dot_precision(hdt),
                         ),
                         axis_name,
                     ).reshape(F, B, N, K)

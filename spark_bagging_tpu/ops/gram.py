@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from spark_bagging_tpu.ops.precision import mosaic_dot_precision
+
 _ROW_TILE = 512
 # conservative budget for the kernel's concurrently-resident VMEM
 # blocks (v5e VMEM ≈ 16 MiB total; leave headroom for Mosaic's own
@@ -67,9 +69,13 @@ def _scaled_gram_kernel(x_ref, s_ref, out_ref, *, n_pairs, op_dtype):
         axis=1,
     )                                            # (rows, P·d) [p][d]
     rhs = (xrep * s_rep).astype(op_dtype)
+    # Explicit precision (ops/precision.py): the kernel is traced
+    # under the caller's jax.default_matmul_precision context, and an
+    # ambient "high" killed the first on-chip Mosaic compile.
     acc = jax.lax.dot_general(
         x.astype(op_dtype), rhs, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=mosaic_dot_precision(op_dtype),
     )                                            # (d, P·d)
 
     @pl.when(r == 0)

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from spark_bagging_tpu.ops.precision import mosaic_dot_precision
+
 _ROW_TILE = 512
 # F_tile chosen so the on-chip indicator block (_ROW_TILE × F_tile·B)
 # stays ~2 MB in bf16 — far under VMEM while keeping MXU tiles full.
@@ -104,9 +106,12 @@ def _hist_kernel(x_ref, e_ref, node_ref, s_ref, out_ref, *, n_nodes,
     )  # [k][n]
     R2 = (oh_rep * s_rep).astype(op_dtype)
 
+    # Pinned precision (ops/precision.py): keeps the caller's
+    # default_matmul_precision context out of the kernel trace.
     acc = jax.lax.dot_general(
         T2, R2, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=mosaic_dot_precision(op_dtype),
     )  # (B·F_t, K·N)
 
     @pl.when(r == 0)

@@ -1,0 +1,31 @@
+"""Dot-precision policy shared by the pallas kernels and the XLA
+paths that must match their numerics.
+
+Mosaic lowers only ``Precision.DEFAULT`` / ``Precision.HIGHEST``; an
+ambient ``jax.default_matmul_precision("high")`` leaking into a kernel
+trace aborts the on-chip compile with "Unsupported dot precision:
+HIGH" (observed on the first real Mosaic compile of ops/gram.py).
+Numerics on these paths are governed by the operand dtype, so the rule
+is: exact-f32 contraction for f32 operands, single-pass for bf16 —
+and any XLA matmul an ``impl`` switch can substitute for a pallas
+kernel (e.g. the dense tree split search) must apply the SAME rule, or
+a size-dependent ``auto`` impl choice changes numerics with dataset
+size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mosaic_dot_precision(op_dtype) -> jax.lax.Precision:
+    """The explicit dot precision for a kernel/matmul whose numerics
+    are set by ``op_dtype``: HIGHEST (exact fp32 contract) for f32
+    operands, DEFAULT (single pass; the only behavior bf16 operands
+    have anyway) otherwise. Both lower on Mosaic."""
+    return (
+        jax.lax.Precision.HIGHEST
+        if jnp.dtype(op_dtype) == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )

@@ -745,6 +745,53 @@ def test_scaled_grams_kernel_direct():
         )
 
 
+def test_pallas_dot_precision_pinned_against_ambient_context():
+    """Mosaic lowers only DEFAULT/HIGHEST dot precision; an ambient
+    jax.default_matmul_precision("high") leaking into the kernel trace
+    killed the first on-chip compile ("Unsupported dot precision:
+    HIGH"). Both kernels must pin an explicit supported precision so
+    the solver's precision context (logistic.py applies it around the
+    whole fit) can never reach the pallas dot."""
+    from spark_bagging_tpu.ops.gram import scaled_grams
+    from spark_bagging_tpu.ops.hist import binned_left_stats
+
+    def dot_precisions(jaxpr, acc):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dot_general":
+                acc.append(eqn.params.get("precision"))
+            for v in eqn.params.values():
+                for j in jax.core.jaxprs_in_params({"_": v}):
+                    dot_precisions(j, acc)
+        return acc
+
+    unsupported = {jax.lax.Precision.HIGH}
+    with jax.default_matmul_precision("high"):
+        X = jnp.ones((256, 8), jnp.float32)
+        S = jnp.ones((256, 3), jnp.float32)
+        jx = jax.make_jaxpr(
+            lambda X, S: scaled_grams(X, S, interpret=True)
+        )(X, S)
+        precs = dot_precisions(jx.jaxpr, [])
+        assert precs, "no dot_general found in scaled_grams trace"
+        for p in precs:
+            assert p is not None and not (set(p) & unsupported), p
+
+        edges = jnp.tile(
+            jnp.asarray([0.0, 0.5, jnp.inf], jnp.float32), (8, 1)
+        )
+        node = jnp.zeros((256,), jnp.int32)
+        St = jnp.ones((256, 2), jnp.float32)
+        jh = jax.make_jaxpr(
+            lambda X, e, nd, S: binned_left_stats(
+                X, e, nd, S, n_nodes=1, interpret=True
+            )
+        )(X, edges, node, St)
+        precs = dot_precisions(jh.jaxpr, [])
+        assert precs, "no dot_general found in binned_left_stats trace"
+        for p in precs:
+            assert p is not None and not (set(p) & unsupported), p
+
+
 def test_pallas_hessian_in_ensemble_vmap():
     """The kernel's accumulate-at-grid-0 pattern must survive vmap's
     grid extension — a full bagged ensemble fit over the pallas path
