@@ -34,10 +34,15 @@ import threading
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_DIR = os.path.join(REPO, ".jax_cache")
 
-# Cache entries below this compile time are not worth the disk/lookup
-# churn (the CPU test suite would write thousands of trivial entries);
-# every compile a TPU window cares about is far above it.
-MIN_COMPILE_SECS = 0.1
+# Cache entries below this compile time are not worth caching. First
+# on-chip measurement (2026-08-01, results_smoke.json) answered the
+# round-4 open question with an asymmetry: retrieval through the axon
+# tunnel costs seconds per entry, so hits on SMALL entries are net
+# negative (config 8: 14 hits, saved_sec -60.7 — retrieval ~4 s/hit
+# vs 2-8 s original compiles) while the big headline executable is
+# net positive (5 hits, +4.63 s). Only programs whose compile clearly
+# exceeds the measured ~4 s retrieval cost belong in the cache.
+MIN_COMPILE_SECS = 6.0
 
 _counters = {"hits": 0, "misses": 0, "saved_sec": 0.0}
 _lock = threading.Lock()

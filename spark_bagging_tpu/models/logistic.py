@@ -91,7 +91,7 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
         precision: str = "highest",
         row_tile: int | None = None,
         hessian_impl: str = "auto",
-        init: str = "zeros",
+        init: str = "pooled",
         pooled_iter: int = 5,
     ):
         self.l2 = l2
@@ -100,17 +100,21 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
         self.lr = lr
         self.precision = precision
         self.validate_init(init)
-        # init="pooled": solve the UNWEIGHTED pooled problem once per
-        # ensemble (pooled_iter Newton steps, amortized over all
-        # replicas) and start every replica's weighted fit from that
-        # shared optimum. The per-replica objective is convex with a
-        # unique optimum, so this changes only the path, not the
-        # destination — measured on covtype-shaped data, ONE refinement
-        # iteration from the pooled start reaches the ensemble accuracy
-        # of three iterations from zeros (0.7618 vs 0.7603 at 20k rows),
-        # a ~3x cut in per-replica Newton work at equal-or-better
-        # quality. In-memory Newton/Adam fits only; fit_stream ignores
-        # it (the streaming engine has no pooled pre-pass).
+        # init="pooled" (the DEFAULT, measured): solve the UNWEIGHTED
+        # pooled problem once per ensemble (pooled_iter Newton steps,
+        # amortized over all replicas) and start every replica's
+        # weighted fit from that shared optimum. The per-replica
+        # objective is convex with a unique optimum, so this changes
+        # only the path, not the destination. Measured on a real v5e
+        # chip at the headline workload (covtype_synth_v4, 581k rows,
+        # 1000 replicas, benchmarks/tune_headline.json): pooled+1
+        # refinement iter = 305.8 fits/s at acc 0.7668 vs zeros+3
+        # iters = 117.7 fits/s at acc 0.7663 — 2.6x at equal-or-better
+        # quality, confirming the earlier CPU study (one pooled-start
+        # iter ≈ three cold iters, tests/test_pooled_init.py). Only
+        # the ensemble engine runs the pooled pre-pass; standalone
+        # fits and fit_stream behave as "zeros" (the streaming engine
+        # has no pooled pre-pass), so the default is free there.
         self.init = init
         self.pooled_iter = pooled_iter
         if hessian_impl not in ("auto", "blocked", "fused", "packed",
@@ -283,6 +287,17 @@ class LogisticRegression(PooledStartMixin, BaseLearner):
             )
         if self.hessian_impl != "auto":
             return self.hessian_impl
+        # Measured on silicon at the headline point (C=7, d=55, 581k
+        # rows, benchmarks/tune_headline.json): blocked = 305.8 fits/s
+        # vs the wide-Gram impls at 71.7 (packed) / 75.6 (pallas) —
+        # the 2.4x output-tile-fill theory did NOT survive contact
+        # with hardware; the wide impls are bound by materializing the
+        # O(rows·C·d) scaled operand in HBM, not by MXU tile fill. So
+        # auto prefers blocked at small C. The C>8 fused branch is
+        # about COMPILE scaling, not speed: blocked emits C²/2
+        # separate matmuls, untenable in trace/compile time at large C
+        # (unmeasured beyond C=8 on chip; explicit hessian_impl
+        # overrides for anyone who measures otherwise).
         return "fused" if C > 8 else "blocked"
 
     def _newton_stats(self, W, Xt, yt, wt, C):

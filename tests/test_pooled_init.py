@@ -165,10 +165,21 @@ class TestPooledInit:
         warm = clf("pooled", 2).score(X, y)
         assert warm >= cold - 0.01
 
-    def test_zeros_init_prepared_stays_none(self, breast_cancer):
-        """init='zeros' must not pay the pooled solve: prepared state
-        stays None through the engine."""
+    def test_default_init_is_pooled(self):
+        """The shipping default: the on-chip sweep measured pooled at
+        2.6x equal-accuracy over zeros (305.8 vs 117.7 fits/s,
+        benchmarks/tune_headline.json), so LogisticRegression defaults
+        to the measured winner. Reverting this default must fail HERE,
+        not in a zeros-path test."""
         lr = LogisticRegression()
+        assert lr.init == "pooled"
+        assert lr.uses_pooled_init is True
+
+    def test_zeros_init_prepared_stays_none(self, breast_cancer):
+        """init='zeros' (opted into explicitly; the default is pooled)
+        must not pay the pooled solve: prepared state stays None
+        through the engine."""
+        lr = LogisticRegression(init="zeros")
         assert lr.uses_pooled_init is False
         assert lr.gather_subspace(None, jnp.arange(3)) is None
         assert jax.tree_util.tree_all(
