@@ -281,7 +281,14 @@ def measure_cpu_baseline_parallel(X, y, l2: float) -> dict:
 
 def _measure(args) -> dict:
     """The measured phase (child mode): repeated fits, accuracy, and
-    the steady-state predict path. Returns a JSON-serializable dict."""
+    the steady-state predict path. Returns a JSON-serializable dict.
+
+    The whole phase runs under ``telemetry.capture`` writing
+    ``telemetry.jsonl`` next to the BENCH_*.json artifacts: every
+    compile/fit/h2d span and registry counter of the measured run is
+    machine-readable afterwards (render with
+    ``python -m spark_bagging_tpu.telemetry dump telemetry.jsonl``).
+    """
     import jax
 
     if args.platform:
@@ -292,6 +299,13 @@ def _measure(args) -> dict:
 
     from headline_data import HEADLINE, load_headline_data
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+    from spark_bagging_tpu import telemetry
+
+    jsonl_path = os.path.join(REPO, "telemetry.jsonl")
+    try:  # fresh log per measured run (capture appends)
+        os.unlink(jsonl_path)
+    except OSError:
+        pass
 
     X, y = load_headline_data(args.n_rows)
     learner = LogisticRegression(
@@ -309,28 +323,29 @@ def _measure(args) -> dict:
         seed=0,
     )
     report, first_report, fit_seconds_all = None, None, []
-    for _ in range(max(1, args.repeat)):
-        clf.fit(X, y)  # includes compile; fit_report_ separates the two
-        if first_report is None:
-            first_report = clf.fit_report_
-        fit_seconds_all.append(round(clf.fit_report_["fit_seconds"], 2))
-        if report is None or clf.fit_report_["fit_seconds"] < report["fit_seconds"]:
-            report = clf.fit_report_
-    # compile/h2d come from the FIRST run — later runs hit the compile
-    # cache and would report ~0, hiding the real one-time cost
-    report = dict(report)
-    report["compile_seconds"] = first_report["compile_seconds"]
-    report["h2d_seconds"] = first_report["h2d_seconds"]
-    acc = float(clf.score(X[:100_000], y[:100_000]))
+    with telemetry.capture(jsonl_path, label="bench_headline") as t_run:
+        for _ in range(max(1, args.repeat)):
+            clf.fit(X, y)  # includes compile; fit_report_ splits the two
+            if first_report is None:
+                first_report = clf.fit_report_
+            fit_seconds_all.append(round(clf.fit_report_["fit_seconds"], 2))
+            if report is None or clf.fit_report_["fit_seconds"] < report["fit_seconds"]:
+                report = clf.fit_report_
+        # compile/h2d come from the FIRST run — later runs hit the
+        # compile cache and would report ~0, hiding the one-time cost
+        report = dict(report)
+        report["compile_seconds"] = first_report["compile_seconds"]
+        report["h2d_seconds"] = first_report["h2d_seconds"]
+        acc = float(clf.score(X[:100_000], y[:100_000]))
 
-    # Inference hot path [SURVEY §3.2]: the batched 1000-replica
-    # forward + soft-vote reduction, timed steady-state (one warm-up
-    # call compiles + pages in the row block).
-    n_pred = min(100_000, args.n_rows)
-    clf.predict_proba(X[:n_pred])
-    t0 = time.perf_counter()
-    clf.predict_proba(X[:n_pred])
-    predict_rows_per_sec = n_pred / (time.perf_counter() - t0)
+        # Inference hot path [SURVEY §3.2]: the batched 1000-replica
+        # forward + soft-vote reduction, timed steady-state (one warm-up
+        # call compiles + pages in the row block).
+        n_pred = min(100_000, args.n_rows)
+        clf.predict_proba(X[:n_pred])
+        t0 = time.perf_counter()
+        clf.predict_proba(X[:n_pred])
+        predict_rows_per_sec = n_pred / (time.perf_counter() - t0)
     return {
         "report": json.loads(json.dumps(report, default=str)),
         "fit_seconds_all": fit_seconds_all,
@@ -340,6 +355,8 @@ def _measure(args) -> dict:
         # from a prior window were reused (hits) or the remote-compile
         # path defeated client-side caching [VERDICT r4 ask#2]
         "compile_cache": compile_cache.stats(),
+        "telemetry_jsonl": jsonl_path,
+        "telemetry_events": t_run.n_events,
     }
 
 
@@ -599,6 +616,11 @@ def main() -> None:
         "init": init,
         "tuned_from_sweep": tuned_from,
         "compile_cache": measured.get("compile_cache"),
+        # full instrument panel of the measured run (spans + registry),
+        # written next to the BENCH artifacts; render with
+        # `python -m spark_bagging_tpu.telemetry dump <path>`
+        "telemetry_jsonl": measured.get("telemetry_jsonl"),
+        "telemetry_events": measured.get("telemetry_events"),
     }
     if report.get("mfu") is not None:
         result["achieved_tflops"] = round(report["achieved_tflops"], 1)

@@ -44,9 +44,32 @@ DEFAULT_DIR = os.path.join(REPO, ".jax_cache")
 # exceeds the measured ~4 s retrieval cost belong in the cache.
 MIN_COMPILE_SECS = 6.0
 
-_counters = {"hits": 0, "misses": 0, "saved_sec": 0.0}
+# One-time sweep threshold [ADVICE r5 medium]: raising MIN_COMPILE_SECS
+# only gates WRITES — the entries written during the 2026-08-01 window
+# under the old 0.1 s floor are still in .jax_cache/ and every child
+# still pays the ~4 s/hit tunnel retrieval on them. Entry SIZE is the
+# available proxy for compile time (the cache stores no timing): that
+# window's sub-6 s smoke-config executables all serialized well under
+# 1 MiB while the >6 s headline program is multi-MB, so enable() now
+# deletes existing entries under this byte floor once per process.
+# Deleting a cache entry is always safe — a miss just recompiles.
+SWEEP_MIN_ENTRY_BYTES = 1 << 20
+
+_counters = {"hits": 0, "misses": 0, "saved_sec": 0.0, "swept": 0}
 _lock = threading.Lock()
 _enabled_dir: str | None = None
+
+
+def _telemetry_inc(name: str) -> None:
+    """Mirror cache events into the unified telemetry registry (the
+    subsystem's compile-cache instrument); never let telemetry trouble
+    take the cache down with it."""
+    try:
+        from spark_bagging_tpu import telemetry
+
+        telemetry.inc(name)
+    except Exception:  # noqa: BLE001 — cache must outlive telemetry
+        pass
 
 
 def _on_event(event: str, **kw) -> None:
@@ -55,6 +78,62 @@ def _on_event(event: str, **kw) -> None:
             _counters["hits"] += 1
         elif event == "/jax/compilation_cache/cache_misses":
             _counters["misses"] += 1
+        else:
+            return
+    _telemetry_inc(
+        "sbt_compile_cache_hits_total"
+        if event.endswith("cache_hits")
+        else "sbt_compile_cache_misses_total"
+    )
+
+
+# Bumping this re-runs the one-time sweep on existing cache dirs (the
+# marker file is version-suffixed).
+_SWEEP_VERSION = 1
+
+
+def sweep_stale_entries(
+    path: str, min_bytes: int = SWEEP_MIN_ENTRY_BYTES, *,
+    once: bool = False,
+) -> int:
+    """Delete persisted cache entries smaller than ``min_bytes`` — the
+    debris written before MIN_COMPILE_SECS rose to 6.0 (see the
+    constant's rationale). Each entry's ``-atime`` sibling (jax's LRU
+    bookkeeping file) goes with it. Returns the number removed.
+
+    ``once=True`` makes the sweep once per CACHE DIR, not per process
+    (a marker file records completion): post-sweep writes all passed
+    the >=6 s gate, so re-sweeping every child would only re-delete
+    legitimate slow-compile-but-small entries forever — and each rerun
+    re-opens the (unlocked-reader) delete race for no benefit.
+    """
+    marker = os.path.join(path, f".swept_v{_SWEEP_VERSION}")
+    if once and os.path.exists(marker):
+        return 0
+    removed = 0
+    try:
+        for name in os.listdir(path):
+            if not name.endswith("-cache"):
+                continue
+            full = os.path.join(path, name)
+            try:
+                if os.path.isfile(full) and os.path.getsize(full) < min_bytes:
+                    os.unlink(full)
+                    removed += 1
+                    try:
+                        os.unlink(full[: -len("-cache")] + "-atime")
+                    except OSError:
+                        pass  # no LRU bookkeeping written for it
+            except OSError:
+                continue  # concurrent writer/sweeper; leave it
+        if once:
+            with open(marker, "w") as f:
+                f.write(f"swept {removed} entries\n")
+    except OSError:
+        pass
+    with _lock:
+        _counters["swept"] += removed
+    return removed
 
 
 def _on_duration(event: str, duration_secs: float, **kw) -> None:
@@ -63,7 +142,7 @@ def _on_duration(event: str, duration_secs: float, **kw) -> None:
             _counters["saved_sec"] += duration_secs
 
 
-def enable(cache_dir: str | None = None) -> str | None:
+def enable(cache_dir: str | None = None, *, sweep: bool = True) -> str | None:
     """Turn on the persistent compilation cache for this process.
 
     Idempotent; returns the cache directory in effect, or ``None`` when
@@ -74,6 +153,10 @@ def enable(cache_dir: str | None = None) -> str | None:
     explicit arg > ``JAX_COMPILATION_CACHE_DIR`` (what ``isolation.py``
     exports to children) > the repo-root default, so a child launched
     outside the isolation protocol still lands in the shared cache.
+
+    ``sweep=False`` skips the one-time purge of sub-threshold entries
+    (the probe children write deliberately small entries that must
+    survive within one probe).
     """
     global _enabled_dir
     if _enabled_dir is not None:
@@ -95,6 +178,13 @@ def enable(cache_dir: str | None = None) -> str | None:
 
         monitoring.register_event_listener(_on_event)
         monitoring.register_event_duration_secs_listener(_on_duration)
+
+        # purge pre-threshold-era small entries ONCE PER CACHE DIR
+        # before the cache goes live (marker-gated: post-sweep writes
+        # all pass the >= MIN_COMPILE_SECS gate, so re-sweeping per
+        # process would only delete legitimate small-but-slow entries)
+        if sweep:
+            sweep_stale_entries(path, once=True)
 
         jax.config.update("jax_compilation_cache_dir", path)
         # The env var spelling of these two knobs is NOT read by this
@@ -133,7 +223,10 @@ import jax, jax.numpy as jnp
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {bench_dir!r})
 import compile_cache
-compile_cache.enable({cache_dir!r})
+# probe-only: sweep=False — this child deliberately writes entries far
+# below the size floor (a toy step), and the second child must find
+# them, so the stale-entry sweep stays off inside a probe
+compile_cache.enable({cache_dir!r}, sweep=False)
 # probe-only: the probe step compiles near the MIN_COMPILE_SECS write
 # threshold on a fast host, which would flake the cold-writes-entries
 # assertion — cache everything for this child regardless of speed

@@ -16,6 +16,7 @@ sections instead of reference file:line):
   compose with pipelines [SURVEY §3.4].
 """
 
+from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.bagging import (
     BaggingClassifier,
     BaggingRegressor,
@@ -60,6 +61,7 @@ from spark_bagging_tpu.utils.io import (
 __version__ = "0.2.0"
 
 __all__ = [
+    "telemetry",
     "BaggingClassifier",
     "clear_compiled_caches",
     "BaggingRegressor",

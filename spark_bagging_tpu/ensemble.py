@@ -42,7 +42,12 @@ from spark_bagging_tpu.ops.bootstrap import (
     oob_mask,
 )
 from spark_bagging_tpu.utils.debug import check_bootstrap_weights
-from spark_bagging_tpu.utils.profiling import named_scope
+
+# telemetry.phase = named_scope (device-trace segmentation, exactly as
+# before) + a host span when telemetry is enabled, so the trace-time
+# cost of each engine phase lands in the same run log as the host-side
+# compile/fit spans under the same names.
+from spark_bagging_tpu.telemetry import phase as named_scope
 
 
 def fit_ensemble(
@@ -61,9 +66,16 @@ def fit_ensemble(
     chunk_size: int | None = None,
     row_mask: jax.Array | None = None,
     aux: jax.Array | None = None,
+    use_pooled_init: bool | None = None,
 ) -> tuple[Any, jax.Array, dict[str, jax.Array]]:
     """Fit all replicas in ``replica_ids``; the reference's ``train()``
     loop [SURVEY §3.1] as one XLA program.
+
+    ``use_pooled_init`` overrides the learner's ``uses_pooled_init``
+    flag (None = honor it). The estimator passes the amortization gate
+    here: for a warm start the decision must be keyed to the TOTAL
+    ensemble size, which only the caller knows — gating on this call's
+    replica count would make warm-grown and cold-fit ensembles diverge.
 
     ``row_mask`` (0/1 per row) multiplies into every replica's sample
     weights — used to neutralize padding rows added for even sharding.
@@ -100,9 +112,11 @@ def fit_ensemble(
     # Replica-invariant precomputation (e.g. tree bin edges + threshold
     # indicators) runs ONCE here, outside the replica map; vmap keeps it
     # unbatched so it is not repeated per replica [models/base.py].
+    if use_pooled_init is None:
+        use_pooled_init = learner.uses_pooled_init
     with named_scope("prepare"):
         prepared = learner.prepare(X, axis_name=data_axis, row_mask=row_mask)
-        if learner.uses_pooled_init:
+        if use_pooled_init:
             # one shared ensemble-level solve; replicas warm-start from
             # it via initial_params (amortized over all replicas, and
             # replicated — not per-replica — under data sharding)

@@ -76,6 +76,14 @@ class BaseLearner(ParamsMixin):
     # ``initial_params`` — the same plumbing as ``prepare``.
     uses_pooled_init: ClassVar[bool] = False
 
+    def pooled_amortizes(self, n_replicas: int) -> bool:
+        """Is the pooled pre-pass worth running for an ensemble of this
+        TOTAL size? The engine consults this before paying the shared
+        solve; the default says yes (learners with a cost model
+        override — PooledStartMixin)."""
+        del n_replicas
+        return True
+
     def init_params(
         self, key: jax.Array, n_features: int, n_outputs: int
     ) -> Params:
@@ -309,6 +317,16 @@ class PooledStartMixin:
     @property
     def uses_pooled_init(self) -> bool:
         return self.init == "pooled"
+
+    def pooled_amortizes(self, n_replicas: int) -> bool:
+        """Small-bag gate [ADVICE r5 low]: the pre-pass costs
+        ``pooled_iter`` full-data solver iterations on top of unchanged
+        per-replica work; the measured benefit is ~2 saved iterations
+        per replica (one warm refinement iteration ≈ three cold ones,
+        tests/test_pooled_init.py). It pays once ``2·R ≥ pooled_iter``
+        — at the default ``pooled_iter=5``, bags of 1-2 replicas skip
+        the solve and start from the cold init instead."""
+        return 2 * n_replicas >= self.pooled_iter
 
     def pooled_init(self, key, prepared, X, y, n_outputs, *,
                     row_mask=None, axis_name=None):

@@ -53,7 +53,29 @@ def global_put(x: Any, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
         and is_multiprocess_mesh(mesh)
     ):
         x = np.asarray(x)
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    from spark_bagging_tpu import telemetry
+
+    was_host = isinstance(x, np.ndarray)
+    out = jax.device_put(x, NamedSharding(mesh, spec))
+    if telemetry.enabled() and was_host:
+        # host→device placement volume, labeled by process so pod runs
+        # can see per-host transfer skew. Count THIS process's
+        # addressable shards, not the global array — every process
+        # passes the full host matrix (broadcast-data design) but
+        # transfers only its shards; counting x.nbytes would overstate
+        # volume n_processes-fold and erase the very skew the label
+        # exists to show. (Shard nbytes is shape metadata — no sync.)
+        try:
+            nbytes = float(sum(
+                s.data.nbytes for s in out.addressable_shards
+            ))
+        except Exception:  # noqa: BLE001 — metadata API drift: fall back
+            nbytes = float(x.nbytes)
+        telemetry.inc(
+            "sbt_h2d_bytes_total", nbytes,
+            labels={"process": jax.process_index()},
+        )
+    return out
 
 
 def to_host(x: Any) -> np.ndarray:
@@ -68,5 +90,18 @@ def to_host(x: Any) -> np.ndarray:
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        from spark_bagging_tpu import telemetry
+
+        with telemetry.span(
+            "to_host_gather", metric="sbt_collective_seconds",
+            process=jax.process_index(),
+        ):
+            out = np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            )
+        telemetry.inc(
+            "sbt_d2h_bytes_total", float(out.nbytes),
+            labels={"process": jax.process_index()},
+        )
+        return out
     return np.asarray(x)

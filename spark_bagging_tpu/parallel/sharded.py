@@ -114,6 +114,7 @@ def sharded_fit(
     chunk_size: int | None = None,
     id_offset: int = 0,
     aux: jnp.ndarray | None = None,
+    use_pooled_init: bool | None = None,
 ) -> tuple[Any, jnp.ndarray, dict[str, jnp.ndarray]]:
     """Ensemble fit over the mesh; same contract as
     :func:`spark_bagging_tpu.ensemble.fit_ensemble`.
@@ -127,6 +128,16 @@ def sharded_fit(
     """
     _check_divisible(X.shape[0], n_replicas, mesh)
     data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    # trace-time counters: shard_map bodies run host code only while
+    # tracing, so what IS observable here is how often each sharded
+    # program gets (re)built and over what mesh — labeled by kind so a
+    # retrace storm in production shows up in the registry
+    from spark_bagging_tpu import telemetry
+
+    telemetry.inc(
+        "sbt_shardmap_traces_total",
+        labels={"kind": "fit", "mesh": "x".join(map(str, mesh.devices.shape))},
+    )
 
     with_aux = aux is not None
     in_specs = [
@@ -160,6 +171,7 @@ def sharded_fit(
             chunk_size=chunk_size,
             row_mask=mask,
             aux=aux_s[0] if aux_s else None,
+            use_pooled_init=use_pooled_init,
         )
         return params, subspaces, fit_aux["loss"]
 
@@ -186,6 +198,13 @@ def sharded_predict_classifier(
     [B:5]; rows stay sharded over the data axis."""
     _check_divisible(X.shape[0], n_total, mesh)
     replica_axis = REPLICA_AXIS if mesh.shape.get(REPLICA_AXIS, 1) > 1 else None
+    from spark_bagging_tpu import telemetry
+
+    telemetry.inc(
+        "sbt_shardmap_traces_total",
+        labels={"kind": "predict_clf",
+                "mesh": "x".join(map(str, mesh.devices.shape))},
+    )
 
     @functools.partial(
         jax.shard_map,
@@ -237,6 +256,13 @@ def sharded_oob_scores(
     data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
     replica_axis = REPLICA_AXIS if mesh.shape.get(REPLICA_AXIS, 1) > 1 else None
     classification = n_classes is not None
+    from spark_bagging_tpu import telemetry
+
+    telemetry.inc(
+        "sbt_shardmap_traces_total",
+        labels={"kind": "oob",
+                "mesh": "x".join(map(str, mesh.devices.shape))},
+    )
 
     @functools.partial(
         jax.shard_map,
@@ -287,6 +313,13 @@ def sharded_predict_regressor(
     """Mean-aggregated predictions ``(n,)`` over the mesh [B:5]."""
     _check_divisible(X.shape[0], n_total, mesh)
     replica_axis = REPLICA_AXIS if mesh.shape.get(REPLICA_AXIS, 1) > 1 else None
+    from spark_bagging_tpu import telemetry
+
+    telemetry.inc(
+        "sbt_shardmap_traces_total",
+        labels={"kind": "predict_reg",
+                "mesh": "x".join(map(str, mesh.devices.shape))},
+    )
 
     @functools.partial(
         jax.shard_map,

@@ -40,6 +40,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.parallel.multihost import global_put, to_host
 
 from spark_bagging_tpu.models.base import BaseLearner
@@ -168,10 +169,15 @@ def save_snapshot(path: str, tree: Any, meta: dict) -> None:
         except PermissionError:
             pass
     os.makedirs(tmp, exist_ok=True)
-    with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
-        f.write(serialization.msgpack_serialize(tree))
-    with open(os.path.join(tmp, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    with telemetry.span("checkpoint_save",
+                        metric="sbt_checkpoint_seconds"):
+        payload = serialization.msgpack_serialize(tree)
+        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+            f.write(payload)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+    telemetry.inc("sbt_checkpoint_bytes_total", float(len(payload)),
+                  labels={"kind": "stream", "op": "save"})
     # Never leave a window with no valid snapshot: move the previous
     # one aside, install the new one, then drop the old. A kill between
     # the two renames leaves `path.old`, which load falls back to.
@@ -445,6 +451,7 @@ def fit_ensemble_stream(
     compile_seconds = None
     steps_done = 0
     for epoch in range(start_epoch, n_epochs):
+        telemetry.inc("sbt_stream_epochs_total", labels={"engine": "sgd"})
         # resume seeks straight to the cursor (O(1) on random-access
         # sources; discard-scan elsewhere) instead of re-ingesting and
         # dropping every pre-cursor chunk; `closing` makes prefetch
@@ -454,25 +461,36 @@ def fit_ensemble_stream(
         with closing(source.chunks_from(offset)) as chunk_iter:
           for c, (Xc, yc, n_valid) in enumerate(chunk_iter, start=offset):
             seen = c
-            Xc, auxc = split_aux_col(Xc, aux_col)
-            if x_sharding is not None:
-                # host chunk → ONE global placement (multihost-safe:
-                # every process streams the same chunks, each transfers
-                # only its shards — the broadcast-data design [B:5])
-                Xd = jax.device_put(Xc, x_sharding)
-                yd = jax.device_put(np.asarray(yc, y_dtype), y_sharding)
-                auxd = (
-                    jax.device_put(auxc, y_sharding) if use_aux else None
+            # per-chunk span: wall-clock of transfer + step dispatch
+            # (device-sync opt-in makes it the true step latency); the
+            # histogram is the chunk-latency distribution BENCH reads
+            with telemetry.span(
+                "chunk_step", metric="sbt_chunk_seconds",
+                epoch=epoch, chunk=c,
+            ):
+                Xc, auxc = split_aux_col(Xc, aux_col)
+                if x_sharding is not None:
+                    # host chunk → ONE global placement (multihost-safe:
+                    # every process streams the same chunks, each
+                    # transfers only its shards — the broadcast-data
+                    # design [B:5])
+                    Xd = jax.device_put(Xc, x_sharding)
+                    yd = jax.device_put(np.asarray(yc, y_dtype), y_sharding)
+                    auxd = (
+                        jax.device_put(auxc, y_sharding) if use_aux
+                        else None
+                    )
+                else:
+                    Xd = jnp.asarray(Xc)
+                    yd = jnp.asarray(yc, y_dtype)
+                    auxd = jnp.asarray(auxc) if use_aux else None
+                params, opt_state, losses = chunk_step(
+                    params, opt_state, Xd, yd, auxd,
+                    jnp.asarray(n_valid, jnp.int32),
+                    jnp.asarray(c, jnp.int32),
                 )
-            else:
-                Xd = jnp.asarray(Xc)
-                yd = jnp.asarray(yc, y_dtype)
-                auxd = jnp.asarray(auxc) if use_aux else None
-            params, opt_state, losses = chunk_step(
-                params, opt_state, Xd, yd, auxd,
-                jnp.asarray(n_valid, jnp.int32),
-                jnp.asarray(c, jnp.int32),
-            )
+            telemetry.inc("sbt_stream_chunks_total",
+                          labels={"engine": "sgd"})
             if compile_seconds is None:
                 jax.block_until_ready(losses)
                 compile_seconds = time.perf_counter() - t0

@@ -1,0 +1,172 @@
+"""Unified telemetry: run registry, phase spans, JSONL log, Prometheus.
+
+The reference inherits observability from Spark — ``Instrumentation``
+logging, Spark-UI stage views, metrics sinks [SURVEY §5]. This package
+is the TPU-native equivalent, one subsystem with three layers:
+
+1. **Registry** (``registry.py``) — process-wide, thread-safe counters,
+   gauges, and log-scale histograms (``sbt_*`` metric names): compile
+   seconds, h2d bytes, chunk latencies, replicas fitted, compile-cache
+   hits/misses, prefetch stalls, checkpoint bytes, OOB evaluations.
+2. **Spans** (``spans.py``) — nestable phase spans
+   (``with telemetry.span("compile"): ...``) recording wall-clock per
+   phase; ``phase()`` composes with ``jax.named_scope`` so host spans
+   and device traces share names. Device-sync timing is opt-in.
+3. **Sinks** (``sinks.py``) — ``capture()`` opens a run whose events
+   (spans + metric flushes) land in memory and, optionally, a
+   schema-versioned JSONL file; ``render_prometheus()`` dumps the
+   registry in Prometheus text format (also:
+   ``python -m spark_bagging_tpu.telemetry dump``).
+
+Cost contract: **zero overhead when disabled** — every instrumentation
+site in the engines guards on :func:`enabled` (one attribute read) or
+goes through :func:`span`, which returns a shared no-op context
+manager when disabled. Host-side counters are ON by default (they sit
+on paths that already cross the host/device boundary); the event
+stream only materializes inside an open :func:`capture`.
+
+Typical use::
+
+    from spark_bagging_tpu import telemetry
+
+    with telemetry.capture("telemetry.jsonl") as run:
+        clf.fit(X, y)
+    run.spans("compile")                 # recorded phase spans
+    print(telemetry.render_prometheus())  # scrape-able metrics dump
+"""
+
+from __future__ import annotations
+
+from spark_bagging_tpu.telemetry.registry import (
+    Registry,
+    render_prometheus as _render_snapshot,
+)
+from spark_bagging_tpu.telemetry.sinks import (
+    SCHEMA_VERSION,
+    Run,
+    capture,
+    current_run,
+    last_metrics_snapshot,
+    read_events,
+    runs,
+)
+from spark_bagging_tpu.telemetry.spans import phase, span
+from spark_bagging_tpu.telemetry.state import STATE as _state
+
+__all__ = [
+    "SCHEMA_VERSION", "Run", "capture", "current_run", "enabled",
+    "enable", "disable", "set_device_sync", "device_sync_enabled",
+    "span", "phase", "inc", "set_gauge", "observe", "registry",
+    "render_prometheus", "read_events", "last_metrics_snapshot",
+    "runs", "record_fit_report", "Registry", "reset",
+]
+
+
+def enabled() -> bool:
+    """THE hot-path gate: every engine instrumentation site checks this
+    (or calls :func:`span`, which does) before doing any work."""
+    return _state.enabled
+
+
+def enable() -> None:
+    _state.enabled = True
+
+
+def disable() -> None:
+    """Turn all telemetry recording off (named_scope device annotations
+    from :func:`phase` remain — they predate this subsystem)."""
+    _state.enabled = False
+
+
+def set_device_sync(on: bool) -> None:
+    """Opt span timing into device barriers at span entry/exit so the
+    recorded wall-clock covers device work launched inside the span
+    (off by default: the barrier serializes the pipeline it measures)."""
+    _state.device_sync = bool(on)
+
+
+def device_sync_enabled() -> bool:
+    return _state.device_sync
+
+
+def registry() -> Registry:
+    """The process-wide metrics registry."""
+    return _state.registry
+
+
+def reset() -> None:
+    """Clear the registry (tests; a long-lived service rotating runs)."""
+    _state.registry.reset()
+
+
+# -- counter convenience wrappers (no-ops when disabled) ---------------
+
+def inc(name: str, v: float = 1.0, labels: dict | None = None) -> None:
+    if _state.enabled:
+        _state.registry.inc(name, v, labels)
+
+
+def set_gauge(name: str, v: float, labels: dict | None = None) -> None:
+    if _state.enabled:
+        _state.registry.set(name, v, labels)
+
+
+def observe(name: str, v: float, labels: dict | None = None) -> None:
+    if _state.enabled:
+        _state.registry.observe(name, v, labels)
+
+
+def render_prometheus(snapshot: list | None = None) -> str:
+    """Prometheus text exposition of the registry (or a snapshot
+    previously read back from a JSONL log's ``metrics`` event)."""
+    if snapshot is None:
+        snapshot = _state.registry.snapshot()
+    return _render_snapshot(snapshot)
+
+
+# -- fit_report integration --------------------------------------------
+
+class FitReportView(dict):
+    """``fit_report_`` as a view over the run registry: a plain dict to
+    every consumer (keys are byte-identical to the historical report),
+    whose numeric entries were exported to the registry as
+    ``sbt_fit_<key>`` gauges at construction. Mutations after
+    construction (``chunk_size_resolved`` etc.) flow back through
+    ``__setitem__`` so the registry view never goes stale."""
+
+    def __setitem__(self, key, value) -> None:
+        super().__setitem__(key, value)
+        if _state.enabled and isinstance(value, (int, float)) \
+                and not isinstance(value, bool):
+            _state.registry.set(f"sbt_fit_{key}", float(value))
+
+
+def record_fit_report(report: dict) -> FitReportView:
+    """Register a freshly assembled fit report with the telemetry
+    subsystem and return the registry-backed view of it.
+
+    Exports every numeric entry as an ``sbt_fit_<key>`` gauge, bumps
+    the headline counters (``sbt_replicas_fitted_total``), folds
+    compile/fit/h2d seconds into their log-scale histograms, and emits
+    one ``fit_report`` event into any open capture.
+    """
+    view = FitReportView()
+    if not _state.enabled:
+        view.update(report)
+        return view
+    for k, v in report.items():
+        view[k] = v  # __setitem__ exports numerics as gauges
+    reg = _state.registry
+    n = report.get("n_replicas") or 0
+    if n:
+        reg.inc("sbt_replicas_fitted_total", float(n))
+    for key, metric in (
+        ("compile_seconds", "sbt_compile_seconds"),
+        ("fit_seconds", "sbt_fit_seconds"),
+        ("h2d_seconds", "sbt_h2d_seconds"),
+    ):
+        val = report.get(key)
+        if val is not None:
+            reg.observe(metric, float(val))
+    _state.emit({"kind": "fit_report", "report": dict(report)})
+    return view

@@ -1,0 +1,49 @@
+"""CLI: ``python -m spark_bagging_tpu.telemetry dump [events.jsonl]``.
+
+With no argument, dumps THIS process's registry in Prometheus text
+format (useful from a REPL/notebook via ``%run``; a fresh process has
+an empty registry). With a JSONL event-log path (written by
+``telemetry.capture(path)``), reconstructs the log's final ``metrics``
+snapshot and renders that — the offline way to turn a recorded run
+into a scrape-able dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m spark_bagging_tpu.telemetry", description=__doc__
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    dump = sub.add_parser(
+        "dump", help="render metrics in Prometheus text format"
+    )
+    dump.add_argument(
+        "jsonl", nargs="?", default=None,
+        help="JSONL event log to render (default: this process's registry)",
+    )
+    args = p.parse_args(argv)
+
+    from spark_bagging_tpu import telemetry
+
+    if args.jsonl is None:
+        sys.stdout.write(telemetry.render_prometheus())
+        return 0
+    events = telemetry.read_events(args.jsonl)
+    snap = telemetry.last_metrics_snapshot(events)
+    if snap is None:
+        print(
+            f"no metrics snapshot found in {args.jsonl!r} "
+            "(was the capture closed?)", file=sys.stderr,
+        )
+        return 1
+    sys.stdout.write(telemetry.render_prometheus(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,219 @@
+"""Process-wide metrics registry: counters, gauges, log-scale histograms.
+
+The reference gets metric plumbing for free from Spark's
+``Instrumentation`` + metrics sinks [SURVEY §5]; here one thread-safe
+registry holds every counter/gauge/histogram the engines emit
+(compile seconds, h2d bytes, chunk latencies, replicas fitted,
+compile-cache hits/misses, prefetch stalls, checkpoint bytes, OOB
+evaluations), keyed by ``(name, sorted labels)``. Metric names follow
+the Prometheus convention with the ``sbt_`` (spark-bagging-tpu) prefix;
+:func:`render_prometheus` emits the text exposition format so the
+registry can be scraped or diffed with standard tooling.
+
+Thread-safety: engines emit from the fit thread, the prefetch producer
+thread, and jax's compilation-cache listener callbacks concurrently —
+every mutation and snapshot takes the registry lock. The hot-path
+cheapness contract lives one level up (``telemetry.enabled()`` gates
+every call site), not here.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Iterable
+
+# Log-scale histogram bounds: decades from 100 microseconds to 1000
+# seconds cover every latency this stack records (a chunk step is
+# ~1e-3..1e0 s, a headline compile ~1e0..1e2 s); byte-valued
+# histograms reuse the same grid scaled by _BYTES_SCALE.
+DEFAULT_BUCKETS: tuple[float, ...] = tuple(
+    10.0 ** e for e in range(-4, 4)
+) + (math.inf,)
+
+
+def _label_key(labels: dict[str, Any] | None) -> tuple:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonically increasing value (Prometheus ``counter``)."""
+
+    kind = "counter"
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"counter increment must be >= 0, got {v}")
+        self.value += v
+
+
+class Gauge:
+    """Last-write-wins value (Prometheus ``gauge``)."""
+
+    kind = "gauge"
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+
+class Histogram:
+    """Log-scale bucketed distribution (Prometheus ``histogram``).
+
+    Buckets store per-bucket counts; cumulative ``le`` counts are
+    produced at render time (the exposition format's convention).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        self.bounds = tuple(sorted(buckets))
+        if not self.bounds or self.bounds[-1] != math.inf:
+            self.bounds = self.bounds + (math.inf,)
+        self.counts = [0] * len(self.bounds)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.sum += v
+        self.count += 1
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                return
+
+
+class Registry:
+    """Thread-safe metric store keyed by ``(name, labels)``."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple[str, tuple], Any] = {}
+
+    def _get_locked(self, name: str, labels, cls):
+        """Fetch-or-create under the ALREADY-HELD lock."""
+        key = (name, _label_key(labels))
+        m = self._metrics.get(key)
+        if m is None:
+            m = self._metrics[key] = cls()
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}"
+            )
+        return m
+
+    def counter(self, name: str, labels: dict | None = None) -> Counter:
+        with self._lock:
+            return self._get_locked(name, labels, Counter)
+
+    def gauge(self, name: str, labels: dict | None = None) -> Gauge:
+        with self._lock:
+            return self._get_locked(name, labels, Gauge)
+
+    def histogram(self, name: str, labels: dict | None = None) -> Histogram:
+        with self._lock:
+            return self._get_locked(name, labels, Histogram)
+
+    # convenience mutators (one lock round-trip each; call sites stay
+    # one-liners behind the enabled() gate)
+
+    def inc(self, name: str, v: float = 1.0, labels: dict | None = None) -> None:
+        with self._lock:
+            self._get_locked(name, labels, Counter).inc(v)
+
+    def set(self, name: str, v: float, labels: dict | None = None) -> None:
+        with self._lock:
+            self._get_locked(name, labels, Gauge).set(v)
+
+    def observe(self, name: str, v: float, labels: dict | None = None) -> None:
+        with self._lock:
+            self._get_locked(name, labels, Histogram).observe(v)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> list[dict]:
+        """JSON-serializable dump of every metric (the ``metrics``
+        JSONL event body, and the input to :func:`render_prometheus`)."""
+        out = []
+        with self._lock:
+            for (name, labels), m in sorted(self._metrics.items()):
+                entry: dict[str, Any] = {
+                    "name": name,
+                    "kind": m.kind,
+                    "labels": dict(labels),
+                }
+                if m.kind == "histogram":
+                    entry["buckets"] = [
+                        ["+Inf" if b == math.inf else b, c]
+                        for b, c in zip(m.bounds, m.counts)
+                    ]
+                    entry["sum"] = m.sum
+                    entry["count"] = m.count
+                else:
+                    entry["value"] = m.value
+                out.append(entry)
+        return out
+
+
+def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in sorted(merged.items()))
+    return "{" + body + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    # non-finite first: int(NaN)/int(inf) raise, and a diverged fit's
+    # loss_mean=NaN must not take the instrument panel down with it
+    # (Prometheus text spec spells these NaN/+Inf/-Inf)
+    if not math.isfinite(f):
+        return "NaN" if math.isnan(f) else ("+Inf" if f > 0 else "-Inf")
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(snapshot: list[dict]) -> str:
+    """Prometheus text exposition of a :meth:`Registry.snapshot`."""
+    lines: list[str] = []
+    seen_type: set[str] = set()
+    for entry in snapshot:
+        name, kind, labels = entry["name"], entry["kind"], entry["labels"]
+        if name not in seen_type:
+            lines.append(f"# TYPE {name} {kind}")
+            seen_type.add(name)
+        if kind == "histogram":
+            cum = 0
+            for le, c in entry["buckets"]:
+                cum += c
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_fmt_labels(labels, {'le': le})} {cum}"
+                )
+            lines.append(
+                f"{name}_sum{_fmt_labels(labels)} "
+                f"{_fmt_value(entry['sum'])}"
+            )
+            lines.append(
+                f"{name}_count{_fmt_labels(labels)} {entry['count']}"
+            )
+        else:
+            lines.append(
+                f"{name}{_fmt_labels(labels)} {_fmt_value(entry['value'])}"
+            )
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,191 @@
+"""Nestable phase spans — the host-side half of the tracing story.
+
+``span("bootstrap")`` records wall-clock for a phase into the current
+run's event stream, maintains a per-thread nesting stack (so events
+carry a full ``path`` like ``fit/compile``), and composes with
+``jax.named_scope``: a span opened inside a jit trace enters the same
+name as a scope, so host spans and device traces (TensorBoard/Perfetto
+via ``utils/profiling.trace``) segment by the SAME phase names — the
+Spark-UI-stages analog [SURVEY §5].
+
+Two cost tiers, per the zero-overhead-when-disabled contract:
+
+- disabled: ``span()`` returns a shared no-op context manager (or a
+  bare ``jax.named_scope`` from ``phase()``, preserving the device
+  trace annotation the engines always had) — no clock reads, no
+  allocation.
+- enabled: two ``perf_counter`` reads plus an event append; optional
+  **device-sync** timing (``set_device_sync(True)``) drains the
+  dispatch queue at span exit so the wall-clock covers the device work
+  launched inside the span, not just its dispatch — opt-in because the
+  barrier serializes the pipeline it is measuring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+from spark_bagging_tpu.telemetry.state import STATE as _state
+
+
+class _Nesting(threading.local):
+    def __init__(self) -> None:
+        self.stack: list[str] = []
+
+
+_nesting = _Nesting()
+
+
+def _device_barrier() -> None:
+    """Best-effort full-queue drain: enqueue a trivial computation and
+    block on it (per-device streams execute in order, so its completion
+    bounds all previously dispatched work)."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.zeros(()))
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+@contextmanager
+def _record_span(
+    name: str, attrs: dict[str, Any] | None, metric: str | None,
+    sync: bool | None,
+) -> Iterator[None]:
+    stack = _nesting.stack
+    do_sync = _state.device_sync if sync is None else sync
+    if do_sync:
+        # entry barrier BEFORE the stack push: if the device is already
+        # wedged this raises without corrupting the nesting state
+        _device_barrier()
+    stack.append(name)
+    path = "/".join(stack)
+    t0 = time.perf_counter()
+    t0_epoch = time.time()
+    try:
+        yield
+    finally:
+        # pop FIRST — later spans on this thread must not inherit a
+        # stale path prefix no matter what the barrier below does
+        stack.pop()
+        if do_sync:
+            try:
+                _device_barrier()
+            except Exception:  # noqa: BLE001 — a body exception (the
+                # device failing mid-span) must not be masked by the
+                # measurement barrier failing for the same reason
+                pass
+        dt = time.perf_counter() - t0
+        if metric is not None:
+            _state.registry.observe(metric, dt)
+        event = {
+            "kind": "span",
+            "name": name,
+            "path": path,
+            "ts": t0_epoch,
+            "seconds": dt,
+            "sync": bool(do_sync),
+        }
+        if attrs:
+            event["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+        _state.emit(event)
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def span(
+    name: str,
+    *,
+    metric: str | None = None,
+    sync: bool | None = None,
+    **attrs: Any,
+):
+    """Record a nestable host phase span named ``name``.
+
+    ``metric`` additionally folds the duration into that log-scale
+    histogram in the registry (e.g. per-chunk latencies). ``sync``
+    forces device-sync timing on/off for this span regardless of the
+    global opt-in. No-op (one attribute read) when telemetry is
+    disabled.
+    """
+    if not _state.enabled:
+        return _NOOP
+    return _record_span(name, attrs or None, metric, sync)
+
+
+def phase(name: str, *, sync: bool | None = None, **attrs: Any):
+    """``span()`` fused with ``jax.named_scope``: the engine phases
+    (prepare/bootstrap/base_fit/aggregate) annotate the device trace
+    under the same name the host span records, so the two timelines
+    correlate by name. When telemetry is disabled this degrades to the
+    bare ``named_scope`` the engines always used — identical device
+    traces, zero added host work. Inside a jit trace the host span
+    measures trace-construction time (recorded with ``traced=True``);
+    outside it measures the real phase.
+    """
+    import jax
+
+    scope = jax.named_scope(name)
+    if not _state.enabled:
+        return scope
+    traced = _under_trace()
+    if traced:
+        attrs = dict(attrs, traced=True)
+        sync = False  # tracing is host work; a barrier adds nothing
+    return _Both(scope, _record_span(name, attrs or None, None, sync))
+
+
+def _under_trace() -> bool:
+    """Are we inside jax tracing (jit/vmap/scan body) right now?"""
+    import jax
+
+    try:
+        return not jax.core.trace_state_clean()
+    except Exception:  # noqa: BLE001 — never let telemetry break a trace
+        return False
+
+
+class _Both:
+    """Enter/exit two context managers as one (scope outer, span inner)."""
+
+    __slots__ = ("_a", "_b")
+
+    def __init__(self, a, b) -> None:
+        self._a, self._b = a, b
+
+    def __enter__(self):
+        self._a.__enter__()
+        try:
+            self._b.__enter__()
+        except BaseException:
+            self._a.__exit__(None, None, None)
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self._b.__exit__(*exc)
+        finally:
+            self._a.__exit__(*exc)
+        return None

@@ -43,6 +43,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
+from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.models.tree import _TreeBase, _quantile_edges
 from spark_bagging_tpu.ops.bootstrap import (
     RNG_SCHEMA,
@@ -197,7 +198,8 @@ def fit_tree_ensemble_stream(
         e_sum = jnp.zeros((n_features, B - 1), jnp.float32)
         e_cnt = jnp.zeros((), jnp.float32)
         n_chunks = 0
-        with closing(source.chunks()) as chunk_iter:
+        with telemetry.span("tree_pass", kind="edges"), \
+                closing(source.chunks()) as chunk_iter:
             for Xc, _, n_valid in chunk_iter:
                 e, has = edge_chunk(
                     jnp.asarray(Xc, jnp.float32),
@@ -205,6 +207,8 @@ def fit_tree_ensemble_stream(
                 )
                 e_sum, e_cnt = e_sum + e, e_cnt + has
                 n_chunks += 1
+                telemetry.inc("sbt_stream_chunks_total",
+                              labels={"engine": "tree"})
                 if first_step_seconds is None:
                     jax.block_until_ready(e)
                     first_step_seconds = time.perf_counter() - t0
@@ -284,19 +288,27 @@ def fit_tree_ensemble_stream(
         nonlocal first_step_seconds
         with closing(stats_src.chunks()) as chunk_iter:
           for c, (Xc, yc, n_valid) in enumerate(chunk_iter):
-            if mesh is not None:
-                Xd = global_put(
-                    np.asarray(Xc, np.float32), mesh, P(DATA_AXIS, None)
+            with telemetry.span("chunk_step",
+                                metric="sbt_chunk_seconds", chunk=c):
+                if mesh is not None:
+                    Xd = global_put(
+                        np.asarray(Xc, np.float32), mesh,
+                        P(DATA_AXIS, None)
+                    )
+                    yd = global_put(
+                        np.asarray(yc, y_dtype), mesh, P(DATA_AXIS)
+                    )
+                else:
+                    Xd = jnp.asarray(Xc, jnp.float32)
+                    yd = jnp.asarray(yc, y_dtype)
+                acc = step_fn(
+                    acc, feats_lvls, thrs_lvls, Xd, yd, edges_arg,
+                    jnp.asarray(n_valid, jnp.int32),
+                    jnp.asarray(c, jnp.int32),
+                    ids, subspaces,
                 )
-                yd = global_put(np.asarray(yc, y_dtype), mesh, P(DATA_AXIS))
-            else:
-                Xd = jnp.asarray(Xc, jnp.float32)
-                yd = jnp.asarray(yc, y_dtype)
-            acc = step_fn(
-                acc, feats_lvls, thrs_lvls, Xd, yd, edges_arg,
-                jnp.asarray(n_valid, jnp.int32), jnp.asarray(c, jnp.int32),
-                ids, subspaces,
-            )
+            telemetry.inc("sbt_stream_chunks_total",
+                          labels={"engine": "tree"})
             if first_step_seconds is None:
                 jax.block_until_ready(acc)
                 first_step_seconds = time.perf_counter() - t0
@@ -354,7 +366,8 @@ def fit_tree_ensemble_stream(
         hist = jnp.zeros(
             (n_replicas, n_subspace, B, N, K), jnp.float32
         )
-        hist = _accumulate(_wrap_step(level_body), hist, source)
+        with telemetry.span("tree_pass", kind="level", level=level):
+            hist = _accumulate(_wrap_step(level_body), hist, source)
 
         k_split = learner._n_split_features(n_subspace)
 
@@ -403,7 +416,8 @@ def fit_tree_ensemble_stream(
         return jax.vmap(one)(acc, fls, tls, ids_l, subs_l)
 
     leaf_acc = jnp.zeros((n_replicas, 2**d, K), jnp.float32)
-    leaf_acc = _accumulate(_wrap_step(leaf_body), leaf_acc, source)
+    with telemetry.span("tree_pass", kind="leaves"):
+        leaf_acc = _accumulate(_wrap_step(leaf_body), leaf_acc, source)
 
     @jax.jit
     def finalize(leaf_acc, curve_stack):

@@ -46,6 +46,8 @@ def _zstd():
 def _write_arrays(path: str, payload: bytes, compress: bool | str) -> str:
     """Write the msgpack payload, zstd-compressed when requested and
     available. Returns the filename written."""
+    from spark_bagging_tpu import telemetry
+
     z = _zstd() if compress in (True, "auto") else None
     if compress is True and z is None:
         raise ImportError(
@@ -59,11 +61,15 @@ def _write_arrays(path: str, payload: bytes, compress: bool | str) -> str:
         name = "arrays.msgpack"
     with open(os.path.join(path, name), "wb") as f:
         f.write(payload)
+    telemetry.inc("sbt_checkpoint_bytes_total", float(len(payload)),
+                  labels={"kind": "model", "op": "save"})
     return name
 
 
 def _read_arrays(path: str) -> bytes:
     """Read the arrays payload, auto-detecting compression."""
+    from spark_bagging_tpu import telemetry
+
     zst = os.path.join(path, "arrays.msgpack.zst")
     if os.path.exists(zst):
         z = _zstd()
@@ -73,9 +79,13 @@ def _read_arrays(path: str) -> bytes:
                 "not installed"
             )
         with open(zst, "rb") as f:
-            return z.ZstdDecompressor().decompress(f.read())
-    with open(os.path.join(path, "arrays.msgpack"), "rb") as f:
-        return f.read()
+            payload = z.ZstdDecompressor().decompress(f.read())
+    else:
+        with open(os.path.join(path, "arrays.msgpack"), "rb") as f:
+            payload = f.read()
+    telemetry.inc("sbt_checkpoint_bytes_total", float(len(payload)),
+                  labels={"kind": "model", "op": "load"})
+    return payload
 
 
 def _class_path(obj: Any) -> str:
@@ -122,6 +132,16 @@ def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
     when the zstandard module is available, ``True`` requires it,
     ``False`` writes raw msgpack. Load auto-detects either format.
     """
+    from spark_bagging_tpu import telemetry
+
+    with telemetry.span("checkpoint_save",
+                        metric="sbt_checkpoint_seconds"):
+        _save_model_impl(model, path, compress=compress)
+
+
+def _save_model_impl(
+    model: Any, path: str, *, compress: bool | str
+) -> None:
     from flax import serialization  # lazy: keep flax off the import path
 
     model._check_fitted()
@@ -256,6 +276,14 @@ def load_model(path: str, *, mesh=None) -> Any:
 
     Checkpoints are trusted input — see :func:`_import_class`.
     """
+    from spark_bagging_tpu import telemetry
+
+    with telemetry.span("checkpoint_load",
+                        metric="sbt_checkpoint_seconds"):
+        return _load_model_impl(path, mesh=mesh)
+
+
+def _load_model_impl(path: str, *, mesh=None) -> Any:
     from flax import serialization  # lazy: keep flax off the import path
 
     if (not os.path.exists(os.path.join(path, "manifest.json"))

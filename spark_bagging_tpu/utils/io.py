@@ -81,6 +81,9 @@ class ChunkSource:
                 yield item
 
     def _chunks_over(self, raw) -> Iterator[Chunk]:
+        from spark_bagging_tpu import telemetry
+
+        src = type(self).__name__
         buf_X: list[np.ndarray] = []
         buf_y: list[np.ndarray] = []
         buffered = 0
@@ -93,6 +96,8 @@ class ChunkSource:
             while buffered >= self.chunk_rows:
                 Xa = np.concatenate(buf_X) if len(buf_X) > 1 else buf_X[0]
                 ya = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+                telemetry.inc("sbt_chunks_yielded_total",
+                              labels={"source": src})
                 yield Xa[: self.chunk_rows], ya[: self.chunk_rows], self.chunk_rows
                 buffered -= self.chunk_rows
                 # drop zero-length leftovers: a lingering empty view
@@ -106,6 +111,10 @@ class ChunkSource:
         if buffered > 0:
             Xa = np.concatenate(buf_X) if len(buf_X) > 1 else buf_X[0]
             ya = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+            # the padded tail is a yielded chunk too — producer/consumer
+            # counter diffs must not show a phantom 1-per-pass gap
+            telemetry.inc("sbt_chunks_yielded_total",
+                          labels={"source": src})
             yield _pad_chunk(Xa, ya, self.chunk_rows)
 
 

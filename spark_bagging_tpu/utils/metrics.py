@@ -202,6 +202,14 @@ def fit_report(
     matrix in host memory" protocol. ``flops_per_fit`` (the learner's
     analytic cost model) yields achieved TFLOP/s and MFU against the
     detected chip's bf16 peak.
+
+    The returned object is a view over the telemetry run registry
+    (``telemetry.FitReportView``): the key set is the historical
+    ``fit_report_`` contract, byte-identical, and every numeric entry
+    is simultaneously exported as an ``sbt_fit_<key>`` gauge (plus the
+    ``sbt_replicas_fitted_total`` counter and the compile/fit/h2d
+    histograms) so BENCH tooling and the Prometheus dump read the same
+    numbers the estimator reports.
     """
     losses = np.asarray(losses, np.float64)
     report: dict[str, Any] = {
@@ -244,4 +252,6 @@ def fit_report(
         report["mfu"] = (
             achieved / (peak * max(n_devices, 1)) if peak else None
         )
-    return report
+    from spark_bagging_tpu import telemetry
+
+    return telemetry.record_fit_report(report)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Any
 
 import os as _os
@@ -151,9 +152,27 @@ class PrefetchChunks(ChunkSource):
             target=produce, daemon=True, name="prefetch-producer"
         )
         t.start()
+        from spark_bagging_tpu import telemetry
+
         try:
             while True:
-                item = q.get()
+                if telemetry.enabled():
+                    # consumer-side stall: how long the device loop sat
+                    # waiting for the producer — THE number that says
+                    # whether ingestion or compute is the bottleneck.
+                    # Queue depth is sampled at the same moment (0 ⇒
+                    # producer-bound, full ⇒ consumer-bound).
+                    telemetry.set_gauge(
+                        "sbt_prefetch_queue_depth", q.qsize()
+                    )
+                    t0 = _time.perf_counter()
+                    item = q.get()
+                    telemetry.inc(
+                        "sbt_prefetch_stall_seconds_total",
+                        _time.perf_counter() - t0,
+                    )
+                else:
+                    item = q.get()
                 if item is _DONE:
                     return
                 if isinstance(item, BaseException):

@@ -35,10 +35,17 @@ def trace(log_dir: str) -> Iterator[None]:
 @contextlib.contextmanager
 def log_timing(label: str, level: int = logging.INFO) -> Iterator[None]:
     """Host-side wall-clock logging for coarse phases (ingestion,
-    compile, fit) — the Instrumentation-log analog."""
+    compile, fit) — the Instrumentation-log analog.
+
+    Subsumed by ``telemetry.span``: the same block is also recorded as
+    a phase span (with the log level as an attribute), so legacy
+    ``log_timing`` call sites feed the unified run trace for free."""
+    from spark_bagging_tpu import telemetry
+
     t0 = time.perf_counter()
     try:
-        yield
+        with telemetry.span(label, log_level=logging.getLevelName(level)):
+            yield
     finally:
         log.log(level, "%s: %.3fs", label, time.perf_counter() - t0)
 

@@ -32,6 +32,41 @@ def test_cross_process_cache_hits(tmp_path):
     assert warm["cache"]["entries"] == cold["cache"]["entries"]
 
 
+def test_sweep_purges_only_small_entries(tmp_path):
+    """The one-time stale-entry sweep [ADVICE r5 medium]: entries below
+    the size floor (written before MIN_COMPILE_SECS rose to 6.0) go,
+    along with their -atime LRU-bookkeeping siblings; big entries and
+    non-cache files stay."""
+    small = tmp_path / "aaa-cache"
+    small.write_bytes(b"x" * 1024)
+    small_atime = tmp_path / "aaa-atime"
+    small_atime.write_bytes(b"t")
+    big = tmp_path / "bbb-cache"
+    big.write_bytes(b"x" * (compile_cache.SWEEP_MIN_ENTRY_BYTES + 1))
+    other = tmp_path / "notes.txt"
+    other.write_text("keep me")
+    removed = compile_cache.sweep_stale_entries(str(tmp_path))
+    assert removed == 1
+    assert not small.exists() and not small_atime.exists()
+    assert big.exists() and other.exists()
+    assert compile_cache.stats().get("swept", 0) >= 1
+
+
+def test_sweep_once_is_per_cache_dir(tmp_path):
+    """enable()'s sweep is marker-gated per DIR, not per process: a
+    >=6s-compile entry that happens to serialize under the size floor
+    must not be re-deleted by every later child's enable()."""
+    first = tmp_path / "aaa-cache"
+    first.write_bytes(b"x" * 64)
+    assert compile_cache.sweep_stale_entries(str(tmp_path), once=True) == 1
+    # a small entry written AFTER the sweep (it passed the compile-time
+    # write gate, so it is legitimate) survives subsequent once-sweeps
+    legit = tmp_path / "bbb-cache"
+    legit.write_bytes(b"x" * 64)
+    assert compile_cache.sweep_stale_entries(str(tmp_path), once=True) == 0
+    assert legit.exists()
+
+
 def test_enable_idempotent(tmp_path):
     # enable() in THIS process: the conftest already initialized the
     # CPU backend, so this exercises the real config path

@@ -745,6 +745,38 @@ def test_scaled_grams_kernel_direct():
         )
 
 
+def _jaxprs_in_param_value(v):
+    """Sub-jaxprs reachable from one eqn param value.
+
+    Prefers ``jax.core.jaxprs_in_params`` (a private surface — works on
+    the pinned jax but is a likely casualty of an upgrade, the same
+    risk class as jax._src.monitoring [ADVICE r5 low]); falls back to a
+    manual walk yielding the Jaxpr/ClosedJaxpr instances a param can
+    carry (directly, or inside the tuples/lists that ``cond`` branches
+    and custom-call closures use), so the precision regression test
+    degrades gracefully instead of erroring out of the suite."""
+    fn = getattr(jax.core, "jaxprs_in_params", None)
+    if fn is not None:
+        try:
+            return list(fn({"_": v}))
+        except Exception:  # noqa: BLE001 — fall through to manual walk
+            pass
+
+    def walk(x, acc):
+        closed = getattr(jax.core, "ClosedJaxpr", ())
+        plain = getattr(jax.core, "Jaxpr", ())
+        if isinstance(x, closed):
+            acc.append(x.jaxpr)
+        elif isinstance(x, plain):
+            acc.append(x)
+        elif isinstance(x, (tuple, list)):
+            for item in x:
+                walk(item, acc)
+        return acc
+
+    return walk(v, [])
+
+
 def test_pallas_dot_precision_pinned_against_ambient_context():
     """Mosaic lowers only DEFAULT/HIGHEST dot precision; an ambient
     jax.default_matmul_precision("high") leaking into the kernel trace
@@ -760,7 +792,7 @@ def test_pallas_dot_precision_pinned_against_ambient_context():
             if eqn.primitive.name == "dot_general":
                 acc.append(eqn.params.get("precision"))
             for v in eqn.params.values():
-                for j in jax.core.jaxprs_in_params({"_": v}):
+                for j in _jaxprs_in_param_value(v):
                     dot_precisions(j, acc)
         return acc
 
