@@ -1,0 +1,84 @@
+"""Online serving: micro-batched, hot-swappable ensemble inference.
+
+Fits a bag, registers it in a serving ModelRegistry, then drives the
+MicroBatcher with simulated concurrent clients while hot-swapping in a
+retrained model mid-traffic — the request-level analog of the batch
+quickstart (01_quickstart.py).
+
+Run anywhere: uses the TPU if one is attached, else CPU.
+
+    python examples/09_serving.py
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+from sklearn.preprocessing import StandardScaler
+
+from spark_bagging_tpu import BaggingClassifier, LogisticRegression, telemetry
+from spark_bagging_tpu.serving import ModelRegistry
+
+X, y = load_breast_cancer(return_X_y=True)
+X = StandardScaler().fit_transform(X).astype(np.float32)
+
+clf_v1 = BaggingClassifier(
+    base_learner=LogisticRegression(max_iter=10),
+    n_estimators=64, seed=0,
+).fit(X, y)
+
+# -- register + warm: compile every row bucket BEFORE traffic ---------
+registry = ModelRegistry(min_bucket_rows=8, max_batch_rows=128)
+registry.register("cancer", clf_v1, warmup=True)
+executor = registry.executor("cancer")
+print(f"warmed buckets  : {executor.compiled_buckets}")
+
+# -- simulated concurrent clients against the micro-batcher -----------
+N_CLIENTS, N_REQUESTS = 8, 40
+results: dict[int, int] = {}
+lock = threading.Lock()
+
+
+def client(cid: int, batcher) -> None:
+    rng = np.random.default_rng(cid)
+    ok = 0
+    for _ in range(N_REQUESTS):
+        i = int(rng.integers(0, len(X)))
+        proba = batcher.predict_proba(X[i : i + 1], timeout=30)
+        ok += int(proba.shape == (1, 2))
+    with lock:
+        results[cid] = ok
+
+
+with registry.batcher("cancer", max_delay_ms=2.0, max_queue=512) as b:
+    threads = [
+        threading.Thread(target=client, args=(c, b))
+        for c in range(N_CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+
+    # -- hot-swap a retrained model while requests are in flight ------
+    clf_v2 = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=10),
+        n_estimators=64, seed=1,
+    ).fit(X, y)
+    registry.swap("cancer", clf_v2)  # atomic; in-flight batches finish
+    print(f"swapped to      : version {registry.version('cancer')}")
+
+    for t in threads:
+        t.join()
+
+served = sum(results.values())
+reg = telemetry.registry()
+print(f"requests served : {served}/{N_CLIENTS * N_REQUESTS}")
+print(f"batches         : {int(reg.counter('sbt_serving_batches_total').value)}"
+      f"  (coalescing ratio "
+      f"{served / max(reg.counter('sbt_serving_batches_total').value, 1):.1f}"
+      " requests/forward)")
+print(f"compiles        : {int(reg.counter('sbt_serving_compiles_total').value)}"
+      " (all during warmup/swap — zero per-request)")

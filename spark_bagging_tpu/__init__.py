@@ -16,7 +16,7 @@ sections instead of reference file:line):
   compose with pipelines [SURVEY §3.4].
 """
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import serving, telemetry
 from spark_bagging_tpu.bagging import (
     BaggingClassifier,
     BaggingRegressor,
@@ -61,6 +61,7 @@ from spark_bagging_tpu.utils.io import (
 __version__ = "0.2.0"
 
 __all__ = [
+    "serving",
     "telemetry",
     "BaggingClassifier",
     "clear_compiled_caches",

@@ -39,10 +39,10 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from spark_bagging_tpu.ensemble import (
+    classifier_forward,
     fit_ensemble,
     oob_predict_scores,
-    predict_ensemble_classifier,
-    predict_ensemble_regressor,
+    regressor_forward,
 )
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
@@ -144,23 +144,18 @@ def _jitted_sharded_predict_reg(learner, mesh, n_total, chunk_size,
 @functools.lru_cache(maxsize=256)
 def _jitted_predict_clf(learner, n_classes, n_total, voting, chunk_size,
                         identity_subspace):
-    return jax.jit(
-        lambda params, subspaces, X: predict_ensemble_classifier(
-            learner, params, subspaces, X, n_classes, n_total,
-            voting=voting, chunk_size=chunk_size,
-            identity_subspace=identity_subspace,
-        )
-    )
+    return jax.jit(classifier_forward(
+        learner, n_classes, n_total, voting=voting, chunk_size=chunk_size,
+        identity_subspace=identity_subspace,
+    ))
 
 
 @functools.lru_cache(maxsize=256)
 def _jitted_predict_reg(learner, n_total, chunk_size, identity_subspace):
-    return jax.jit(
-        lambda params, subspaces, X: predict_ensemble_regressor(
-            learner, params, subspaces, X, n_total, chunk_size=chunk_size,
-            identity_subspace=identity_subspace,
-        )
-    )
+    return jax.jit(regressor_forward(
+        learner, n_total, chunk_size=chunk_size,
+        identity_subspace=identity_subspace,
+    ))
 
 
 @functools.lru_cache(maxsize=256)
@@ -439,6 +434,38 @@ class _BaseBagging(ParamsMixin):
             raise RuntimeError(
                 f"{type(self).__name__} is not fitted; call fit(X, y) first"
             )
+
+    def aggregated_forward(self):
+        """The fitted ensemble's aggregated forward as a jit-able handle.
+
+        Returns ``(fn, params, subspaces)`` where ``fn`` is a pure
+        function ``fn(params, subspaces, X) -> aggregated output``
+        ((n, C) probabilities for classifiers, (n,) predictions for
+        regressors) with every static choice — learner, vote mode,
+        replica chunk, identity-subspace fast path — baked into the
+        closure, and ``params``/``subspaces`` are the fitted device
+        arrays to pass on every call. This is the serving seam: the
+        online serving executor (``spark_bagging_tpu/serving``) jits
+        ``fn`` once per row-bucket with a donated ``X`` buffer and
+        replays it for the model's lifetime; ``fn`` traces the exact
+        computation ``predict_proba``/``predict`` runs, so served
+        results match the batch API bit for bit.
+
+        Single-device handle: a mesh-fitted estimator must be gathered
+        first (``save()`` then ``load()`` without a mesh) — serving
+        shards by REQUESTS, not by rows of one request.
+        """
+        self._check_fitted()
+        if self.mesh is not None:
+            raise ValueError(
+                "aggregated_forward is the single-device serving handle;"
+                " save() the mesh-fitted ensemble and load() it without "
+                "a mesh to serve it"
+            )
+        return self._forward_closure(), self.ensemble_, self.subspaces_
+
+    def _forward_closure(self):
+        raise NotImplementedError  # per-task subclasses build it
 
     @property
     def feature_importances_(self) -> np.ndarray:
@@ -1410,6 +1437,15 @@ class BaggingClassifier(_BaseBagging):
             self._finalize_oob(counts, votes, y_enc)
         return self
 
+    def _forward_closure(self):
+        """Aggregated-forward closure for serving: trace-identical to
+        the ``predict_proba`` jit (same ``classifier_forward``)."""
+        return classifier_forward(
+            self._fitted_learner, self.n_classes_, self.n_estimators_,
+            voting=self.voting, chunk_size=self._eff_chunk(),
+            identity_subspace=self._identity_subspace,
+        )
+
     def predict_proba(self, X) -> np.ndarray:
         self._check_fitted()
         X = self._validate_X(X, fitted=True)
@@ -1616,6 +1652,16 @@ class BaggingRegressor(_BaseBagging):
                     cache = out.mean(axis=0).astype(np.float32)
             self._collapsed_beta_cache = cache
         return self._collapsed_beta_cache
+
+    def _forward_closure(self):
+        """Aggregated-forward closure for serving: always the device
+        ensemble forward (trace-identical to the ``predict`` jit) —
+        the host-side linear collapse stays a batch-API optimization."""
+        return regressor_forward(
+            self._fitted_learner, self.n_estimators_,
+            chunk_size=self._eff_chunk(),
+            identity_subspace=self._identity_subspace,
+        )
 
     def predict(self, X) -> np.ndarray:
         self._check_fitted()

@@ -244,6 +244,54 @@ def predict_ensemble_regressor(
     return mean_aggregate(scores, n_total=n_total, axis_name=replica_axis)
 
 
+def classifier_forward(
+    learner: BaseLearner,
+    n_classes: int,
+    n_total: int,
+    *,
+    voting: str = "soft",
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+):
+    """The aggregated classifier forward as a pure jit-able closure
+    ``forward(stacked_params, subspaces, X) -> (n, C) proba``.
+
+    One definition feeds both consumers — the estimator's batch
+    ``predict_proba`` jit cache and the serving executor's per-bucket
+    compiles (serving/executor.py) — so the two paths trace the
+    identical computation and cannot drift numerically.
+    """
+
+    def forward(stacked_params, subspaces, X):
+        return predict_ensemble_classifier(
+            learner, stacked_params, subspaces, X, n_classes, n_total,
+            voting=voting, chunk_size=chunk_size,
+            identity_subspace=identity_subspace,
+        )
+
+    return forward
+
+
+def regressor_forward(
+    learner: BaseLearner,
+    n_total: int,
+    *,
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+):
+    """The aggregated regressor forward as a pure jit-able closure
+    ``forward(stacked_params, subspaces, X) -> (n,) predictions`` —
+    see :func:`classifier_forward`."""
+
+    def forward(stacked_params, subspaces, X):
+        return predict_ensemble_regressor(
+            learner, stacked_params, subspaces, X, n_total,
+            chunk_size=chunk_size, identity_subspace=identity_subspace,
+        )
+
+    return forward
+
+
 def oob_predict_scores(
     learner: BaseLearner,
     stacked_params: Any,

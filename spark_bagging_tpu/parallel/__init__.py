@@ -10,6 +10,12 @@ Collectives ride ICI within a slice and DCN across hosts, reached only
 through JAX (``shard_map`` + ``lax.psum``) [SURVEY §5 comms backend].
 """
 
+from spark_bagging_tpu.parallel.compat import (
+    HAS_SHARD_MAP,
+    SHARD_MAP_SOURCE,
+    ShardMapUnavailable,
+    shard_map,
+)
 from spark_bagging_tpu.parallel.mesh import (
     DATA_AXIS,
     REPLICA_AXIS,
@@ -25,6 +31,10 @@ from spark_bagging_tpu.parallel.sharded import (
 from spark_bagging_tpu.parallel.distributed import initialize_distributed
 
 __all__ = [
+    "HAS_SHARD_MAP",
+    "SHARD_MAP_SOURCE",
+    "ShardMapUnavailable",
+    "shard_map",
     "DATA_AXIS",
     "REPLICA_AXIS",
     "device_put_rows",

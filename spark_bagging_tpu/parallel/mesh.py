@@ -30,6 +30,21 @@ def make_mesh(
     the right default for the fits/sec north star [B:2], where replicas
     are the abundant parallel axis.
     """
+    from spark_bagging_tpu.parallel.compat import HAS_SHARD_MAP
+
+    if not HAS_SHARD_MAP:
+        # the Mesh itself is just metadata and always constructible,
+        # but everything consuming it (parallel/sharded.py) needs
+        # shard_map — warn here, at the first decision point, instead
+        # of erroring replica-by-replica deep inside a fit
+        import warnings
+
+        warnings.warn(
+            "this jax build has no shard_map implementation "
+            "(parallel/compat.py); the mesh can be built but sharded "
+            "fit/predict will be unavailable",
+            stacklevel=2,
+        )
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data < 1 or (replica is not None and replica < 1):

@@ -38,6 +38,7 @@ from spark_bagging_tpu.ensemble import (
     predict_ensemble_regressor,
 )
 from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.parallel.compat import shard_map
 from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
 
 
@@ -151,7 +152,7 @@ def sharded_fit(
         in_specs.append(P(DATA_AXIS))
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
@@ -207,7 +208,7 @@ def sharded_predict_classifier(
     )
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(DATA_AXIS, None)),
         out_specs=P(DATA_AXIS, None),
@@ -265,7 +266,7 @@ def sharded_oob_scores(
     )
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(REPLICA_AXIS),      # stacked params
@@ -322,7 +323,7 @@ def sharded_predict_regressor(
     )
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(DATA_AXIS, None)),
         out_specs=P(DATA_AXIS),

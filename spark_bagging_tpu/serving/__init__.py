@@ -1,0 +1,62 @@
+"""Online inference serving: micro-batched, shape-bucketed, hot-swappable.
+
+The training stack ends at batch ``predict``/``predict_proba`` — per
+call, a request pays Python dispatch, a fresh h2d transfer, and (for a
+novel row count) an XLA recompile. This package is the request-level
+serving path on top of the fitted estimators:
+
+- :class:`EnsembleExecutor` (``executor.py``) — pre-compiles the
+  aggregated ensemble forward once per power-of-two row bucket
+  (``buckets.py``) with the input buffer donated; steady-state traffic
+  runs compiled executables only (**zero recompiles after warmup**,
+  counted by ``sbt_serving_compiles_total``).
+- :class:`MicroBatcher` (``batcher.py``) — a bounded-queue background
+  coalescer: concurrent ``submit()`` calls ride ONE padded TPU forward
+  within a ``max_delay_ms``/``max_batch_rows`` window, with explicit
+  :class:`Overloaded` backpressure and per-request futures.
+- :class:`ModelRegistry` (``registry.py``) — versioned registration
+  and atomic hot-swap (``registry.swap(name, new_model)``), including
+  load-from-checkpoint; swaps pre-compile the incoming executor on the
+  live bucket set so traffic never sees a compile stall.
+
+Telemetry rides the PR-1 registry end to end: ``sbt_serving_*``
+counters/gauges/histograms (requests, rows, batches, queue depth,
+batch fill ratio, padding waste, compile count/seconds, request
+latency, overload rejections, swap events) plus spans around
+enqueue / forward / scatter.
+
+Typical use::
+
+    from spark_bagging_tpu.serving import ModelRegistry
+
+    registry = ModelRegistry()
+    registry.register("clf", fitted_model, warmup=True)
+    batcher = registry.batcher("clf", max_delay_ms=2.0)
+
+    fut = batcher.submit(x_row)          # from any thread
+    proba = fut.result()
+
+    registry.swap("clf", retrained)      # atomic, mid-traffic
+    batcher.close()
+"""
+
+from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
+from spark_bagging_tpu.serving.buckets import (
+    bucket_for,
+    bucket_ladder,
+    next_pow2,
+    pad_to_bucket,
+)
+from spark_bagging_tpu.serving.executor import EnsembleExecutor
+from spark_bagging_tpu.serving.registry import ModelRegistry
+
+__all__ = [
+    "EnsembleExecutor",
+    "MicroBatcher",
+    "ModelRegistry",
+    "Overloaded",
+    "bucket_for",
+    "bucket_ladder",
+    "next_pow2",
+    "pad_to_bucket",
+]

@@ -1,0 +1,289 @@
+"""Micro-batching request coalescer — many submitters, one TPU forward.
+
+Online traffic arrives as many small concurrent requests; the paper's
+aggregation story ("vote/mean over replicas is ONE batched forward")
+only pays when those requests ride one program launch. The
+``MicroBatcher`` owns a bounded request queue and a single worker
+thread: the worker takes the first waiting request, keeps gathering
+until ``max_delay_ms`` elapses or ``max_batch_rows`` accumulate,
+concatenates the rows into one padded bucket forward on the executor,
+then scatters slices of the output back to per-request futures.
+
+Contracts that matter under load:
+
+- **Backpressure is explicit.** ``submit`` never blocks: a full queue
+  raises :class:`Overloaded` immediately (and counts
+  ``sbt_serving_overloaded_total``) so callers shed load at the edge
+  instead of silently queueing into timeout territory.
+- **Failure is per-batch, not fatal.** An executor exception fails
+  exactly the futures of the batch that hit it; the worker keeps
+  serving.
+- **Hot-swap-safe.** The executor is resolved from a provider ONCE per
+  micro-batch, so a registry ``swap()`` takes effect at the next batch
+  boundary while requests already forwarded finish on the executor
+  they started with — no request is ever dropped by a swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from queue import Empty, Full, Queue
+from typing import Any, Callable
+
+import numpy as np
+
+from spark_bagging_tpu import telemetry
+
+_SHUTDOWN = object()
+
+
+class Overloaded(RuntimeError):
+    """The batcher's request queue is full — shed this request.
+
+    Raised by :meth:`MicroBatcher.submit` instead of blocking: under
+    sustained overload a bounded queue must reject at the edge, or
+    every request degrades to worst-case latency together.
+    """
+
+
+class _Request:
+    __slots__ = ("X", "n", "mode", "future", "t_submit")
+
+    def __init__(self, X: np.ndarray, mode: str):
+        self.X = X
+        self.n = X.shape[0]
+        self.mode = mode
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesce concurrent ``submit()`` calls into bucketed forwards.
+
+    ``executor`` is an :class:`~spark_bagging_tpu.serving.executor.
+    EnsembleExecutor` — or a zero-arg callable returning the current
+    one (the registry's hot-swap hook).
+
+    ``max_delay_ms`` bounds the extra latency any request pays waiting
+    for batch-mates; ``max_batch_rows`` bounds one forward's row count;
+    ``max_queue`` bounds requests admitted but not yet forwarded
+    (beyond it, :class:`Overloaded`).
+
+    ``idle_flush_ms`` is how long the worker lingers on an EMPTY queue
+    before launching what it has. Closed-loop clients (submit, wait,
+    repeat) all go quiet once their wave is enqueued — waiting out the
+    full ``max_delay_ms`` window after that is pure added latency with
+    zero extra coalescing, so the default flushes fast; raise it toward
+    ``max_delay_ms`` when clients are open-loop and stragglers trickle
+    in, lower it to 0 to launch the instant the queue empties.
+    """
+
+    def __init__(
+        self,
+        executor: Any,
+        *,
+        max_delay_ms: float = 2.0,
+        max_batch_rows: int = 2048,
+        max_queue: int = 256,
+        idle_flush_ms: float = 0.25,
+    ):
+        if max_delay_ms < 0 or idle_flush_ms < 0:
+            raise ValueError(
+                f"delays must be >= 0, got max_delay_ms={max_delay_ms}, "
+                f"idle_flush_ms={idle_flush_ms}"
+            )
+        if max_batch_rows < 1 or max_queue < 1:
+            raise ValueError("max_batch_rows and max_queue must be >= 1")
+        if callable(executor) and not hasattr(executor, "forward"):
+            self._resolve: Callable[[], Any] = executor
+        else:
+            self._resolve = lambda: executor
+        # contract snapshot: the registry's swap validation guarantees
+        # task and feature width are invariant per entry, so submit()
+        # validates against this snapshot instead of resolving the
+        # executor (a registry-lock acquisition) on every request
+        ex0 = self._resolve()
+        self._n_features = int(ex0.n_features)
+        self._task = ex0.task
+        self.max_delay_s = max_delay_ms / 1e3
+        self.idle_flush_s = idle_flush_ms / 1e3
+        self.max_batch_rows = int(max_batch_rows)
+        self._q: Queue = Queue(maxsize=int(max_queue))
+        self._stop = threading.Event()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._loop, daemon=True, name="serving-batcher"
+        )
+        self._worker.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, X, *, mode: str = "aggregate") -> Future:
+        """Enqueue one request; returns a ``concurrent.futures.Future``.
+
+        ``mode="aggregate"`` resolves to the executor's raw aggregated
+        output (probabilities / predictions); ``mode="predict"``
+        resolves to class labels (classification) or predictions
+        (regression). Raises :class:`Overloaded` when the queue is
+        full and ``RuntimeError`` after :meth:`close`.
+        """
+        if mode not in ("aggregate", "predict"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        X = np.ascontiguousarray(np.asarray(X, np.float32))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != self._n_features:
+            raise ValueError(
+                f"X must be (n, {self._n_features}), got {X.shape}"
+            )
+        if X.shape[0] == 0:
+            raise ValueError("X has no rows")
+        req = _Request(X, mode)
+        with telemetry.span("serving_enqueue", rows=req.n):
+            try:
+                self._q.put_nowait(req)
+            except Full:
+                telemetry.inc("sbt_serving_overloaded_total")
+                raise Overloaded(
+                    f"serving queue full ({self._q.maxsize} requests "
+                    "waiting); retry with backoff or raise max_queue"
+                ) from None
+        if self._closed and req.future.cancel():
+            # raced close(): its drain may already have run, so nobody
+            # would ever serve this request — a successful cancel means
+            # no worker claimed it (claims flip it to RUNNING, where
+            # cancel() returns False and the request is served anyway);
+            # fail fast instead of hanging the caller
+            raise RuntimeError("MicroBatcher closed during submit")
+        if telemetry.enabled():
+            telemetry.inc("sbt_serving_requests_total")
+            telemetry.set_gauge("sbt_serving_queue_depth",
+                                self._q.qsize())
+        return req.future
+
+    def predict(self, X, timeout: float | None = 30.0) -> np.ndarray:
+        """Synchronous convenience: submit + wait for class labels /
+        predictions."""
+        return self.submit(X, mode="predict").result(timeout)
+
+    def predict_proba(self, X, timeout: float | None = 30.0) -> np.ndarray:
+        """Synchronous convenience: submit + wait for probabilities
+        (classification executors only)."""
+        if self._task != "classification":
+            raise AttributeError(
+                "predict_proba is classification-only; this batcher "
+                "serves a regression executor"
+            )
+        return self.submit(X, mode="aggregate").result(timeout)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting requests, let the in-flight batch finish,
+        fail whatever is still queued, join the worker."""
+        if self._closed:
+            return
+        self._closed = True
+        # stop BEFORE the join: the worker's outer get() polls the flag
+        # every 100ms, so even with a full queue (sentinel un-enqueueable)
+        # it exits after at most the in-flight batch + one poll — the
+        # join never has to burn its whole timeout on a set-too-late flag
+        self._stop.set()
+        try:  # best-effort wake so an idle worker exits immediately
+            self._q.put_nowait(_SHUTDOWN)
+        except Full:
+            pass
+        self._worker.join(timeout)
+        # anything still queued was never forwarded — fail it loudly
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except Empty:
+                break
+            if req is _SHUTDOWN:
+                continue
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(
+                    RuntimeError("MicroBatcher closed before this "
+                                 "request was served")
+                )
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker side ---------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except Empty:
+                continue
+            if first is _SHUTDOWN:
+                return
+            batch = [first]
+            rows = first.n
+            deadline = time.perf_counter() + self.max_delay_s
+            while rows < self.max_batch_rows:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    # linger at most idle_flush on an empty queue: an
+                    # Empty here means the wave is absorbed — launch
+                    # now instead of sleeping out the window
+                    req = self._q.get(
+                        timeout=min(remaining, self.idle_flush_s)
+                    )
+                except Empty:
+                    break
+                if req is _SHUTDOWN:
+                    self._stop.set()
+                    break
+                batch.append(req)
+                rows += req.n
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: list) -> None:
+        # claim the futures; drop requests cancelled while queued
+        live = [r for r in batch if r.future.set_running_or_notify_cancel()]
+        if not live:
+            return
+        if telemetry.enabled():
+            telemetry.inc("sbt_serving_batches_total")
+            telemetry.set_gauge("sbt_serving_queue_depth",
+                                self._q.qsize())
+        try:
+            ex = self._resolve()
+            X = (live[0].X if len(live) == 1
+                 else np.concatenate([r.X for r in live]))
+            with telemetry.span("serving_batch", rows=X.shape[0],
+                                requests=len(live)):
+                out = ex.forward(X)
+        except BaseException as e:  # noqa: BLE001 — delivered per-future
+            for r in live:
+                r.future.set_exception(e)
+            return
+        with telemetry.span("serving_scatter", requests=len(live)):
+            off = 0
+            t_done = time.perf_counter()
+            for r in live:
+                piece = out[off:off + r.n]
+                off += r.n
+                try:
+                    if r.mode == "predict" and ex.task == "classification":
+                        piece = ex.classes_[piece.argmax(axis=1)]
+                    r.future.set_result(piece)
+                except BaseException as e:  # noqa: BLE001
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                if telemetry.enabled():
+                    telemetry.observe("sbt_serving_latency_seconds",
+                                      t_done - r.t_submit)

@@ -1,0 +1,192 @@
+"""Pre-compiled, shape-bucketed executor for one fitted ensemble.
+
+The batch API (``BaggingClassifier.predict_proba`` &c.) re-enters jit
+dispatch per call and compiles per novel input shape — fine for
+offline scoring, wrong for online traffic. ``EnsembleExecutor`` turns
+a fitted estimator into a long-lived predictor:
+
+- the aggregated forward (``model.aggregated_forward()``) is lowered
+  and compiled ONCE per row bucket (AOT, ``.lower().compile()``) with
+  the incoming ``X`` buffer **donated** — steady state runs compiled
+  executables only, no tracing, no dispatch-cache probing;
+- incoming batches pad up to the power-of-two bucket ladder
+  (``buckets.py``), so the compiled-shape set is finite and
+  :meth:`warmup` makes post-warmup compiles exactly zero
+  (``sbt_serving_compiles_total`` counts every build);
+- batches larger than the top bucket split into top-bucket slabs.
+
+Thread-safe: compiled executables are safe to call concurrently; the
+bucket cache itself is built under a lock (one compile per bucket even
+when many threads race to first use).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.serving.buckets import (
+    DEFAULT_MAX_ROWS,
+    DEFAULT_MIN_ROWS,
+    bucket_for,
+    bucket_ladder,
+    pad_to_bucket,
+)
+
+
+class EnsembleExecutor:
+    """Serve one fitted bagging estimator with bucketed AOT compiles.
+
+    ``model`` is any fitted ``Bagging*``/``RandomForest*`` estimator
+    (or anything exposing the same ``aggregated_forward()`` contract).
+    ``donate_input=True`` donates the padded ``X`` buffer to each
+    forward — it is a per-call scratch transfer, so XLA may reuse its
+    memory for the outputs. The default (``None``) donates on
+    accelerator backends only: CPU XLA does not implement donation and
+    would warn on every bucket compile.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        min_bucket_rows: int = DEFAULT_MIN_ROWS,
+        max_batch_rows: int = DEFAULT_MAX_ROWS,
+        donate_input: bool | None = None,
+    ):
+        import jax
+
+        if donate_input is None:
+            donate_input = jax.default_backend() != "cpu"
+        if min_bucket_rows < 1 or max_batch_rows < min_bucket_rows:
+            raise ValueError(
+                f"need 1 <= min_bucket_rows <= max_batch_rows, got "
+                f"{min_bucket_rows}, {max_batch_rows}"
+            )
+        fn, params, subspaces = model.aggregated_forward()
+        self.model = model
+        self.task: str = model.task
+        self.n_features: int = int(model.n_features_in_)
+        self.classes_ = getattr(model, "classes_", None)
+        self.min_bucket_rows = int(min_bucket_rows)
+        self.max_batch_rows = int(max_batch_rows)
+        self._fn = fn
+        self._params = params
+        self._subspaces = subspaces
+        self._donate = bool(donate_input)
+        self._compiled: dict[int, Any] = {}
+        self._build_lock = threading.Lock()
+
+    # -- compile management --------------------------------------------
+
+    @property
+    def compiled_buckets(self) -> tuple[int, ...]:
+        """Buckets with a live executable (ascending)."""
+        return tuple(sorted(self._compiled))
+
+    def warmup(self, buckets=None) -> tuple[int, ...]:
+        """Compile ahead of traffic. ``buckets=None`` compiles the full
+        ladder — afterwards NO request can trigger a compile. Returns
+        the buckets compiled by this call."""
+        if buckets is None:
+            buckets = bucket_ladder(self.min_bucket_rows,
+                                    self.max_batch_rows)
+        built = []
+        for b in buckets:
+            b = bucket_for(int(b), self.min_bucket_rows,
+                           self.max_batch_rows)
+            if b not in self._compiled:
+                self._build(b)
+                built.append(b)
+        return tuple(built)
+
+    def _build(self, bucket: int):
+        """Compile the forward for one bucket (serialized; double-checked
+        so racing threads compile each bucket once)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._build_lock:
+            fn = self._compiled.get(bucket)
+            if fn is not None:
+                return fn
+            t0 = time.perf_counter()
+            with telemetry.span("serving_compile", bucket=bucket):
+                jitted = jax.jit(
+                    self._fn,
+                    donate_argnums=(2,) if self._donate else (),
+                )
+                Xz = jnp.zeros((bucket, self.n_features), jnp.float32)
+                compiled = jitted.lower(
+                    self._params, self._subspaces, Xz
+                ).compile()
+            telemetry.inc("sbt_serving_compiles_total")
+            telemetry.observe("sbt_serving_compile_seconds",
+                              time.perf_counter() - t0)
+            self._compiled[bucket] = compiled
+            return compiled
+
+    # -- the forward ---------------------------------------------------
+
+    def forward(self, X) -> np.ndarray:
+        """Aggregated output for ``X`` — (n, C) probabilities for a
+        classifier, (n,) predictions for a regressor. Pads to the
+        bucket, runs the compiled executable, slices padding off."""
+        X = np.ascontiguousarray(np.asarray(X, np.float32))
+        if X.ndim == 1:
+            # single feature vector: the overwhelmingly common online
+            # request shape — accept it as one row
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"X must be (n, {self.n_features}), got {X.shape}"
+            )
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("X has no rows")
+        if n <= self.max_batch_rows:
+            return self._forward_piece(X)
+        pieces = [
+            self._forward_piece(X[s:s + self.max_batch_rows])
+            for s in range(0, n, self.max_batch_rows)
+        ]
+        return np.concatenate(pieces)
+
+    __call__ = forward
+
+    def _forward_piece(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        bucket = bucket_for(n, self.min_bucket_rows, self.max_batch_rows)
+        compiled = self._compiled.get(bucket)
+        if compiled is None:
+            compiled = self._build(bucket)
+        if telemetry.enabled():
+            telemetry.inc("sbt_serving_rows_total", float(n))
+            telemetry.inc("sbt_serving_padding_rows_total",
+                          float(bucket - n))
+            telemetry.observe("sbt_serving_batch_fill_ratio", n / bucket)
+        Xp = pad_to_bucket(X, bucket)
+        with telemetry.span("serving_forward", bucket=bucket, rows=n):
+            out = compiled(self._params, self._subspaces, Xp)
+            out = np.asarray(out)  # device->host barrier
+        return out[:n]
+
+    # -- sklearn-flavored conveniences ---------------------------------
+
+    def predict_proba(self, X) -> np.ndarray:
+        if self.task != "classification":
+            raise AttributeError(
+                "predict_proba is classification-only; this executor "
+                f"serves a {self.task} model"
+            )
+        return self.forward(X)
+
+    def predict(self, X) -> np.ndarray:
+        out = self.forward(X)
+        if self.task == "classification":
+            return self.classes_[out.argmax(axis=1)]
+        return out

@@ -1,0 +1,203 @@
+"""Versioned model registry with atomic hot-swap.
+
+A serving process outlives any one fitted model: bags get retrained
+(fresh data, warm-started growth) and the serving copy must be replaced
+WITHOUT dropping in-flight traffic or paying a recompile stall at the
+swap instant. The registry owns that lifecycle:
+
+- :meth:`ModelRegistry.register` installs a fitted estimator under a
+  name (version 1) wrapped in an
+  :class:`~spark_bagging_tpu.serving.executor.EnsembleExecutor`;
+- :meth:`ModelRegistry.swap` builds the replacement executor OFF to the
+  side, validates it serves the same contract (task, feature width,
+  class set), **pre-compiles it on every bucket the live executor has
+  active** (so post-swap traffic stays zero-recompile), then replaces
+  the entry pointer atomically under the registry lock;
+- :meth:`ModelRegistry.load` does the same from a checkpoint directory
+  (``utils/checkpoint.load_model``) — the retrain-in-another-process
+  hand-off;
+- :meth:`ModelRegistry.batcher` returns a
+  :class:`~spark_bagging_tpu.serving.batcher.MicroBatcher` whose
+  executor is RESOLVED PER MICRO-BATCH from this registry, which is
+  what makes a swap atomic from the traffic's point of view: requests
+  already forwarded finish on the old executor, the next batch runs on
+  the new one, and nothing in between is dropped (tested mid-traffic
+  in tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.serving.executor import EnsembleExecutor
+
+
+class _Entry:
+    __slots__ = ("name", "version", "executor", "opts")
+
+    def __init__(self, name: str, version: int,
+                 executor: EnsembleExecutor, opts: dict):
+        self.name = name
+        self.version = version
+        self.executor = executor
+        self.opts = opts
+
+
+class ModelRegistry:
+    """Named, versioned serving models. All methods are thread-safe."""
+
+    def __init__(self, **default_executor_opts: Any):
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self._default_opts = default_executor_opts
+
+    # -- introspection -------------------------------------------------
+
+    def names(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._entries))
+
+    def version(self, name: str) -> int:
+        return self._entry(name).version
+
+    def executor(self, name: str) -> EnsembleExecutor:
+        """The CURRENT executor for ``name`` (a snapshot — hold the
+        return value no longer than one batch if you want swaps to
+        take effect)."""
+        return self._entry(name).executor
+
+    def model(self, name: str) -> Any:
+        return self._entry(name).executor.model
+
+    def _entry(self, name: str) -> _Entry:
+        with self._lock:
+            try:
+                return self._entries[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model registered as {name!r}; have "
+                    f"{sorted(self._entries)}"
+                ) from None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def register(self, name: str, model: Any, *, warmup: bool = False,
+                 **executor_opts: Any) -> EnsembleExecutor:
+        """Install a fitted estimator as version 1 of ``name``.
+
+        ``warmup=True`` compiles the full bucket ladder before the
+        method returns (serve-ready, zero compiles afterwards).
+        ``executor_opts`` (bucket bounds, donation) override the
+        registry defaults and stick to the name across swaps.
+        """
+        opts = {**self._default_opts, **executor_opts}
+        ex = EnsembleExecutor(model, **opts)
+        if warmup:
+            ex.warmup()
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(
+                    f"{name!r} is already registered (version "
+                    f"{self._entries[name].version}); use swap() to "
+                    "replace it"
+                )
+            self._entries[name] = _Entry(name, 1, ex, opts)
+        telemetry.inc("sbt_serving_models_registered_total")
+        return ex
+
+    def swap(self, name: str, model: Any, *, warm: bool = True,
+             **executor_opts: Any) -> EnsembleExecutor:
+        """Atomically replace ``name``'s serving model; returns the new
+        executor and bumps the version.
+
+        The replacement must serve the same contract (task, feature
+        width, and — for classifiers — the exact class set): a swap is
+        an invisible model upgrade, not an API change. ``warm=True``
+        (default) maps every bucket the live executor has active
+        through the NEW executor's ladder and pre-compiles those rungs,
+        so the traffic profile that was being served never hits a
+        compile stall after the swap (even when ``executor_opts``
+        changed the bucket bounds). ``executor_opts`` update the
+        entry's sticky options — committed only if the swap succeeds;
+        a rejected swap leaves the live entry fully untouched.
+        """
+        entry = self._entry(name)
+        old = entry.executor
+        opts = {**entry.opts, **executor_opts}
+        new = EnsembleExecutor(model, **opts)
+        if new.task != old.task:
+            raise ValueError(
+                f"swap would change task {old.task!r} -> {new.task!r}"
+            )
+        if new.n_features != old.n_features:
+            raise ValueError(
+                f"swap would change feature width {old.n_features} -> "
+                f"{new.n_features}"
+            )
+        if old.classes_ is not None and not np.array_equal(
+            np.asarray(old.classes_), np.asarray(new.classes_)
+        ):
+            raise ValueError(
+                "swap would change the served class set; register the "
+                "new label space under a new name instead"
+            )
+        if warm:
+            from spark_bagging_tpu.serving.buckets import bucket_for
+
+            for b in old.compiled_buckets:
+                # translate the observed traffic profile into the new
+                # executor's ladder (bounds may differ): the row counts
+                # that used to run in bucket b land in its image rung
+                new._build(bucket_for(
+                    b, new.min_bucket_rows, new.max_batch_rows
+                ))
+        with self._lock:
+            # re-read under the lock: racing swaps must serialize into
+            # a strict version order, last one in place
+            entry = self._entries[name]
+            entry.executor = new
+            entry.opts = opts
+            entry.version += 1
+            version = entry.version
+        telemetry.inc("sbt_serving_swaps_total")
+        telemetry.set_gauge("sbt_serving_model_version", float(version),
+                            labels={"model": name})
+        return new
+
+    def load(self, name: str, path: str, *, warm: bool = True,
+             **executor_opts: Any) -> EnsembleExecutor:
+        """Register-or-swap ``name`` from a checkpoint directory saved
+        with ``estimator.save()`` / ``utils/checkpoint.save_model`` —
+        the hand-off seam from a retraining job. ``executor_opts``
+        apply either way: on an existing name they ride the swap
+        (committed to the entry's sticky options only on success)."""
+        from spark_bagging_tpu.utils.checkpoint import load_model
+
+        model = load_model(path)
+        with self._lock:
+            exists = name in self._entries
+        if not exists:
+            try:
+                return self.register(name, model, warmup=warm,
+                                     **executor_opts)
+            except ValueError:
+                # register-or-swap must be race-safe: another load()
+                # may have installed the name between our check and the
+                # register — only that race falls through to swap
+                with self._lock:
+                    if name not in self._entries:
+                        raise
+        return self.swap(name, model, warm=warm, **executor_opts)
+
+    def batcher(self, name: str, **batcher_opts: Any):
+        """A micro-batcher bound to THIS registry entry by name: each
+        micro-batch resolves the executor afresh, so ``swap()`` takes
+        effect at the next batch boundary with no dropped requests."""
+        from spark_bagging_tpu.serving.batcher import MicroBatcher
+
+        self._entry(name)  # fail fast on unknown names
+        return MicroBatcher(lambda: self.executor(name), **batcher_opts)

@@ -7,7 +7,10 @@ is the TPU-native equivalent, one subsystem with three layers:
 1. **Registry** (``registry.py``) — process-wide, thread-safe counters,
    gauges, and log-scale histograms (``sbt_*`` metric names): compile
    seconds, h2d bytes, chunk latencies, replicas fitted, compile-cache
-   hits/misses, prefetch stalls, checkpoint bytes, OOB evaluations.
+   hits/misses, prefetch stalls, checkpoint bytes, OOB evaluations,
+   and the online-serving series (``sbt_serving_*``: requests, rows,
+   batches, queue depth, batch fill, padding waste, compile count,
+   request latency, overload rejections, swaps — serving/).
 2. **Spans** (``spans.py``) — nestable phase spans
    (``with telemetry.span("compile"): ...``) recording wall-clock per
    phase; ``phase()`` composes with ``jax.named_scope`` so host spans

@@ -51,6 +51,7 @@ from spark_bagging_tpu.ops.bootstrap import (
     feature_subspaces,
     replica_init_fit_keys,
 )
+from spark_bagging_tpu.parallel.compat import shard_map
 from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
 from spark_bagging_tpu.parallel.multihost import global_put, to_host
 from spark_bagging_tpu.streaming import (
@@ -273,7 +274,7 @@ def fit_tree_ensemble_stream(
         if mesh is None:
             return jax.jit(body, donate_argnums=(0,))
         r = P(REPLICA_AXIS)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body,
             mesh=mesh,
             #       acc fls tls  X                    y             e

@@ -34,29 +34,34 @@ _FORMAT_VERSION = 1
 
 def _zstd():
     """The zstandard module, or None — compression is optional (the
-    reference's Snappy/zstd JNI codec analog [SURVEY §2b])."""
-    try:
-        import zstandard
+    reference's Snappy/zstd JNI codec analog [SURVEY §2b]); the
+    resolution lives in utils/io.py, shared with every consumer."""
+    from spark_bagging_tpu.utils.io import optional_zstd
 
-        return zstandard
-    except ImportError:
-        return None
+    return optional_zstd()
 
 
 def _write_arrays(path: str, payload: bytes, compress: bool | str) -> str:
-    """Write the msgpack payload, zstd-compressed when requested and
-    available. Returns the filename written."""
+    """Write the msgpack payload, compressed when requested. Prefers
+    zstd; without the zstandard module, ``compress=True``/``"auto"``
+    fall back to the stdlib zlib codec (one-time warning) rather than
+    failing or silently skipping compression. Returns the filename
+    written."""
     from spark_bagging_tpu import telemetry
 
-    z = _zstd() if compress in (True, "auto") else None
-    if compress is True and z is None:
-        raise ImportError(
-            "compress=True needs the zstandard module; use "
-            "compress='auto' to fall back to uncompressed"
-        )
-    if z is not None:
-        name = "arrays.msgpack.zst"
-        payload = z.ZstdCompressor(level=3).compress(payload)
+    if compress in (True, "auto"):
+        z = _zstd()
+        if z is not None:
+            name = "arrays.msgpack.zst"
+            payload = z.ZstdCompressor(level=3).compress(payload)
+        else:
+            from spark_bagging_tpu.utils.io import warn_zstd_fallback
+
+            warn_zstd_fallback("checkpoint compression")
+            import zlib
+
+            name = "arrays.msgpack.z"
+            payload = zlib.compress(payload, 1)
     else:
         name = "arrays.msgpack"
     with open(os.path.join(path, name), "wb") as f:
@@ -67,10 +72,13 @@ def _write_arrays(path: str, payload: bytes, compress: bool | str) -> str:
 
 
 def _read_arrays(path: str) -> bytes:
-    """Read the arrays payload, auto-detecting compression."""
+    """Read the arrays payload, auto-detecting the codec by filename
+    (``.zst`` zstd — requires the module; ``.z`` stdlib zlib; bare —
+    uncompressed)."""
     from spark_bagging_tpu import telemetry
 
     zst = os.path.join(path, "arrays.msgpack.zst")
+    zl = os.path.join(path, "arrays.msgpack.z")
     if os.path.exists(zst):
         z = _zstd()
         if z is None:
@@ -80,6 +88,11 @@ def _read_arrays(path: str) -> bytes:
             )
         with open(zst, "rb") as f:
             payload = z.ZstdDecompressor().decompress(f.read())
+    elif os.path.exists(zl):
+        import zlib
+
+        with open(zl, "rb") as f:
+            payload = zlib.decompress(f.read())
     else:
         with open(os.path.join(path, "arrays.msgpack"), "rb") as f:
             payload = f.read()
@@ -128,9 +141,10 @@ def _deserialize_value(v: Any) -> Any:
 def save_model(model: Any, path: str, *, compress: bool | str = "auto") -> None:
     """Save a fitted bagging estimator to directory ``path``.
 
-    ``compress``: ``"auto"`` (default) zstd-compresses the array payload
-    when the zstandard module is available, ``True`` requires it,
-    ``False`` writes raw msgpack. Load auto-detects either format.
+    ``compress``: ``"auto"``/``True`` compress the array payload —
+    zstd when the zstandard module is available, else the stdlib zlib
+    codec (one-time warning); ``False`` writes raw msgpack. Load
+    auto-detects all three formats (``.zst``/``.z``/bare).
     """
     from spark_bagging_tpu import telemetry
 

@@ -24,6 +24,44 @@ import numpy as np
 
 Chunk = tuple[np.ndarray, np.ndarray, int]
 
+# -- optional compression codec ---------------------------------------
+#
+# zstandard is a SOFT dependency everywhere in this package (the
+# reference's Snappy/zstd JNI codec analog [SURVEY §2b]): payload
+# compression must degrade, never gate. `optional_zstd()` is the one
+# resolution point; consumers (utils/checkpoint.py) fall back to the
+# stdlib `zlib` codec when it returns None, with a one-time warning so
+# the degradation is visible without being fatal.
+
+_WARNED_NO_ZSTD = False
+
+
+def optional_zstd():
+    """The ``zstandard`` module, or None when not installed."""
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
+def warn_zstd_fallback(context: str) -> None:
+    """One-time (per process) notice that zstd was requested but the
+    stdlib codec is being used instead."""
+    global _WARNED_NO_ZSTD
+    if _WARNED_NO_ZSTD:
+        return
+    _WARNED_NO_ZSTD = True
+    import warnings
+
+    warnings.warn(
+        f"zstandard is not installed; {context} falls back to the "
+        "stdlib zlib codec (slower, larger payloads). `pip install "
+        "zstandard` to restore zstd compression.",
+        stacklevel=3,
+    )
+
 
 def _pad_chunk(
     X: np.ndarray, y: np.ndarray, chunk_rows: int

@@ -139,6 +139,40 @@ def test_checkpoint_zstd_compression(tmp_path, iris):
     )
 
 
+def test_checkpoint_zlib_fallback_without_zstandard(tmp_path, iris,
+                                                    monkeypatch):
+    """zstandard is a SOFT dependency: with the module missing, auto
+    and compress=True both degrade to the stdlib zlib codec (one-time
+    warning, .z suffix) instead of raising or silently writing raw —
+    and load auto-detects the fallback format."""
+    import os
+    import warnings
+
+    from spark_bagging_tpu.utils import checkpoint as ckpt, io as sbt_io
+
+    monkeypatch.setattr(ckpt, "_zstd", lambda: None)
+    monkeypatch.setattr(sbt_io, "_WARNED_NO_ZSTD", False)
+
+    X, y = iris
+    clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
+    p = str(tmp_path / "m")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        clf.save(p, compress=True)
+    assert any("zlib" in str(x.message) for x in w), "fallback must warn"
+    assert os.path.exists(os.path.join(p, "arrays.msgpack.z"))
+    assert not os.path.exists(os.path.join(p, "arrays.msgpack"))
+    loaded = BaggingClassifier.load(p)
+    np.testing.assert_allclose(
+        clf.predict_proba(X), loaded.predict_proba(X), rtol=1e-6
+    )
+    # the warning is one-time per process
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        clf.save(str(tmp_path / "m2"))
+    assert not any("zlib" in str(x.message) for x in w2)
+
+
 def test_auto_chunk_resolution_survives_roundtrip(tmp_path, iris):
     """An auto-chunked fit's resolved chunk must survive save/load, or
     the loaded model's predict/OOB maps vmap all replicas at once —

@@ -19,9 +19,12 @@ REPO = os.path.dirname(HERE)
 FAST_EXAMPLES = [
     "01_quickstart.py",
     "05_custom_learner.py",
-    "06_learner_zoo.py",
+    # 06_learner_zoo fits all 11 learner families (~70s of compiles) —
+    # the single biggest tier-1 sink; it runs under -m slow / full runs
+    pytest.param("06_learner_zoo.py", marks=pytest.mark.slow),
     "07_survival_aft.py",
     "08_out_of_core.py",
+    "09_serving.py",
 ]
 
 

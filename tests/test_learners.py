@@ -401,6 +401,8 @@ class TestLinearSVC:
         assert np.all(np.diff(curve) <= 1e-6)
         assert float(aux["loss"]) <= curve[0] + 1e-6
 
+    @pytest.mark.slow  # 100 sequential tiny fits ≈ 50s: the single
+    # largest non-example sink in the tier-1 window; full runs keep it
     def test_no_newton_cycling_on_tiny_bags(self):
         """Full undamped Newton steps on the squared hinge can cycle
         permanently on tiny problems (active-set flips) — the regime

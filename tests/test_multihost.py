@@ -22,6 +22,13 @@ from sklearn.preprocessing import StandardScaler
 
 from spark_bagging_tpu import BaggingClassifier
 from spark_bagging_tpu.parallel import make_mesh
+from spark_bagging_tpu.parallel.compat import HAS_SHARD_MAP
+
+pytestmark = pytest.mark.skipif(
+    not HAS_SHARD_MAP,
+    reason="this jax build has no shard_map implementation "
+           "(parallel/compat.py)",
+)
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multihost_worker.py")

@@ -1,0 +1,519 @@
+"""Serving subsystem tests [ISSUE 2]: bucket math, padding hygiene,
+micro-batch coalescing, backpressure, hot-swap atomicity, and the
+zero-recompile steady-state contract.
+
+The load-bearing property throughout: a served result must be
+BITWISE-equal to the batch API's answer for the same rows — padding
+rows, bucket choice, and batch-mates must be invisible. Bagging
+aggregation is row-local, and the serving executor jits the exact
+closure the batch ``predict_proba``/``predict`` jit uses
+(``ensemble.classifier_forward``/``regressor_forward``), so equality
+is exact, not approximate.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    GeneralizedLinearRegression,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.serving import (
+    EnsembleExecutor,
+    MicroBatcher,
+    ModelRegistry,
+    Overloaded,
+    bucket_for,
+    bucket_ladder,
+    next_pow2,
+    pad_to_bucket,
+)
+
+
+def _counter(name: str) -> float:
+    return telemetry.registry().counter(name).value
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(256, 12)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.normal(size=256) > 0)
+    return X, y.astype(np.int64)
+
+
+@pytest.fixture(scope="module")
+def clf(data):
+    X, y = data
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=0,
+    ).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def executor(clf):
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=64)
+    ex.warmup()
+    return ex
+
+
+# -- bucket math -------------------------------------------------------
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 4, 5, 63, 64, 65)] == [
+        1, 2, 4, 4, 8, 64, 64, 128,
+    ]
+    with pytest.raises(ValueError):
+        next_pow2(0)
+
+
+def test_bucket_for_clamps_to_ladder():
+    assert bucket_for(1, 8, 64) == 8
+    assert bucket_for(8, 8, 64) == 8
+    assert bucket_for(9, 8, 64) == 16
+    assert bucket_for(64, 8, 64) == 64
+    assert bucket_for(1000, 8, 64) == 64  # oversize: executor slabs it
+    with pytest.raises(ValueError):
+        bucket_for(0)
+
+
+def test_bucket_ladder():
+    assert bucket_ladder(8, 64) == (8, 16, 32, 64)
+    assert bucket_ladder(8, 8) == (8,)
+    with pytest.raises(ValueError):
+        bucket_ladder(16, 8)
+
+
+def test_non_pow2_bounds_stay_on_the_ladder():
+    """Arbitrary bucket bounds normalize to powers of two, so every
+    bucket_for() result is a warmup-ladder rung — otherwise a non-pow2
+    min/max would break the zero-recompile-after-warmup contract."""
+    ladder = bucket_ladder(10, 3000)
+    assert ladder == (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+    for n in (1, 3, 10, 17, 2999, 3000, 9999):
+        assert bucket_for(n, 10, 3000) in ladder
+
+
+def test_pad_to_bucket():
+    X = np.ones((3, 2), np.float32)
+    Xp = pad_to_bucket(X, 8)
+    assert Xp.shape == (8, 2)
+    np.testing.assert_array_equal(Xp[:3], X)
+    assert (Xp[3:] == 0).all()
+    assert pad_to_bucket(X, 3) is X  # exact fit: no copy
+    with pytest.raises(ValueError):
+        pad_to_bucket(X, 2)
+
+
+# -- executor correctness ----------------------------------------------
+
+def test_padded_rows_never_leak_classifier(clf, executor, data):
+    """Every row count in [1, max_batch] pads to SOME bucket; results
+    must be bitwise-identical to the batch predict_proba of exactly
+    those rows — padding garbage must never reach a caller."""
+    X, _ = data
+    for n in (1, 2, 7, 8, 9, 23, 33, 64):
+        got = executor.predict_proba(X[:n])
+        want = clf.predict_proba(X[:n])
+        np.testing.assert_array_equal(got, want)
+        assert got.shape == (n, 2)
+
+
+def test_predict_labels_match(clf, executor, data):
+    X, _ = data
+    np.testing.assert_array_equal(
+        executor.predict(X[:19]), clf.predict(X[:19])
+    )
+
+
+def test_oversize_batch_splits_into_slabs(clf, executor, data):
+    """Rows beyond max_batch_rows run as top-bucket slabs — same
+    answers, bounded compiled-shape set."""
+    X, _ = data
+    got = executor.predict_proba(X[:200])  # 200 > max_batch_rows=64
+    np.testing.assert_array_equal(got, clf.predict_proba(X[:200]))
+
+
+def test_single_feature_vector_accepted(clf, executor, data):
+    X, _ = data
+    got = executor.predict_proba(X[0])  # 1-D: one online request
+    np.testing.assert_array_equal(got, clf.predict_proba(X[:1]))
+
+
+def test_regressor_forward_matches_predict(data):
+    """Regressor serving runs the same device closure as the batch
+    predict jit (a non-collapsible learner keeps both on the device
+    path) — bitwise equality again."""
+    X, _ = data
+    rng = np.random.default_rng(3)
+    yr = np.exp(0.3 * X[:, 0] + 0.1 * rng.normal(size=len(X)))
+    reg = BaggingRegressor(
+        base_learner=GeneralizedLinearRegression(
+            family="poisson", max_iter=4
+        ),
+        n_estimators=4, seed=0,
+    ).fit(X, yr.astype(np.float32))
+    ex = EnsembleExecutor(reg, min_bucket_rows=8, max_batch_rows=32)
+    for n in (1, 5, 17, 32):
+        np.testing.assert_array_equal(
+            ex.predict(X[:n]), reg.predict(X[:n])
+        )
+    with pytest.raises(AttributeError):
+        ex.predict_proba(X[:4])
+
+
+def test_forest_and_gbt_models_serve(data):
+    """The tentpole covers forest/gbt models too: tree-based ensembles
+    go through the same aggregated_forward seam, bitwise-equal."""
+    from spark_bagging_tpu import (
+        BaggingRegressor, GBTRegressor, RandomForestClassifier,
+    )
+
+    X, y = data
+    rf = RandomForestClassifier(
+        n_estimators=4, max_depth=3, n_bins=8, seed=0
+    ).fit(X[:96], y[:96])
+    ex = EnsembleExecutor(rf, min_bucket_rows=8, max_batch_rows=32)
+    for n in (1, 11, 32):
+        np.testing.assert_array_equal(
+            ex.predict_proba(X[:n]), rf.predict_proba(X[:n])
+        )
+    gbt = BaggingRegressor(
+        base_learner=GBTRegressor(n_rounds=3, max_depth=2, n_bins=8),
+        n_estimators=2, seed=0,
+    ).fit(X[:96], X[:96, 0])
+    exg = EnsembleExecutor(gbt, min_bucket_rows=8, max_batch_rows=32)
+    for n in (1, 11):
+        np.testing.assert_array_equal(
+            exg.predict(X[:n]), gbt.predict(X[:n])
+        )
+
+
+def test_executor_validates_input(clf, executor):
+    with pytest.raises(ValueError, match="must be"):
+        executor.forward(np.zeros((4, 5), np.float32))  # wrong width
+    with pytest.raises(ValueError, match="no rows"):
+        executor.forward(np.zeros((0, clf.n_features_in_), np.float32))
+
+
+def test_unfitted_and_meshed_models_rejected(data):
+    X, y = data
+    with pytest.raises(RuntimeError, match="not fitted"):
+        EnsembleExecutor(BaggingClassifier(n_estimators=2))
+    clf = BaggingClassifier(n_estimators=2, seed=0).fit(X, y)
+    clf.mesh = object()  # stand-in: any mesh-bound estimator
+    with pytest.raises(ValueError, match="single-device"):
+        EnsembleExecutor(clf)
+
+
+# -- zero-recompile steady state ---------------------------------------
+
+def test_zero_new_compiles_after_warmup(clf, data):
+    """THE amortization contract: after warmup over the bucket ladder,
+    steady-state traffic of arbitrary row counts records ZERO new
+    compiles (sbt_serving_compiles_total is the telemetry witness)."""
+    X, _ = data
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=64)
+    built = ex.warmup()
+    assert built == (8, 16, 32, 64)
+    assert ex.compiled_buckets == (8, 16, 32, 64)
+    before = _counter("sbt_serving_compiles_total")
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        n = int(rng.integers(1, 200))
+        out = ex.predict_proba(X[:n])
+        assert out.shape == (n, 2)
+    assert _counter("sbt_serving_compiles_total") == before
+    # warmup again is a no-op too
+    assert ex.warmup() == ()
+    assert _counter("sbt_serving_compiles_total") == before
+
+
+# -- micro-batcher -----------------------------------------------------
+
+def test_micro_batch_coalesces_waiting_requests(clf, executor, data):
+    """Requests submitted within the delay window ride ONE forward:
+    far fewer batches than requests, results exact per request."""
+    X, _ = data
+    before = _counter("sbt_serving_batches_total")
+    ref = clf.predict_proba(X[:16])
+    with MicroBatcher(executor, max_delay_ms=250, idle_flush_ms=250,
+                      max_batch_rows=64, max_queue=64) as b:
+        futs = [b.submit(X[i:i + 1]) for i in range(16)]
+        results = [f.result(30) for f in futs]
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r, ref[i:i + 1])
+    n_batches = _counter("sbt_serving_batches_total") - before
+    assert 1 <= n_batches <= 3, f"expected coalescing, got {n_batches}"
+
+
+def test_concurrent_submitters_all_exact(clf, executor, data):
+    X, _ = data
+    ref = clf.predict_proba(X)
+    with MicroBatcher(executor, max_delay_ms=5, max_batch_rows=64,
+                      max_queue=128) as b:
+        def one(i):
+            return i, b.submit(X[i:i + 1]).result(30)
+
+        with ThreadPoolExecutor(8) as pool:
+            for i, r in pool.map(one, range(64)):
+                np.testing.assert_array_equal(r, ref[i:i + 1])
+
+
+def test_predict_mode_scatter(clf, executor, data):
+    X, _ = data
+    with MicroBatcher(executor, max_delay_ms=5, max_queue=32) as b:
+        futs = [b.submit(X[i:i + 1], mode="predict") for i in range(8)]
+        got = np.concatenate([f.result(30) for f in futs])
+    np.testing.assert_array_equal(got, clf.predict(X[:8]))
+
+
+class _StallingExecutor:
+    """Duck-typed executor whose forward blocks until released — makes
+    queue-full behavior deterministic."""
+
+    task = "classification"
+    n_features = 12
+    classes_ = np.array([0, 1])
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def forward(self, X):
+        self.entered.set()
+        assert self.release.wait(30)
+        return np.zeros((X.shape[0], 2), np.float32)
+
+
+def test_backpressure_overloaded_is_explicit():
+    ex = _StallingExecutor()
+    X1 = np.zeros((1, 12), np.float32)
+    before = _counter("sbt_serving_overloaded_total")
+    b = MicroBatcher(ex, max_delay_ms=0, max_queue=2)
+    try:
+        first = b.submit(X1)           # worker takes it, stalls in forward
+        assert ex.entered.wait(10)
+        b.submit(X1)                   # queue slot 1
+        b.submit(X1)                   # queue slot 2
+        with pytest.raises(Overloaded):
+            b.submit(X1)               # full -> explicit shed, no block
+        assert _counter("sbt_serving_overloaded_total") == before + 1
+    finally:
+        ex.release.set()
+        b.close()
+    assert first.result(10).shape == (1, 2)
+
+
+def test_closed_batcher_rejects_and_fails_pending(executor, data):
+    X, _ = data
+    b = MicroBatcher(executor, max_delay_ms=1)
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(X[:1])
+
+
+def test_batch_failure_is_per_batch_not_fatal(clf, executor, data):
+    """A poison request fails its own batch's futures; the worker keeps
+    serving later requests."""
+    X, _ = data
+
+    class _Flaky:
+        task = "classification"
+        n_features = clf.n_features_in_
+        classes_ = clf.classes_
+        boom = True
+
+        def forward(self, Xb):
+            if self.boom:
+                self.boom = False
+                raise RuntimeError("injected")
+            return executor.forward(Xb)
+
+    with MicroBatcher(_Flaky(), max_delay_ms=1, max_queue=8) as b:
+        bad = b.submit(X[:2])
+        with pytest.raises(RuntimeError, match="injected"):
+            bad.result(30)
+        good = b.submit(X[:2]).result(30)
+        np.testing.assert_array_equal(good, executor.forward(X[:2]))
+
+
+# -- registry + hot swap -----------------------------------------------
+
+def test_registry_register_swap_versions(clf, data):
+    X, y = data
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    ex1 = reg.register("m", clf, warmup=True)
+    assert reg.names() == ("m",)
+    assert reg.version("m") == 1
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("m", clf)
+
+    clf2 = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=1,
+    ).fit(X, y)
+    before = _counter("sbt_serving_compiles_total")
+    ex2 = reg.swap("m", clf2)
+    assert reg.version("m") == 2
+    assert reg.executor("m") is ex2 is not ex1
+    # warm swap pre-compiled the live bucket set on the NEW executor
+    assert ex2.compiled_buckets == ex1.compiled_buckets
+    assert _counter("sbt_serving_compiles_total") > before
+    np.testing.assert_array_equal(
+        ex2.predict_proba(X[:5]), clf2.predict_proba(X[:5])
+    )
+
+
+def test_swap_contract_violations_rejected(clf, data):
+    X, y = data
+    reg = ModelRegistry()
+    reg.register("m", clf)
+    wrong_width = BaggingClassifier(n_estimators=2, seed=0).fit(
+        X[:, :5], y
+    )
+    with pytest.raises(ValueError, match="feature width"):
+        reg.swap("m", wrong_width)
+    regressor = BaggingRegressor(n_estimators=2, seed=0).fit(
+        X, X[:, 0]
+    )
+    with pytest.raises(ValueError, match="task"):
+        reg.swap("m", regressor)
+    relabeled = BaggingClassifier(n_estimators=2, seed=0).fit(
+        X, np.where(y > 0, "pos", "neg")
+    )
+    with pytest.raises(ValueError, match="class set"):
+        reg.swap("m", relabeled)
+    with pytest.raises(KeyError, match="no model"):
+        reg.executor("ghost")
+
+
+def test_swap_with_changed_bounds_warms_new_ladder(clf, data):
+    """A swap that changes bucket bounds must pre-compile the OBSERVED
+    traffic profile's image in the NEW ladder — otherwise the first
+    post-swap request pays a compile stall the docs promise away."""
+    X, y = data
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf)
+    reg.executor("m").forward(X[:30])  # traffic compiled bucket 32
+    clf2 = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=3,
+    ).fit(X, y)
+    new = reg.swap("m", clf2, max_batch_rows=128)
+    assert 32 in new.compiled_buckets  # image of the observed bucket
+    before = _counter("sbt_serving_compiles_total")
+    new.forward(X[:30])  # the same traffic: no post-swap compile
+    assert _counter("sbt_serving_compiles_total") == before
+
+
+def test_rejected_swap_leaves_entry_untouched(clf, data, tmp_path):
+    """A swap/load that fails validation must not commit executor
+    options (or anything else) to the live entry."""
+    X, y = data
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf)
+    wrong = BaggingClassifier(n_estimators=2, seed=0).fit(X[:, :5], y)
+    p = str(tmp_path / "wrong")
+    wrong.save(p)
+    with pytest.raises(ValueError, match="feature width"):
+        reg.load("m", p, max_batch_rows=4096)
+    assert reg.version("m") == 1
+    assert reg.executor("m").max_batch_rows == 32
+    clf2 = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=4,
+    ).fit(X, y)
+    assert reg.swap("m", clf2).max_batch_rows == 32  # opts unpolluted
+
+
+def test_hot_swap_atomic_mid_traffic(clf, data):
+    """Swaps land mid-traffic without dropping or corrupting a single
+    request: every result is exactly model A's or model B's answer —
+    never an error, never a mixture."""
+    X, y = data
+    clf_b = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=99,
+    ).fit(X, y)
+    ref_a = clf.predict_proba(X)
+    ref_b = clf_b.predict_proba(X)
+    assert not np.array_equal(ref_a, ref_b)  # swap must be observable
+
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    reg.register("m", clf, warmup=True)
+    stop = threading.Event()
+    errors: list = []
+    checked = [0]
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            i = int(rng.integers(0, len(X)))
+            try:
+                r = b.submit(X[i:i + 1]).result(30)
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(e)
+                return
+            if not (np.array_equal(r, ref_a[i:i + 1])
+                    or np.array_equal(r, ref_b[i:i + 1])):
+                errors.append(AssertionError(f"row {i}: mixed result"))
+                return
+            checked[0] += 1
+
+    with reg.batcher("m", max_delay_ms=1, max_queue=256) as b:
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        # a FIXED number of swaps, not a wall-clock window: a warm
+        # swap's pre-compiles take arbitrarily long on a loaded CI
+        # host, and the property under test is per-swap, not per-second
+        model = [clf_b, clf]
+        n_swaps = 4
+        for k in range(n_swaps):
+            if errors:
+                break
+            reg.swap("m", model[k % 2])
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(60)
+    assert not errors, errors[:3]
+    assert checked[0] > 20, "traffic should have flowed throughout"
+    assert reg.version("m") == 1 + n_swaps
+
+
+def test_registry_load_from_checkpoint(clf, data, tmp_path):
+    """The retrain hand-off: load() registers from a checkpoint dir,
+    then swaps on subsequent loads of the same name."""
+    X, y = data
+    p1 = str(tmp_path / "v1")
+    clf.save(p1)
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.load("m", p1)
+    assert reg.version("m") == 1
+    np.testing.assert_allclose(
+        reg.executor("m").predict_proba(X[:5]),
+        clf.predict_proba(X[:5]), rtol=1e-6,
+    )
+    clf2 = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=5,
+    ).fit(X, y)
+    p2 = str(tmp_path / "v2")
+    clf2.save(p2)
+    reg.load("m", p2)
+    assert reg.version("m") == 2
+    np.testing.assert_allclose(
+        reg.executor("m").predict_proba(X[:5]),
+        clf2.predict_proba(X[:5]), rtol=1e-6,
+    )

@@ -1,0 +1,54 @@
+"""Tier-1 smoke of the serving benchmark [ISSUE 2 acceptance]: the CPU
+run must show micro-batched serving >= 3x the throughput of naive
+per-request predict at concurrency 16, with ZERO post-warmup recompiles
+(the amortization story the serving subsystem exists for), and must
+write well-formed BENCH_serving.json + telemetry.jsonl artifacts."""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def test_serving_latency_smoke(tmp_path):
+    out = str(tmp_path / "BENCH_serving.json")
+    tel = str(tmp_path / "telemetry.jsonl")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "serving_latency.py"),
+            "--smoke", "--concurrency", "16",
+            "--out", out, "--telemetry", tel,
+        ],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"benchmark failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    result = json.loads(open(out).read())
+    assert result["backend"] == "cpu"
+    assert result["compiles_post_warmup"] == 0, (
+        "steady-state bucketed traffic must not recompile"
+    )
+    (level,) = result["levels"]
+    assert level["concurrency"] == 16
+    assert level["speedup_rps"] >= 3.0, (
+        f"micro-batched serving should be >= 3x naive at concurrency "
+        f"16, got {level['speedup_rps']}x "
+        f"(naive {level['naive']}, served {level['served']})"
+    )
+    # the telemetry artifact is a parseable JSONL run with the serving
+    # series present in its final metrics snapshot
+    from spark_bagging_tpu.telemetry import (
+        last_metrics_snapshot, read_events,
+    )
+
+    events = read_events(tel)
+    snap = last_metrics_snapshot(events)
+    names = {m["name"] for m in snap}
+    assert "sbt_serving_requests_total" in names
+    assert "sbt_serving_latency_seconds" in names

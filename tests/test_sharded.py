@@ -11,7 +11,14 @@ from sklearn.preprocessing import StandardScaler
 
 from spark_bagging_tpu import BaggingClassifier, BaggingRegressor
 from spark_bagging_tpu.parallel import make_mesh
+from spark_bagging_tpu.parallel.compat import HAS_SHARD_MAP
 from spark_bagging_tpu.parallel.sharded import pad_rows
+
+pytestmark = pytest.mark.skipif(
+    not HAS_SHARD_MAP,
+    reason="this jax build has no shard_map implementation "
+           "(parallel/compat.py)",
+)
 
 
 @pytest.fixture(scope="module")
