@@ -1,0 +1,56 @@
+"""Static-analysis subsystem (``sbt-lint``): AST lint, jaxpr audit,
+lock-order detection.
+
+Three engines, one goal — catch the JAX/TPU failure modes that survive
+unit tests and only surface under production load:
+
+- :mod:`~spark_bagging_tpu.analysis.lint` + ``analysis/rules/``:
+  source-level rules (host syncs in hot paths, recompile hazards,
+  tracer escapes, donation misuse, PRNG hygiene, unlocked shared
+  state), with per-line suppressions and a CLI
+  (``python -m spark_bagging_tpu.analysis``).
+- :mod:`~spark_bagging_tpu.analysis.jaxpr_audit`: traces the REAL
+  serving closures and asserts no host callbacks, no wide-dtype
+  promotion, bounded baked constants, donation applied.
+- :mod:`~spark_bagging_tpu.analysis.locks`: instrumented locks that
+  record the acquisition graph and flag order cycles and
+  held-across-device-sync hazards (``SBT_LOCK_DEBUG=1``).
+
+This module imports no jax at top level: linting runs anywhere, fast.
+"""
+
+from spark_bagging_tpu.analysis import locks
+from spark_bagging_tpu.analysis.jaxpr_audit import (
+    AuditError,
+    AuditReport,
+    audit_estimator,
+    audit_executor,
+    audit_fn,
+)
+from spark_bagging_tpu.analysis.lint import (
+    RULES,
+    Finding,
+    lint_file,
+    lint_paths,
+    lint_source,
+    load_config,
+    render_json,
+    render_text,
+)
+
+__all__ = [
+    "AuditError",
+    "AuditReport",
+    "audit_estimator",
+    "audit_executor",
+    "audit_fn",
+    "Finding",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "load_config",
+    "locks",
+    "render_json",
+    "render_text",
+]

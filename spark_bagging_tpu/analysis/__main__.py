@@ -1,0 +1,71 @@
+"""CLI: ``python -m spark_bagging_tpu.analysis [paths...]``.
+
+Exit status is the contract — 0 for a clean tree, 1 when findings
+remain — so the command drops straight into CI. With no paths it lints
+what ``[tool.sbt-lint] paths`` in pyproject.toml names (default: the
+package and benchmarks/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from spark_bagging_tpu.analysis.lint import (
+    RULES,
+    _load_rules,
+    lint_paths,
+    load_config,
+    render_json,
+    render_text,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m spark_bagging_tpu.analysis",
+        description="JAX/TPU-aware static analysis (sbt-lint)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: [tool.sbt-lint] "
+                        "paths from pyproject.toml)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--disable", action="append", default=[],
+                   metavar="RULE", help="disable a rule (repeatable)")
+    p.add_argument("--no-config", action="store_true",
+                   help="ignore pyproject.toml [tool.sbt-lint]")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    args = p.parse_args(argv)
+
+    _load_rules()
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name in sorted(RULES):
+            print(f"{name:<{width}}  {RULES[name].doc}")
+        return 0
+
+    cfg = (
+        {"paths": [], "exclude": [], "disable": []}
+        if args.no_config else load_config()
+    )
+    paths = args.paths or cfg["paths"]
+    if not paths:
+        p.error("no paths given and none configured")
+    disabled = set(cfg["disable"]) | set(args.disable)
+    unknown = disabled - set(RULES)
+    if unknown:
+        p.error(f"unknown rule(s) in disable: {sorted(unknown)}")
+
+    try:
+        findings = lint_paths(paths, exclude=cfg["exclude"],
+                              disabled=disabled)
+    except FileNotFoundError as e:
+        p.error(str(e))
+    out = (render_json if args.format == "json" else render_text)(findings)
+    sys.stdout.write(out)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""jaxpr audit — machine-checkable invariants of traced programs.
+
+The AST lint sees source; this engine sees what jax actually builds.
+For the paths that must run at hardware speed (every model's aggregated
+forward, the serving executor's per-bucket compiles) it traces the real
+closure and asserts the invariants that keep it TPU-clean:
+
+- **no host callbacks**: ``pure_callback``/``io_callback``/
+  ``debug_callback`` in a serving path means a host round-trip per
+  launch — the exact per-item sync the streaming-bootstrap design
+  exists to avoid;
+- **no f64 promotion**: TPUs emulate f64 at ~1/10 speed (and x64 mode
+  doubles every buffer); a stray Python float in the wrong place
+  promotes a whole forward;
+- **bounded baked constants**: a closure that captures big arrays bakes
+  them into EVERY bucket's executable — params must flow in as
+  arguments (one HBM copy), not consts (one copy per compiled shape);
+- **donation applied**: ``donate_argnums`` asked-for must survive into
+  the lowered program (visible as input-output aliasing), or the
+  serving path silently doubles its scratch memory.
+
+``audit_estimator`` / ``audit_executor`` wrap these for the model zoo
+and the serving subsystem; ``tests/test_analysis.py`` parametrizes them
+over every estimator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+__all__ = [
+    "AuditError",
+    "AuditReport",
+    "audit_fn",
+    "audit_estimator",
+    "audit_executor",
+]
+
+# primitives that re-enter the host per launch
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call",
+}
+
+# generous by default: an aggregated forward's consts should be scalars
+# and small index vectors, never the ensemble itself
+DEFAULT_MAX_CONST_BYTES = 1 << 20  # 1 MiB
+DEFAULT_MAX_CONSTS = 64
+
+
+class AuditError(AssertionError):
+    """An audited program violates a TPU-cleanliness invariant."""
+
+
+@dataclass
+class AuditReport:
+    """What the audit saw; ``ok`` iff ``problems`` is empty."""
+
+    name: str
+    n_eqns: int = 0
+    primitives: set[str] = field(default_factory=set)
+    const_count: int = 0
+    const_bytes: int = 0
+    wide_dtypes: set[str] = field(default_factory=set)
+    donation_checked: bool = False
+    donation_applied: bool = False
+    # donation requested but no output shares any donated leaf's
+    # (shape, dtype) — XLA has nothing to alias into, so the request
+    # is a no-op by construction, not a bug
+    donation_inapplicable: bool = False
+    problems: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    def raise_if_bad(self) -> "AuditReport":
+        if self.problems:
+            raise AuditError(
+                f"audit of {self.name} failed:\n  - "
+                + "\n  - ".join(self.problems)
+            )
+        return self
+
+
+def _walk_jaxprs(jaxpr) -> Iterable[Any]:
+    """The jaxpr and every sub-jaxpr nested in eqn params (scan/cond/
+    while bodies, custom_jvp branches, ...). Duck-typed on
+    ``.eqns``/``.jaxpr`` so no private jax module paths are needed."""
+    stack = [jaxpr]
+    seen: set[int] = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield j
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                stack.extend(_extract_jaxprs(v))
+
+
+def _extract_jaxprs(value) -> list[Any]:
+    out = []
+    vals = value if isinstance(value, (list, tuple)) else [value]
+    for v in vals:
+        # ClosedJaxpr has .jaxpr, raw Jaxpr has .eqns
+        if hasattr(v, "jaxpr"):
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns"):
+            out.append(v)
+    return out
+
+
+def _dtype_of(var) -> str | None:
+    aval = getattr(var, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return str(dt) if dt is not None else None
+
+
+_WIDE = {"float64", "complex128", "int64", "uint64"}
+
+
+def audit_fn(
+    fn: Callable,
+    *example_args: Any,
+    name: str = "<fn>",
+    allow_callbacks: bool = False,
+    allow_wide_dtypes: bool = False,
+    max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
+    max_consts: int = DEFAULT_MAX_CONSTS,
+    donate_argnums: tuple[int, ...] | None = None,
+) -> AuditReport:
+    """Trace ``fn(*example_args)`` and audit the jaxpr.
+
+    ``donate_argnums`` additionally lowers the jitted function and
+    verifies the donation survives into the program (input-output
+    aliasing present in the lowered text) — the check that catches
+    donation silently dropped by a wrapper along the way. Wide-dtype
+    findings are suppressed for inputs that are ALREADY wide (auditing
+    an f64 pipeline is the caller's explicit choice).
+    """
+    import numpy as np
+
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    report = AuditReport(name=name)
+
+    # -- constants baked into the closure ------------------------------
+    report.const_count = len(closed.consts)
+    for c in closed.consts:
+        try:
+            report.const_bytes += int(np.asarray(c).nbytes)
+        except Exception:  # noqa: BLE001 — opaque consts count as zero
+            pass
+    if report.const_count > max_consts:
+        report.problems.append(
+            f"{report.const_count} baked-in constants (max {max_consts});"
+            " pass big arrays as arguments, not closure captures"
+        )
+    if report.const_bytes > max_const_bytes:
+        report.problems.append(
+            f"{report.const_bytes} bytes of baked-in constants (max "
+            f"{max_const_bytes}); each compiled shape would carry its "
+            "own copy"
+        )
+
+    # -- walk every (nested) jaxpr -------------------------------------
+    input_wide = {
+        d for v in closed.jaxpr.invars
+        if (d := _dtype_of(v)) in _WIDE
+    }
+    for j in _walk_jaxprs(closed.jaxpr):
+        for eqn in j.eqns:
+            report.n_eqns += 1
+            prim = str(eqn.primitive)
+            report.primitives.add(prim)
+            if prim in _CALLBACK_PRIMS and not allow_callbacks:
+                report.problems.append(
+                    f"host callback `{prim}` in the traced program: "
+                    "one host round-trip per launch"
+                )
+            for var in eqn.outvars:
+                dt = _dtype_of(var)
+                if dt in _WIDE and dt not in input_wide:
+                    report.wide_dtypes.add(dt)
+    if report.wide_dtypes and not allow_wide_dtypes:
+        report.problems.append(
+            f"wide dtypes promoted inside the program: "
+            f"{sorted(report.wide_dtypes)} (inputs were not wide); "
+            "TPUs emulate f64 an order of magnitude slower"
+        )
+
+    # -- donation survives lowering ------------------------------------
+    if donate_argnums is not None:
+        report.donation_checked = True
+        import warnings
+
+        with warnings.catch_warnings():
+            # the "donated buffers were not usable" warning is exactly
+            # the condition we classify below — keep it out of stderr
+            warnings.simplefilter("ignore")
+            lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(
+                *example_args
+            )
+        txt = lowered.as_text()
+        report.donation_applied = (
+            "tf.aliasing_output" in txt or "input_output_alias" in txt
+        )
+        if not report.donation_applied:
+            # XLA only aliases a donated buffer into an output of the
+            # same shape+dtype; if none exists the request is inert by
+            # construction (e.g. serving donates X (n, F) while the
+            # output is (n, C) probabilities) — report it, don't fail
+            out_leaves = [
+                (tuple(l.shape), str(l.dtype))
+                for l in jax.tree.leaves(jax.eval_shape(fn, *example_args))
+            ]
+            donated_leaves = [
+                (tuple(np.shape(l)), str(np.asarray(l).dtype))
+                for i in donate_argnums
+                for l in jax.tree.leaves(example_args[i])
+            ]
+            if any(d in out_leaves for d in donated_leaves):
+                report.problems.append(
+                    f"donate_argnums={donate_argnums} did not survive "
+                    "lowering (no input-output alias in the program, "
+                    "though a shape/dtype-compatible output exists)"
+                )
+            else:
+                report.donation_inapplicable = True
+    return report
+
+
+def audit_estimator(
+    est: Any,
+    *,
+    n_rows: int = 8,
+    check_donation: bool = True,
+    **kw: Any,
+) -> AuditReport:
+    """Audit a fitted estimator's serving seam — the exact
+    ``aggregated_forward`` closure the executor compiles per bucket.
+    Raises :class:`AuditError` on violation; returns the report."""
+    import jax.numpy as jnp
+
+    fn, params, subspaces = est.aggregated_forward()
+    X = jnp.zeros((n_rows, int(est.n_features_in_)), jnp.float32)
+    report = audit_fn(
+        fn, params, subspaces, X,
+        name=f"{type(est).__name__}.aggregated_forward",
+        donate_argnums=(2,) if check_donation else None,
+        **kw,
+    )
+    return report.raise_if_bad()
+
+
+def audit_executor(ex: Any, *, n_rows: int | None = None,
+                   **kw: Any) -> AuditReport:
+    """Audit a serving :class:`EnsembleExecutor`'s forward at one
+    bucket shape (default: its smallest bucket) — the program online
+    traffic actually runs."""
+    import jax.numpy as jnp
+
+    rows = int(n_rows if n_rows is not None else ex.min_bucket_rows)
+    X = jnp.zeros((rows, ex.n_features), jnp.float32)
+    report = audit_fn(
+        ex._fn, ex._params, ex._subspaces, X,
+        name=f"EnsembleExecutor[{type(ex.model).__name__}]@{rows}",
+        donate_argnums=(2,) if ex._donate else None,
+        **kw,
+    )
+    return report.raise_if_bad()

@@ -1,0 +1,16 @@
+"""Rule modules — importing this package registers every rule with the
+engine's registry (``analysis.lint.RULES``). To add a rule: write a
+generator decorated with ``@rule("my-rule-name")`` in the thematic
+module (or a new one), import the module here, and add a good/bad
+fixture pair to ``tests/test_analysis.py`` — the fixture test is what
+keeps the rule honest.
+"""
+
+from spark_bagging_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    host_sync,
+    prng,
+    recompile,
+    threads,
+    tracer,
+)

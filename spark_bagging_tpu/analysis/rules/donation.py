@@ -1,0 +1,152 @@
+"""Buffer-donation misuse.
+
+``donate_argnums`` is how the streaming engines keep the whole ensemble
+resident in HBM (the donated ``(params, opt_state)`` carry), and how
+serving reuses the padded request buffer. The failure mode is reading a
+donated argument AFTER the call: the buffer was handed to XLA, and the
+read returns a deleted-array error on accelerators — but silently works
+on CPU, where donation is a no-op. That asymmetry makes it exactly the
+kind of bug that passes CPU CI and dies on the TPU; the rule tracks the
+``f = jax.jit(g, donate_argnums=...)`` idiom and flags later reads of
+arguments passed at donated positions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from spark_bagging_tpu.analysis.lint import (
+    Finding,
+    LintContext,
+    _is_jit_callable,
+    rule,
+    walk_skip_defs,
+)
+
+
+def _donated_positions(call: ast.Call) -> list[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            return [
+                e.value for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+    return []
+
+
+def _assigned_names(stmt: ast.AST) -> set[str]:
+    """Names this statement (re)binds — in ITS scope only."""
+    out: set[str] = set()
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        out |= {n.id for n in ast.walk(t) if isinstance(n, ast.Name)}
+    return out
+
+
+@rule("donated-arg-reuse")
+def donated_arg_reuse(ctx: LintContext) -> Iterator[Finding]:
+    """Variable passed at a donated position read again after the call
+    — its buffer belongs to XLA now (deleted-array error on TPU/GPU,
+    silently fine on CPU)."""
+    scopes: list[ast.AST] = [ctx.tree]
+    scopes += [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        # jitted-wrapper names -> donated positions, bound in this scope
+        donating: dict[str, list[int]] = {}
+        for node in walk_skip_defs(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and _is_jit_callable(v.func)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                pos = _donated_positions(v)
+                if pos:
+                    donating[node.targets[0].id] = pos
+        if not donating:
+            continue
+        poisoned: dict[str, str] = {}  # var -> wrapper that ate it
+        yield from _scan_block(ctx, getattr(scope, "body", []),
+                               donating, poisoned)
+
+
+def _scan_block(
+    ctx: LintContext,
+    body: list[ast.stmt],
+    donating: dict[str, list[int]],
+    poisoned: dict[str, str],
+) -> Iterator[Finding]:
+    """Walk statements in execution order, tracking which names hold a
+    donated (dead) buffer. Compound statements recurse so a rebind
+    inside a loop body clears the poison before the next read."""
+    for stmt in body:
+        header_only = isinstance(
+            stmt, (ast.For, ast.AsyncFor, ast.While, ast.If,
+                   ast.With, ast.AsyncWith, ast.Try),
+        )
+        # expression parts of this statement (header exprs for compound
+        # statements; the whole statement otherwise), same scope only
+        if header_only:
+            exprs: list[ast.AST] = []
+            for field in ("iter", "test", "items"):
+                v = getattr(stmt, field, None)
+                if isinstance(v, list):
+                    exprs += [i.context_expr for i in v]
+                elif v is not None:
+                    exprs.append(v)
+        else:
+            exprs = [stmt]
+        nodes: list[ast.AST] = []
+        for e in exprs:
+            nodes.append(e)
+            nodes.extend(walk_skip_defs(e))
+        rebound = _assigned_names(stmt)
+        for n in nodes:
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in poisoned
+            ):
+                yield ctx.finding(
+                    "donated-arg-reuse", n,
+                    f"`{n.id}` was passed at a donated position of "
+                    f"`{poisoned[n.id]}` above: its buffer is gone on "
+                    "accelerator backends; rebind the result or drop "
+                    "the donation",
+                )
+        for name in rebound:
+            poisoned.pop(name, None)
+        for n in nodes:
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in donating
+            ):
+                for i in donating[n.func.id]:
+                    if i < len(n.args) and isinstance(n.args[i], ast.Name):
+                        arg = n.args[i].id
+                        if arg not in rebound:
+                            poisoned[arg] = n.func.id
+        if header_only:
+            for sub in (
+                getattr(stmt, "body", []),
+                getattr(stmt, "orelse", []),
+                getattr(stmt, "finalbody", []),
+                *[h.body for h in getattr(stmt, "handlers", []) or []],
+            ):
+                yield from _scan_block(ctx, sub, donating, poisoned)

@@ -1,0 +1,108 @@
+"""Host-device synchronization rules.
+
+The throughput story of scalable bagging (Kleiner et al.'s BLB, the
+streaming Poisson bootstrap) rests on the hot loop never round-tripping
+to the host per item: one blocking pull (``.item()``, ``np.asarray`` of
+a device array, ``block_until_ready``) inside a per-chunk or per-request
+path serializes the dispatch pipeline and caps throughput at host
+latency. Two lexical contexts are load-bearing enough to lint:
+
+- inside a jit-compiled function these calls are at best a trace-time
+  constant bake and at worst a ``TracerArrayConversionError`` at 2am;
+- inside a ``telemetry.span``/``phase`` block — the marker this repo
+  puts exactly on its hot phases — they silently turn a pipelined
+  dispatch into a synchronous one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from spark_bagging_tpu.analysis.lint import (
+    Finding,
+    LintContext,
+    dotted_name,
+    rule,
+    walk_skip_defs,
+)
+
+# device->host pulls / full-queue drains by dotted callable name
+_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "jax.block_until_ready",
+}
+# method names whose call on ANY receiver forces a sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# builtins that coerce a traced array to a Python scalar
+_SCALAR_BUILTINS = {"float", "int", "bool"}
+
+
+def _sync_call(node: ast.AST, *, scalar_builtins: bool = True) -> str | None:
+    """Name of the host-sync this Call performs, or None.
+
+    ``scalar_builtins=False`` skips ``float()/int()/bool()`` — outside a
+    trace they only sync when fed a device array, and the common span
+    pattern (``int(X.shape[0])``) is pure host shape math.
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name in _SYNC_CALLS:
+        return name
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+        return f".{node.func.attr}()"
+    if (
+        scalar_builtins
+        and name in _SCALAR_BUILTINS
+        and len(node.args) == 1
+        and not isinstance(node.args[0], ast.Constant)
+    ):
+        return f"{name}()"
+    return None
+
+
+@rule("host-sync-in-jit")
+def host_sync_in_jit(ctx: LintContext) -> Iterator[Finding]:
+    """Host-sync call (``.item()``/``np.asarray``/``float()``/...)
+    inside a jit-compiled function — a trace error or a baked constant,
+    never a per-call value."""
+    for fn in ctx.jitted_functions():
+        for node in ast.walk(fn):
+            what = _sync_call(node)
+            if what:
+                yield ctx.finding(
+                    "host-sync-in-jit", node,
+                    f"{what} inside jit-compiled `{fn.name}`: under "
+                    "trace this either fails or bakes a constant; "
+                    "compute on-device or move it outside the jit",
+                )
+
+
+def _is_span_with(item: ast.withitem) -> bool:
+    if not isinstance(item.context_expr, ast.Call):
+        return False
+    name = dotted_name(item.context_expr.func)
+    return bool(name) and name.split(".")[-1] in ("span", "phase")
+
+
+@rule("host-sync-in-span")
+def host_sync_in_span(ctx: LintContext) -> Iterator[Finding]:
+    """Blocking device pull inside a ``telemetry.span``/``phase`` block
+    (the hot-path marker) — the span's phase becomes host-latency-bound."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(_is_span_with(i) for i in node.items):
+            continue
+        for stmt in node.body:
+            for sub in [stmt, *walk_skip_defs(stmt)]:
+                what = _sync_call(sub, scalar_builtins=False)
+                if what:
+                    yield ctx.finding(
+                        "host-sync-in-span", sub,
+                        f"{what} inside a telemetry span: this phase is "
+                        "instrumented as hot, and the call blocks the "
+                        "dispatch pipeline; pull results after the span "
+                        "or justify with a suppression",
+                    )

@@ -1,0 +1,182 @@
+"""Recompilation-hazard rules.
+
+XLA compiles are seconds; forward passes are microseconds. A recompile
+that sneaks into steady state (jit rebuilt per loop iteration, an array
+marked static, a Python value captured per iteration) silently costs
+10^5x per hit and shows up only as mysterious tail latency under load —
+the serving subsystem counts them (``sbt_serving_compiles_total``) but
+counting is postmortem; these rules catch the patterns at review time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from spark_bagging_tpu.analysis.lint import (
+    Finding,
+    LintContext,
+    _is_jit_callable,
+    dotted_name,
+    rule,
+    walk_skip_defs,
+)
+
+# parameter names that are overwhelmingly arrays in this codebase; a
+# static_argnums pointing at one re-specializes (and re-compiles) per
+# distinct VALUE, which for arrays means per call
+_ARRAYISH = {
+    "x", "y", "xs", "ys", "params", "state", "weights", "w", "data",
+    "batch", "arr", "inputs", "grads", "opt_state", "key", "keys",
+}
+
+
+@rule("jit-in-loop")
+def jit_in_loop(ctx: LintContext) -> Iterator[Finding]:
+    """``jax.jit`` applied inside a loop body (call or decorated def)
+    — each iteration builds a fresh wrapper with an empty cache:
+    compile-per-iteration."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for stmt in node.body + node.orelse:
+            for sub in [stmt, *walk_skip_defs(stmt)]:
+                if (
+                    isinstance(sub, ast.Call)
+                    and _is_jit_callable(sub.func)
+                    and sub.args
+                ):
+                    yield ctx.finding(
+                        "jit-in-loop", sub,
+                        "jax.jit called inside a loop: every iteration "
+                        "makes a new wrapper (fresh compile cache); "
+                        "hoist the jit outside the loop",
+                    )
+            # decorated defs nested anywhere under the loop, including
+            # inside other defs the loop body creates
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.FunctionDef) and any(
+                    _is_jit_callable(d) for d in sub.decorator_list
+                ):
+                    # anchor on the decorator so a suppression comment
+                    # directly above `@jax.jit` covers the finding
+                    yield ctx.finding(
+                        "jit-in-loop", sub.decorator_list[0],
+                        f"`@jit` function `{sub.name}` defined inside a "
+                        "loop: each iteration gets a fresh wrapper and "
+                        "compile cache; hoist the definition or justify "
+                        "with a suppression",
+                    )
+
+
+def _static_positions(call: ast.Call) -> tuple[list[int], list[str]]:
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.append(e.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.append(e.value)
+    return nums, names
+
+
+@rule("static-argnums-array")
+def static_argnums_array(ctx: LintContext) -> Iterator[Finding]:
+    """``static_argnums``/``static_argnames`` pointing at an array-like
+    parameter — jit re-traces per distinct value, i.e. per call."""
+    # function defs by name, for resolving jax.jit(f, static_argnums=...)
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+
+    def check(call: ast.Call, target: ast.FunctionDef | None):
+        nums, names = _static_positions(call)
+        if target is not None:
+            pos = [a.arg for a in target.args.args]
+            for i in nums:
+                if 0 <= i < len(pos) and pos[i] in _ARRAYISH:
+                    names.append(pos[i])
+        for name in names:
+            if name in _ARRAYISH:
+                yield ctx.finding(
+                    "static-argnums-array", call,
+                    f"parameter `{name}` marked static but looks like "
+                    "an array: static args are hashed by VALUE, so "
+                    "every distinct array recompiles; pass it traced",
+                )
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_callable(node.func):
+            target = None
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = defs.get(node.args[0].id)
+            yield from check(node, target)
+        elif isinstance(node, ast.FunctionDef):
+            # @partial(jax.jit, static_argnums=...) / @jax.jit(...)
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) and _is_jit_callable(deco):
+                    yield from check(deco, node)
+
+
+@rule("loop-constant-capture")
+def loop_constant_capture(ctx: LintContext) -> Iterator[Finding]:
+    """A function jitted inside a loop closes over the loop variable —
+    the value bakes in as a constant, so each iteration is a novel
+    program and a fresh compile."""
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        targets: set[str] = {
+            n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+        }
+        if not targets:
+            continue
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.FunctionDef):
+                    continue
+                # jitted either by decorator or by a jax.jit(name) call
+                # somewhere in the loop body
+                jitted = any(
+                    _is_jit_callable(d) for d in sub.decorator_list
+                ) or any(
+                    isinstance(c, ast.Call)
+                    and _is_jit_callable(c.func)
+                    and c.args
+                    and isinstance(c.args[0], ast.Name)
+                    and c.args[0].id == sub.name
+                    for s2 in loop.body
+                    for c in ast.walk(s2)
+                )
+                if not jitted:
+                    continue
+                local = {a.arg for a in sub.args.args}
+                local |= {a.arg for a in sub.args.kwonlyargs}
+                # walk the BODY only: a default-arg expression
+                # (`def f(x, _lvl=level)`) binds the value at def time
+                # — the sanctioned way to capture a loop variable
+                for n in (
+                    x for b in sub.body for x in ast.walk(b)
+                ):
+                    if (
+                        isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.id in targets
+                        and n.id not in local
+                    ):
+                        yield ctx.finding(
+                            "loop-constant-capture", n,
+                            f"jitted `{sub.name}` closes over loop "
+                            f"variable `{n.id}`: its value bakes into "
+                            "the trace, recompiling every iteration; "
+                            "pass it as a traced argument",
+                        )

@@ -1,0 +1,54 @@
+"""Tracer-escape rules.
+
+A jax tracer is only meaningful during its trace; storing one on
+``self`` or in a module global outlives the trace and produces the
+dreaded ``UnexpectedTracerError`` (or worse: a silently stale constant)
+at some unrelated later call site. The escape is purely lexical — an
+assignment targeting state that outlives the function — so it lints
+cleanly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from spark_bagging_tpu.analysis.lint import Finding, LintContext, rule
+
+
+def _attr_targets(stmt: ast.AST) -> Iterator[ast.Attribute]:
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Attribute):
+                yield node
+
+
+@rule("tracer-escape")
+def tracer_escape(ctx: LintContext) -> Iterator[Finding]:
+    """Assignment to ``self.*`` or a ``global`` inside a jit-compiled
+    function — a traced value escaping its trace."""
+    for fn in ctx.jitted_functions():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                yield ctx.finding(
+                    "tracer-escape", node,
+                    f"`global {', '.join(node.names)}` inside "
+                    f"jit-compiled `{fn.name}`: values assigned under "
+                    "trace are tracers and must not outlive it",
+                )
+                continue
+            for attr in _attr_targets(node):
+                base = attr.value
+                if isinstance(base, ast.Name) and base.id == "self":
+                    yield ctx.finding(
+                        "tracer-escape", node,
+                        f"assignment to `self.{attr.attr}` inside "
+                        f"jit-compiled `{fn.name}`: the stored value is "
+                        "a tracer; return it instead and store outside "
+                        "the jit",
+                    )

@@ -35,6 +35,7 @@ from typing import Any, Callable
 import numpy as np
 
 from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.analysis.locks import make_lock
 
 _SHUTDOWN = object()
 
@@ -59,6 +60,7 @@ class _Request:
         self.t_submit = time.perf_counter()
 
 
+# sbt-lint: shared-state
 class MicroBatcher:
     """Coalesce concurrent ``submit()`` calls into bucketed forwards.
 
@@ -113,6 +115,7 @@ class MicroBatcher:
         self._q: Queue = Queue(maxsize=int(max_queue))
         self._stop = threading.Event()
         self._closed = False
+        self._close_lock = make_lock("serving.batcher.close")
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name="serving-batcher"
         )
@@ -185,9 +188,13 @@ class MicroBatcher:
     def close(self, timeout: float = 10.0) -> None:
         """Stop accepting requests, let the in-flight batch finish,
         fail whatever is still queued, join the worker."""
-        if self._closed:
-            return
-        self._closed = True
+        # the flag flip is a check-then-act: two racing close() calls
+        # must not BOTH run the drain loop below (found by the
+        # shared-state-unlocked lint rule when this class was marked)
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         # stop BEFORE the join: the worker's outer get() polls the flag
         # every 100ms, so even with a full queue (sentinel un-enqueueable)
         # it exits after at most the in-flight batch + one poll — the

@@ -22,13 +22,13 @@ when many threads race to first use).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any
 
 import numpy as np
 
 from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.serving.buckets import (
     DEFAULT_MAX_ROWS,
     DEFAULT_MIN_ROWS,
@@ -38,6 +38,7 @@ from spark_bagging_tpu.serving.buckets import (
 )
 
 
+# sbt-lint: shared-state
 class EnsembleExecutor:
     """Serve one fitted bagging estimator with bucketed AOT compiles.
 
@@ -79,7 +80,7 @@ class EnsembleExecutor:
         self._subspaces = subspaces
         self._donate = bool(donate_input)
         self._compiled: dict[int, Any] = {}
-        self._build_lock = threading.Lock()
+        self._build_lock = make_lock("serving.executor.build")
 
     # -- compile management --------------------------------------------
 
@@ -172,7 +173,8 @@ class EnsembleExecutor:
         Xp = pad_to_bucket(X, bucket)
         with telemetry.span("serving_forward", bucket=bucket, rows=n):
             out = compiled(self._params, self._subspaces, Xp)
-            out = np.asarray(out)  # device->host barrier
+            # sbt-lint: disable=host-sync-in-span — the served result must reach the host here; the span times the true forward latency
+            out = np.asarray(out)
         return out[:n]
 
     # -- sklearn-flavored conveniences ---------------------------------

@@ -27,12 +27,12 @@ swap instant. The registry owns that lifecycle:
 
 from __future__ import annotations
 
-import threading
 from typing import Any
 
 import numpy as np
 
 from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.serving.executor import EnsembleExecutor
 
 
@@ -47,11 +47,12 @@ class _Entry:
         self.opts = opts
 
 
+# sbt-lint: shared-state
 class ModelRegistry:
     """Named, versioned serving models. All methods are thread-safe."""
 
     def __init__(self, **default_executor_opts: Any):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.registry")
         self._entries: dict[str, _Entry] = {}
         self._default_opts = default_executor_opts
 

@@ -475,6 +475,7 @@ def fit_ensemble_stream(
                     # transfers only its shards — the broadcast-data
                     # design [B:5])
                     Xd = jax.device_put(Xc, x_sharding)
+                    # sbt-lint: disable=host-sync-in-span — dtype cast of a host numpy chunk, not a device pull
                     yd = jax.device_put(np.asarray(yc, y_dtype), y_sharding)
                     auxd = (
                         jax.device_put(auxc, y_sharding) if use_aux

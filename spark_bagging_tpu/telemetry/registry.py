@@ -20,8 +20,9 @@ every call site), not here.
 from __future__ import annotations
 
 import math
-import threading
 from typing import Any, Iterable
+
+from spark_bagging_tpu.analysis.locks import make_lock
 
 # Log-scale histogram bounds: decades from 100 microseconds to 1000
 # seconds cover every latency this stack records (a chunk step is
@@ -94,11 +95,12 @@ class Histogram:
                 return
 
 
+# sbt-lint: shared-state
 class Registry:
     """Thread-safe metric store keyed by ``(name, labels)``."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.registry")
         self._metrics: dict[tuple[str, tuple], Any] = {}
 
     def _get_locked(self, name: str, labels, cls):
@@ -106,6 +108,7 @@ class Registry:
         key = (name, _label_key(labels))
         m = self._metrics.get(key)
         if m is None:
+            # sbt-lint: disable=shared-state-unlocked — every caller holds self._lock (enforced by the _locked naming convention)
             m = self._metrics[key] = cls()
         elif not isinstance(m, cls):
             raise TypeError(

@@ -46,6 +46,12 @@ def _device_barrier() -> None:
     import jax
     import jax.numpy as jnp
 
+    from spark_bagging_tpu.analysis import locks
+
+    # a sync span entered while holding an instrumented lock would park
+    # every waiter behind the device queue — record the hazard when
+    # lock debugging is on (free otherwise: one module-flag read)
+    locks.note_device_sync("telemetry span device barrier")
     jax.block_until_ready(jnp.zeros(()))
 
 

@@ -211,6 +211,7 @@ def fit_tree_ensemble_stream(
                 telemetry.inc("sbt_stream_chunks_total",
                               labels={"engine": "tree"})
                 if first_step_seconds is None:
+                    # sbt-lint: disable=host-sync-in-span — one-time compile-cost probe on the first chunk only, not steady state
                     jax.block_until_ready(e)
                     first_step_seconds = time.perf_counter() - t0
         if n_chunks == 0:
@@ -293,10 +294,12 @@ def fit_tree_ensemble_stream(
                                 metric="sbt_chunk_seconds", chunk=c):
                 if mesh is not None:
                     Xd = global_put(
+                        # sbt-lint: disable=host-sync-in-span — dtype cast of a host numpy chunk, not a device pull
                         np.asarray(Xc, np.float32), mesh,
                         P(DATA_AXIS, None)
                     )
                     yd = global_put(
+                        # sbt-lint: disable=host-sync-in-span — dtype cast of a host numpy chunk, not a device pull
                         np.asarray(yc, y_dtype), mesh, P(DATA_AXIS)
                     )
                 else:
@@ -372,6 +375,7 @@ def fit_tree_ensemble_stream(
 
         k_split = learner._n_split_features(n_subspace)
 
+        # sbt-lint: disable=jit-in-loop — one program per tree level by design (level-synchronous growth); bounded by max_depth, compiled once per fit
         @jax.jit
         def select(hist, _level=level, _N=N):
             def one(h, idx, rid):

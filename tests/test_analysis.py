@@ -1,0 +1,836 @@
+"""Static-analysis subsystem tests [ISSUE 4]: per-rule good/bad fixture
+pairs, suppression handling, CLI exit codes, the jaxpr audit over the
+model zoo + serving path, the lock-order detector, and — the
+self-hosting gate — a clean lint of the repo's own tree, enforced here
+so tier-1 keeps it clean.
+
+Fixture convention: every rule gets a known-BAD snippet it must flag
+and a known-GOOD twin it must stay silent on; a rule without that pair
+is not trusted. The good twin is always the sanctioned fix for the bad
+pattern (split the key, hoist the jit, rebind the donated carry, take
+the lock), so the fixtures double as documentation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu.analysis import (
+    AuditError,
+    audit_estimator,
+    audit_executor,
+    audit_fn,
+    lint_paths,
+    lint_source,
+    load_config,
+    locks,
+)
+from spark_bagging_tpu.analysis.__main__ import main as lint_main
+from spark_bagging_tpu.analysis.lint import RULES, _load_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def hits(src: str, rule: str) -> list:
+    """Findings of ONE rule for a source snippet."""
+    return [f for f in lint_source(src, enabled={rule})]
+
+
+# -- rule fixtures: bad must fire, good twin must not ------------------
+
+BAD_GOOD = {
+    "host-sync-in-jit": (
+        """
+import jax
+@jax.jit
+def step(x):
+    return float(x.sum())
+""",
+        """
+import jax
+@jax.jit
+def step(x):
+    return x.sum()
+
+def outside(x):
+    return float(step(x))
+""",
+    ),
+    "host-sync-in-span": (
+        """
+import numpy as np
+from spark_bagging_tpu import telemetry
+
+def serve(compiled, X):
+    with telemetry.span("forward"):
+        out = compiled(X)
+        host = np.asarray(out)
+    return host
+""",
+        """
+import numpy as np
+from spark_bagging_tpu import telemetry
+
+def serve(compiled, X):
+    with telemetry.span("forward"):
+        out = compiled(X)
+    return np.asarray(out)
+""",
+    ),
+    "jit-in-loop": (
+        """
+import jax
+
+def fit_all(fns, x):
+    outs = []
+    for fn in fns:
+        outs.append(jax.jit(fn)(x))
+    return outs
+""",
+        """
+import jax
+
+def fit_all(fns, x):
+    jitted = [jax.jit(fn) for fn in fns]
+    outs = []
+    for fn in jitted:
+        outs.append(fn(x))
+    return outs
+""",
+    ),
+    "static-argnums-array": (
+        """
+import jax
+
+def loss(params, n):
+    return params.sum() + n
+
+f = jax.jit(loss, static_argnums=(0,))
+""",
+        """
+import jax
+
+def loss(params, n):
+    return params.sum() + n
+
+f = jax.jit(loss, static_argnums=(1,))
+""",
+    ),
+    "loop-constant-capture": (
+        """
+import jax
+
+def grow(levels, h):
+    for level in levels:
+        @jax.jit
+        def select(hist):
+            return hist[level]
+        h = select(h)
+    return h
+""",
+        """
+import jax
+
+def grow(levels, h):
+    for level in levels:
+        @jax.jit
+        def select(hist, _level=level):
+            return hist[_level]
+        h = select(h)
+    return h
+""",
+    ),
+    "tracer-escape": (
+        """
+import jax
+
+class Model:
+    def fit(self, x):
+        @jax.jit
+        def step(x):
+            self.last = x.sum()
+            return x
+        return step(x)
+""",
+        """
+import jax
+
+class Model:
+    def fit(self, x):
+        @jax.jit
+        def step(x):
+            return x, x.sum()
+        x, last = step(x)
+        self.last = last
+        return x
+""",
+    ),
+    "donated-arg-reuse": (
+        """
+import jax
+
+def fit(params, x, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    new = step(params, x)
+    return new, params.mean()
+""",
+        """
+import jax
+
+def fit(params, x, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    params = step(params, x)
+    return params, params.mean()
+""",
+    ),
+    "prng-key-reuse": (
+        """
+import jax
+
+def init(key):
+    w = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return w, b
+""",
+        """
+import jax
+
+def init(key):
+    kw, kb = jax.random.split(key)
+    w = jax.random.normal(kw, (4,))
+    b = jax.random.uniform(kb, (4,))
+    return w, b
+""",
+    ),
+    "prng-nondeterministic-seed": (
+        """
+import time
+import jax
+
+def make_key():
+    return jax.random.PRNGKey(int(time.time()))
+""",
+        """
+import jax
+
+def make_key(seed: int):
+    return jax.random.PRNGKey(seed)
+""",
+    ),
+    "shared-state-unlocked": (
+        """
+import threading
+
+# sbt-lint: shared-state
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, k, v):
+        self._items[k] = v
+""",
+        """
+import threading
+
+# sbt-lint: shared-state
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_GOOD))
+def test_rule_fires_on_bad_fixture(rule):
+    bad, _ = BAD_GOOD[rule]
+    found = hits(bad, rule)
+    assert found, f"{rule} missed its known-bad fixture"
+    assert all(f.rule == rule for f in found)
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_GOOD))
+def test_rule_silent_on_good_twin(rule):
+    _, good = BAD_GOOD[rule]
+    found = hits(good, rule)
+    assert not found, f"{rule} false-positived on its good twin: {found}"
+
+
+def test_every_registered_rule_has_fixtures():
+    _load_rules()
+    assert set(RULES) == set(BAD_GOOD), (
+        "every rule ships with a bad/good fixture pair; update "
+        "BAD_GOOD when adding rules"
+    )
+
+
+# -- targeted rule behaviors -------------------------------------------
+
+def test_prng_branch_exclusive_use_is_clean():
+    # the ops/bootstrap.py pattern: one key, consumed in mutually
+    # exclusive if-arms — at most one draw per call, not reuse
+    src = """
+import jax
+
+def draw(key, replacement):
+    k = jax.random.fold_in(key, 7)
+    if replacement:
+        return jax.random.poisson(k, 1.0, (8,))
+    return jax.random.uniform(k, (8,))
+"""
+    assert not hits(src, "prng-key-reuse")
+
+
+def test_prng_loop_reuse_is_flagged():
+    src = """
+import jax
+
+def noise(key, n):
+    outs = []
+    for i in range(n):
+        outs.append(jax.random.normal(key, (4,)))
+    return outs
+"""
+    assert hits(src, "prng-key-reuse")
+
+
+def test_prng_loop_rederive_is_clean():
+    src = """
+import jax
+
+def noise(key, n):
+    outs = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        outs.append(jax.random.normal(k, (4,)))
+    return outs
+"""
+    assert not hits(src, "prng-key-reuse")
+
+
+def test_donated_carry_rebind_in_loop_is_clean():
+    # the streaming engine's shape: donated carry rebound by the call
+    src = """
+import jax
+
+def fit(params, opt_state, chunks, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    for c in chunks:
+        params, opt_state = step(params, opt_state, c)
+    return params, opt_state
+"""
+    assert not hits(src, "donated-arg-reuse")
+
+
+def test_jit_decorated_def_in_loop_is_flagged():
+    src = """
+import jax
+
+def grow(levels, h):
+    for level in levels:
+        @jax.jit
+        def select(hist, _level=level):
+            return hist[_level]
+        h = select(h)
+    return h
+"""
+    assert hits(src, "jit-in-loop")
+
+
+def test_host_sync_scalar_builtins_only_flagged_under_jit():
+    # int(X.shape[0]) inside a span is host shape math, not a sync
+    src = """
+from spark_bagging_tpu import telemetry
+
+def report(X):
+    with telemetry.span("aggregate"):
+        n = int(X.shape[0])
+    return n
+"""
+    assert not hits(src, "host-sync-in-span")
+
+
+# -- suppressions ------------------------------------------------------
+
+BAD_PRNG = BAD_GOOD["prng-key-reuse"][0]
+
+
+def test_same_line_suppression():
+    src = BAD_PRNG.replace(
+        "b = jax.random.uniform(key, (4,))",
+        "b = jax.random.uniform(key, (4,))  # sbt-lint: disable=prng-key-reuse",
+    )
+    assert not hits(src, "prng-key-reuse")
+
+
+def test_comment_line_above_suppresses_next_line():
+    src = BAD_PRNG.replace(
+        "    b = jax.random.uniform(key, (4,))",
+        "    # sbt-lint: disable=prng-key-reuse — fixture\n"
+        "    b = jax.random.uniform(key, (4,))",
+    )
+    assert not hits(src, "prng-key-reuse")
+
+
+def test_disable_all_wildcard():
+    src = BAD_PRNG.replace(
+        "b = jax.random.uniform(key, (4,))",
+        "b = jax.random.uniform(key, (4,))  # sbt-lint: disable=all",
+    )
+    assert not lint_source(src)
+
+
+def test_suppression_covers_wrapped_multiline_statement():
+    """A formatter re-wrap must not orphan a suppression: the comment
+    above the STATEMENT covers findings anchored on its later physical
+    lines."""
+    src = """
+import jax
+
+def init(key):
+    w = jax.random.normal(key, (4,))
+    # sbt-lint: disable=prng-key-reuse — fixture
+    b = jax.random.uniform(
+        key,
+        (4,),
+    )
+    return w, b
+"""
+    assert not hits(src, "prng-key-reuse")
+
+
+def test_suppression_is_rule_specific():
+    src = BAD_PRNG.replace(
+        "b = jax.random.uniform(key, (4,))",
+        "b = jax.random.uniform(key, (4,))  # sbt-lint: disable=jit-in-loop",
+    )
+    assert hits(src, "prng-key-reuse")
+
+
+def test_unknown_rule_name_raises():
+    with pytest.raises(KeyError):
+        lint_source("x = 1\n", enabled={"no-such-rule"})
+
+
+def test_syntax_error_is_reported_not_raised():
+    found = lint_source("def broken(:\n")
+    assert [f.rule for f in found] == ["syntax-error"]
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_cli_exit_zero_on_clean_file(tmp_path, capsys):
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    assert lint_main([str(p), "--no-config"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_exit_one_on_findings(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(BAD_PRNG)
+    assert lint_main([str(p), "--no-config"]) == 1
+    out = capsys.readouterr().out
+    assert "prng-key-reuse" in out and "bad.py" in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(BAD_PRNG)
+    assert lint_main([str(p), "--no-config", "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data and data[0]["rule"] == "prng-key-reuse"
+
+
+def test_cli_disable_flag(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(BAD_PRNG)
+    assert lint_main(
+        [str(p), "--no-config", "--disable", "prng-key-reuse"]
+    ) == 0
+    capsys.readouterr()
+
+
+def test_cli_errors_on_missing_path(capsys):
+    # a typo'd path must NOT silently lint nothing and exit 0
+    with pytest.raises(SystemExit) as exc:
+        lint_main(["definitely_not_a_path_xyz", "--no-config"])
+    assert exc.value.code == 2
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in BAD_GOOD:
+        assert rule in out
+
+
+def test_config_section_roundtrip(tmp_path, monkeypatch):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.sbt-lint]\npaths = ['pkg']\nexclude = ['gen']\n"
+        "disable = ['jit-in-loop']\n"
+    )
+    cfg = load_config(str(tmp_path))
+    assert cfg["paths"] == ["pkg"]
+    assert cfg["exclude"] == ["gen"]
+    assert cfg["disable"] == ["jit-in-loop"]
+
+
+def test_config_defaults_without_file(tmp_path):
+    cfg = load_config(str(tmp_path))
+    assert cfg["paths"] == ["spark_bagging_tpu", "benchmarks"]
+
+
+# -- the self-hosting gate ---------------------------------------------
+
+def test_repo_tree_is_lint_clean():
+    """THE tier-1 gate: the package and benchmarks stay lint-clean
+    (zero unsuppressed findings) — the acceptance bar for the whole
+    subsystem. If this fails, either fix the finding or add a
+    justified `# sbt-lint: disable=<rule>` with a reason."""
+    import time
+
+    cfg = load_config(REPO)
+    t0 = time.perf_counter()
+    findings = lint_paths(
+        [os.path.join(REPO, p) for p in cfg["paths"]],
+        exclude=cfg["exclude"], disabled=cfg["disable"],
+    )
+    dt = time.perf_counter() - t0
+    assert not findings, "\n".join(f.render() for f in findings)
+    assert dt < 10.0, f"full-tree lint took {dt:.1f}s (budget 10s)"
+
+
+# -- jaxpr audit -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cls_data():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(48, 6)).astype(np.float32)
+    y = (X[:, 0] - 0.3 * X[:, 1] > 0).astype(np.int64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(48, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=48)).astype(np.float32)
+    return X, y
+
+
+def _zoo():
+    """(name, builder) for every estimator family with a serving seam.
+    Tiny configs: the audit only needs a FITTED estimator to trace, not
+    a good one."""
+    from spark_bagging_tpu import (
+        BaggingClassifier,
+        BaggingRegressor,
+        FMClassifier,
+        GaussianNB,
+        GBTRegressor,
+        GeneralizedLinearRegression,
+        LinearRegression,
+        LinearSVC,
+        LogisticRegression,
+        MLPClassifier,
+        RandomForestClassifier,
+        RandomForestRegressor,
+    )
+
+    def bag_c(learner):
+        return lambda X, y: BaggingClassifier(
+            base_learner=learner, n_estimators=2, seed=0
+        ).fit(X, y)
+
+    def bag_r(learner):
+        return lambda X, y: BaggingRegressor(
+            base_learner=learner, n_estimators=2, seed=0
+        ).fit(X, y)
+
+    return [
+        ("logistic", "cls", bag_c(LogisticRegression(max_iter=3))),
+        ("svc", "cls", bag_c(LinearSVC(max_iter=3))),
+        ("gaussian_nb", "cls", bag_c(GaussianNB())),
+        ("mlp", "cls", bag_c(MLPClassifier(hidden=4, max_iter=3))),
+        ("fm", "cls", bag_c(FMClassifier(factor_size=2, max_iter=3))),
+        ("linear", "reg", bag_r(LinearRegression())),
+        ("glm", "reg", bag_r(GeneralizedLinearRegression(max_iter=3))),
+        ("gbt", "reg", bag_r(GBTRegressor(n_rounds=2, max_depth=2))),
+        ("forest_cls", "cls", lambda X, y: RandomForestClassifier(
+            n_estimators=2, max_depth=2, n_bins=8, seed=0).fit(X, y)),
+        ("forest_reg", "reg", lambda X, y: RandomForestRegressor(
+            n_estimators=2, max_depth=2, n_bins=8, seed=0).fit(X, y)),
+    ]
+
+
+@pytest.mark.parametrize(
+    "name,kind,build", _zoo(), ids=[z[0] for z in _zoo()]
+)
+def test_jaxpr_audit_model_zoo(name, kind, build, cls_data, reg_data):
+    """Acceptance: every zoo member's aggregated forward is TPU-clean —
+    no host callbacks, no wide-dtype promotion, bounded consts, and the
+    donation request is honored or provably inapplicable."""
+    X, y = cls_data if kind == "cls" else reg_data
+    est = build(X, y)
+    report = audit_estimator(est)  # raises AuditError on violation
+    assert report.ok
+    assert report.n_eqns > 0
+    assert report.donation_checked
+    assert report.donation_applied or report.donation_inapplicable
+    assert not report.wide_dtypes
+
+
+def test_jaxpr_audit_serving_executor(cls_data):
+    """The serving path itself — the executor's compiled closure at a
+    real bucket shape — passes the same audit."""
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+    from spark_bagging_tpu.serving import EnsembleExecutor
+
+    X, y = cls_data
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3), n_estimators=2,
+        seed=0,
+    ).fit(X, y)
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=32)
+    report = audit_executor(ex)
+    assert report.ok and report.n_eqns > 0
+
+
+def test_audit_flags_host_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def with_cb(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((4,), jnp.float32), x,
+        )
+
+    report = audit_fn(with_cb, jnp.zeros((4,), jnp.float32),
+                      name="cb-fixture")
+    assert not report.ok
+    assert any("pure_callback" in p for p in report.problems)
+    with pytest.raises(AuditError):
+        report.raise_if_bad()
+
+
+def test_audit_flags_oversized_consts():
+    import jax.numpy as jnp
+
+    baked = jnp.ones((64, 64), jnp.float32)  # 16 KiB closure capture
+
+    def f(x):
+        return x @ baked
+
+    report = audit_fn(f, jnp.zeros((2, 64), jnp.float32),
+                      max_const_bytes=1024, name="const-fixture")
+    assert any("constant" in p for p in report.problems)
+
+
+def test_audit_verifies_carry_donation():
+    import jax.numpy as jnp
+
+    def step(params, x):
+        return params + x.sum()
+
+    report = audit_fn(step, jnp.zeros((8,)), jnp.ones((3, 8)),
+                      donate_argnums=(0,), name="carry-fixture")
+    assert report.donation_checked and report.donation_applied
+    assert report.ok
+
+
+def test_audit_callback_allowance():
+    import jax
+    import jax.numpy as jnp
+
+    def with_cb(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,),
+                                                          jnp.float32), x,
+        )
+
+    report = audit_fn(with_cb, jnp.zeros((4,), jnp.float32),
+                      allow_callbacks=True, name="cb-ok-fixture")
+    assert report.ok
+
+
+# -- lock-order detector -----------------------------------------------
+
+@pytest.fixture()
+def lock_debug():
+    locks.enable(True, strict=False)
+    locks.clear()
+    yield
+    locks.clear()
+    locks.enable(False)
+
+
+def test_lock_cycle_detected(lock_debug):
+    """The canonical repro the detector must catch: two locks taken in
+    opposite orders (here sequentially — no deadlock has to happen for
+    the ORDER violation to be visible)."""
+    a = locks.DebugLock("locks.A")
+    b = locks.DebugLock("locks.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    v = locks.violations()
+    assert any("cycle" in msg for msg in v), v
+
+
+def test_lock_cycle_strict_raises(lock_debug):
+    locks.enable(True, strict=True)
+    a = locks.DebugLock("locks.A2")
+    b = locks.DebugLock("locks.B2")
+    with a:
+        with b:
+            pass
+    with pytest.raises(locks.LockOrderError):
+        with b:
+            with a:
+                pass
+
+
+def test_strict_raise_releases_the_lock(lock_debug):
+    """A strict-mode LockOrderError must leave the lock RELEASED and
+    the held-stack clean — otherwise the failing test suite deadlocks
+    on the next acquire instead of reporting the violation."""
+    locks.enable(True, strict=True)
+    a = locks.DebugLock("locks.A2b")
+    b = locks.DebugLock("locks.B2b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(locks.LockOrderError):
+            a.acquire()
+    assert locks.held_locks() == ()
+    assert a.acquire(timeout=1.0), "lock leaked by the strict raise"
+    a.release()
+
+
+def test_consistent_order_is_clean(lock_debug):
+    a = locks.DebugLock("locks.A3")
+    b = locks.DebugLock("locks.B3")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert not locks.violations()
+
+
+def test_cross_thread_cycle_detected(lock_debug):
+    """The realistic shape: each ORDER comes from a different thread."""
+    a = locks.DebugLock("locks.A4")
+    b = locks.DebugLock("locks.B4")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    with b:
+        with a:
+            pass
+    assert any("cycle" in msg for msg in locks.violations())
+
+
+def test_sync_while_locked_hazard(lock_debug):
+    a = locks.DebugLock("locks.A5")
+    with a:
+        locks.note_device_sync("test barrier")
+    v = locks.violations()
+    assert any("A5" in msg for msg in v), v
+
+
+def test_telemetry_barrier_reports_held_lock(lock_debug):
+    """The adopted integration: the telemetry span device barrier calls
+    note_device_sync, so a sync span under a registry lock is caught."""
+    from spark_bagging_tpu.telemetry.spans import _device_barrier
+
+    a = locks.DebugLock("serving.registry.test")
+    with a:
+        _device_barrier()
+    assert any("serving.registry.test" in m for m in locks.violations())
+
+
+def test_same_name_instance_nesting_is_flagged(lock_debug):
+    """Two registries nested = two locks with ONE graph name: no a->b
+    edge exists, but instances of one class have no defined order —
+    the classic symmetric deadlock. Must be flagged anyway."""
+    a = locks.DebugLock("serving.registry")
+    b = locks.DebugLock("serving.registry")
+    with a:
+        with b:
+            pass
+    assert any("serving.registry" in m and "instances" in m
+               for m in locks.violations())
+
+
+def test_rlock_reentry_is_not_a_cycle(lock_debug):
+    a = locks.DebugLock("locks.R", rlock=True)
+    with a:
+        with a:
+            pass
+    assert not locks.violations()
+
+
+def test_make_lock_plain_when_disabled():
+    locks.enable(False)
+    lk = locks.make_lock("plain")
+    assert isinstance(lk, type(threading.Lock()))
+
+
+def test_make_lock_instrumented_when_enabled(lock_debug):
+    lk = locks.make_lock("serving.test")
+    assert isinstance(lk, locks.DebugLock)
+
+
+def test_adopted_subsystems_use_factory(lock_debug):
+    """Registry/executor/batcher locks come from make_lock, so enabling
+    debug instruments the REAL serving stack."""
+    from spark_bagging_tpu.serving.registry import ModelRegistry
+    from spark_bagging_tpu.telemetry.registry import Registry
+
+    assert isinstance(ModelRegistry()._lock, locks.DebugLock)
+    assert isinstance(Registry()._lock, locks.DebugLock)
+
+
+def test_batcher_double_close_race_fix(cls_data):
+    """close() is guarded by a lock now: N racing closers, one drain."""
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+    from spark_bagging_tpu.serving import EnsembleExecutor, MicroBatcher
+
+    X, y = cls_data
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3), n_estimators=2,
+        seed=0,
+    ).fit(X, y)
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=32)
+    mb = MicroBatcher(ex, max_queue=4)
+    threads = [threading.Thread(target=mb.close) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with pytest.raises(RuntimeError):
+        mb.submit(X[:1])
