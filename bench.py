@@ -284,10 +284,11 @@ def _measure(args) -> dict:
     the steady-state predict path. Returns a JSON-serializable dict.
 
     The whole phase runs under ``telemetry.capture`` writing
-    ``telemetry.jsonl`` next to the BENCH_*.json artifacts: every
-    compile/fit/h2d span and registry counter of the measured run is
-    machine-readable afterwards (render with
-    ``python -m spark_bagging_tpu.telemetry dump telemetry.jsonl``).
+    ``telemetry.jsonl`` into the telemetry dir (``$SBT_TELEMETRY_DIR``,
+    default ``./telemetry/`` — run artifacts stay out of the git
+    tree): every compile/fit/h2d span and registry counter of the
+    measured run is machine-readable afterwards (render with
+    ``python -m spark_bagging_tpu.telemetry dump telemetry/telemetry.jsonl``).
     """
     import jax
 
@@ -301,7 +302,7 @@ def _measure(args) -> dict:
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
     from spark_bagging_tpu import telemetry
 
-    jsonl_path = os.path.join(REPO, "telemetry.jsonl")
+    jsonl_path = telemetry.default_log_path("telemetry.jsonl")
     try:  # fresh log per measured run (capture appends)
         os.unlink(jsonl_path)
     except OSError:

@@ -28,7 +28,8 @@ naive path does not pay); a short instrumented burst afterwards
 produces ``telemetry.jsonl`` with the full ``sbt_serving_*`` panel,
 including the cumulative counters from the measured traffic.
 
-Writes ``BENCH_serving.json`` + ``telemetry.jsonl``.
+Writes ``BENCH_serving.json`` + ``telemetry.jsonl`` (the latter into
+``$SBT_TELEMETRY_DIR``, default ``./telemetry/``).
 
     python benchmarks/serving_latency.py            # full grid
     python benchmarks/serving_latency.py --smoke    # CI-sized, CPU
@@ -164,8 +165,9 @@ def main() -> int:
     ap.add_argument("--max-delay-ms", type=float, default=0.5)
     ap.add_argument("--idle-flush-ms", type=float, default=0.0)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_serving.json"))
-    ap.add_argument("--telemetry",
-                    default=os.path.join(REPO, "telemetry.jsonl"))
+    ap.add_argument("--telemetry", default=None,
+                    help="JSONL path (default: telemetry.jsonl inside "
+                         "$SBT_TELEMETRY_DIR, else ./telemetry/)")
     args = ap.parse_args()
 
     import jax
@@ -249,6 +251,8 @@ def main() -> int:
     # telemetry artifact: a short instrumented burst — the final
     # metrics snapshot carries the CUMULATIVE serving counters from
     # everything above (the registry is process-wide)
+    if args.telemetry is None:
+        args.telemetry = telemetry.default_log_path("telemetry.jsonl")
     if os.path.exists(args.telemetry):
         os.unlink(args.telemetry)
     with telemetry.capture(args.telemetry, label="serving_latency"):

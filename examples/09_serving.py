@@ -8,6 +8,13 @@ quickstart (01_quickstart.py).
 Run anywhere: uses the TPU if one is attached, else CPU.
 
     python examples/09_serving.py
+
+With the live observability plane (scrape it while it runs):
+
+    SBT_METRICS_PORT=9100 python examples/09_serving.py
+    curl :9100/healthz        # batcher liveness + live model version
+    curl :9100/metrics        # Prometheus text, sbt_serving_* series
+    curl :9100/varz           # JSON snapshot incl. latency quantiles
 """
 
 import os
@@ -36,6 +43,10 @@ registry = ModelRegistry(min_bucket_rows=8, max_batch_rows=128)
 registry.register("cancer", clf_v1, warmup=True)
 executor = registry.executor("cancer")
 print(f"warmed buckets  : {executor.compiled_buckets}")
+if (addr := telemetry.server_address()) is not None:
+    host, port = addr
+    print(f"metrics server  : http://{host}:{port}  "
+          "(/metrics /healthz /varz /debug/spans)")
 
 # -- simulated concurrent clients against the micro-batcher -----------
 N_CLIENTS, N_REQUESTS = 8, 40
@@ -75,7 +86,10 @@ with registry.batcher("cancer", max_delay_ms=2.0, max_queue=512) as b:
 
 served = sum(results.values())
 reg = telemetry.registry()
+lat = reg.histogram("sbt_serving_latency_seconds").quantiles()
 print(f"requests served : {served}/{N_CLIENTS * N_REQUESTS}")
+print("latency         : "
+      + "  ".join(f"{k}={v * 1e3:.1f}ms" for k, v in lat.items()))
 print(f"batches         : {int(reg.counter('sbt_serving_batches_total').value)}"
       f"  (coalescing ratio "
       f"{served / max(reg.counter('sbt_serving_batches_total').value, 1):.1f}"

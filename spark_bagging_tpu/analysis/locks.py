@@ -45,6 +45,7 @@ __all__ = [
     "violations",
     "clear",
     "held_locks",
+    "all_held_locks",
 ]
 
 
@@ -62,10 +63,28 @@ class _Held(threading.local):
         # instances, not names: re-entrancy and same-name-different-
         # instance detection both need object identity
         self.stack: list["DebugLock"] = []
+        # register this thread's stack for the cross-thread view
+        # (all_held_locks, read by the flight recorder): the stack is
+        # only MUTATED by its owner thread, readers copy under the
+        # graph lock and tolerate a momentarily stale snapshot
+        with _graph_lock:
+            # prune dead threads here too, not only in all_held_locks()
+            # (which only runs when a flight dump fires): a debug-armed
+            # server spawns a handler thread per scrape, and a healthy
+            # long-running process must not grow this dict forever
+            alive = {t.ident for t in threading.enumerate()}
+            for tid in [t for t in _all_stacks if t not in alive]:
+                del _all_stacks[tid]
+            _all_stacks[threading.get_ident()] = (
+                threading.current_thread().name, self.stack,
+            )
 
 
-_held = _Held()
 _graph_lock = threading.Lock()
+# thread ident -> (thread name, that thread's held-lock stack object);
+# feeds all_held_locks(); entries from dead threads are pruned on read
+_all_stacks: dict[int, tuple[str, list]] = {}
+_held = _Held()
 # edge a -> b with the (a_site, b_site) witness that created it
 _edges: dict[tuple[str, str], str] = {}
 _violations: list[str] = []
@@ -101,6 +120,30 @@ def held_locks() -> tuple[str, ...]:
     """Names of instrumented locks the CURRENT thread holds, outermost
     first."""
     return tuple(lk.name for lk in _held.stack)
+
+
+def all_held_locks() -> dict[str, tuple[str, ...]]:
+    """Held instrumented locks across EVERY thread that has ever
+    acquired one: ``name#ident`` -> lock names, outermost first (the
+    ident disambiguates same-named threads — every MicroBatcher worker
+    is "serving-batcher", and a dump that collapsed them would drop
+    exactly the multi-batcher stacks an inversion post-mortem needs).
+    The
+    flight recorder snapshots this into failure dumps — the "who was
+    holding what" a post-mortem starts from. Empty unless lock
+    debugging is armed (plain locks are invisible by design); a
+    thread's stack may be one acquisition stale, which is fine for a
+    forensic snapshot."""
+    alive = {t.ident for t in threading.enumerate()}
+    out: dict[str, tuple[str, ...]] = {}
+    with _graph_lock:
+        for tid in [t for t in _all_stacks if t not in alive]:
+            del _all_stacks[tid]
+        for tid, (name, stack) in _all_stacks.items():
+            names = tuple(lk.name for lk in list(stack))
+            if names:
+                out[f"{name}#{tid}"] = names
+    return out
 
 
 def _find_cycle(start: str) -> list[str] | None:

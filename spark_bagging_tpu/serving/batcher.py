@@ -22,6 +22,14 @@ Contracts that matter under load:
   micro-batch, so a registry ``swap()`` takes effect at the next batch
   boundary while requests already forwarded finish on the executor
   they started with — no request is ever dropped by a swap.
+- **Every request is traceable.** ``submit()`` mints a trace context
+  (``telemetry.tracing``) exposed as ``future.trace``: after the
+  future resolves, ``future.trace.breakdown`` attributes the latency
+  (``queue_ms``/``batch_ms``/``forward_ms``/``total_ms``) and names
+  the batch (``batch_size``, ``bucket``, ``model_version``); span
+  events carry the ids, and batch failures / overload rejections emit
+  flight-recorder trigger events. All of it vanishes when telemetry
+  is disabled (``future.trace is None``).
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ import numpy as np
 
 from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.telemetry import tracing
 
 _SHUTDOWN = object()
 
@@ -50,14 +59,20 @@ class Overloaded(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("X", "n", "mode", "future", "t_submit")
+    __slots__ = ("X", "n", "mode", "future", "t_submit", "trace")
 
-    def __init__(self, X: np.ndarray, mode: str):
+    def __init__(self, X: np.ndarray, mode: str,
+                 trace: "tracing.TraceContext | None"):
         self.X = X
         self.n = X.shape[0]
         self.mode = mode
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # per-request trace context (None when telemetry is disabled);
+        # mirrored onto the future so callers can read
+        # `future.trace.breakdown` after the result resolves
+        self.trace = trace
+        self.future.trace = trace  # type: ignore[attr-defined]
 
 
 # sbt-lint: shared-state
@@ -116,10 +131,26 @@ class MicroBatcher:
         self._stop = threading.Event()
         self._closed = False
         self._close_lock = make_lock("serving.batcher.close")
+        # health facts for /healthz: single-writer fields (the worker
+        # thread); readers tolerate a momentarily stale float. Seeded
+        # at construction so a cold-start burst (queue pinned while
+        # the first forward compiles) gets the full STALL_S grace
+        # before /healthz calls it a stall
+        self._t_last_batch: float = time.monotonic()
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name="serving-batcher"
         )
         self._worker.start()
+        # deferred import: the health registry lives in the exposition
+        # server module, whose http.server import chain (~100ms) only
+        # serving processes should pay. Register AFTER the worker
+        # exists — health() reads it, and a scrape can land the
+        # instant registration returns
+        from spark_bagging_tpu.telemetry import server as telemetry_server
+
+        self._health_handle = telemetry_server.register_health_source(
+            "batcher", self, MicroBatcher.health
+        )
 
     # -- client side ---------------------------------------------------
 
@@ -145,16 +176,25 @@ class MicroBatcher:
             )
         if X.shape[0] == 0:
             raise ValueError("X has no rows")
-        req = _Request(X, mode)
-        with telemetry.span("serving_enqueue", rows=req.n):
-            try:
-                self._q.put_nowait(req)
-            except Full:
-                telemetry.inc("sbt_serving_overloaded_total")
-                raise Overloaded(
-                    f"serving queue full ({self._q.maxsize} requests "
-                    "waiting); retry with backoff or raise max_queue"
-                ) from None
+        trace = (tracing.request_context() if telemetry.enabled()
+                 else None)
+        req = _Request(X, mode, trace)
+        with tracing.use(trace):
+            with telemetry.span("serving_enqueue", rows=req.n):
+                try:
+                    self._q.put_nowait(req)
+                except Full:
+                    telemetry.inc("sbt_serving_overloaded_total")
+                    telemetry.emit_event({
+                        "kind": "serving_overloaded",
+                        "trace_id": trace.trace_id if trace else None,
+                        "rows": req.n,
+                        "max_queue": self._q.maxsize,
+                    })
+                    raise Overloaded(
+                        f"serving queue full ({self._q.maxsize} requests "
+                        "waiting); retry with backoff or raise max_queue"
+                    ) from None
         if self._closed and req.future.cancel():
             # raced close(): its drain may already have run, so nobody
             # would ever serve this request — a successful cancel means
@@ -182,6 +222,50 @@ class MicroBatcher:
                 "serves a regression executor"
             )
         return self.submit(X, mode="aggregate").result(timeout)
+
+    # -- observability -------------------------------------------------
+
+    # a full queue that has not drained a batch for this long means
+    # traffic is arriving but nothing is served (hung device forward);
+    # an empty queue with an old last-batch age is just an idle process
+    STALL_S = 10.0
+
+    def health(self) -> dict:
+        """Liveness facts for ``/healthz`` (registered automatically):
+        healthy means SERVING traffic — closed, dead-worker (a sink
+        raised outside the batch guard), and stalled (queue pinned at
+        its bound past :data:`STALL_S` with no batch completing)
+        batchers all report unhealthy so a load balancer stops routing
+        here."""
+        depth = self._q.qsize()
+        alive = self._worker.is_alive()
+        age = time.monotonic() - self._t_last_batch
+        stalled = depth >= self._q.maxsize and age > self.STALL_S
+        return {
+            "healthy": not self._closed and alive and not stalled,
+            "closed": self._closed,
+            "worker_alive": alive,
+            "stalled": stalled,
+            "queue_depth": depth,
+            "max_queue": self._q.maxsize,
+            "last_batch_age_s": age,
+        }
+
+    def stats(self) -> dict:
+        """Serving stats off the live registry: cumulative counters
+        plus request-latency quantiles (p50/p95/p99, log-bucket
+        interpolation — the same numbers ``/varz`` serves)."""
+        reg = telemetry.registry()
+        return {
+            "requests": reg.counter("sbt_serving_requests_total").value,
+            "batches": reg.counter("sbt_serving_batches_total").value,
+            "overloaded": reg.counter("sbt_serving_overloaded_total").value,
+            "batch_errors": reg.counter(
+                "sbt_serving_batch_errors_total").value,
+            "latency": reg.histogram(
+                "sbt_serving_latency_seconds").quantiles(),
+            **self.health(),
+        }
 
     # -- lifecycle -----------------------------------------------------
 
@@ -218,6 +302,17 @@ class MicroBatcher:
                     RuntimeError("MicroBatcher closed before this "
                                  "request was served")
                 )
+
+    def retire(self) -> None:
+        """Close AND leave ``/healthz``. ``close()`` alone keeps this
+        batcher in the health set reporting unhealthy (the
+        load-balancer drain signal); retire() is for rolling over to a
+        new batcher in the same process, where the old one's 503 would
+        poison an otherwise healthy node."""
+        self.close()
+        from spark_bagging_tpu.telemetry import server as telemetry_server
+
+        telemetry_server.remove_health_source(self._health_handle)
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -263,34 +358,103 @@ class MicroBatcher:
         live = [r for r in batch if r.future.set_running_or_notify_cancel()]
         if not live:
             return
+        t_claim = time.perf_counter()
         if telemetry.enabled():
             telemetry.inc("sbt_serving_batches_total")
             telemetry.set_gauge("sbt_serving_queue_depth",
                                 self._q.qsize())
+        # one batch-level trace context linked to every member request:
+        # the coalesced batch/forward/scatter spans resolve from any of
+        # the trace ids riding the batch
+        traced = [r.trace for r in live if r.trace is not None]
+        bctx = tracing.batch_context(traced) if traced else None
+        ex = None
+        t_fwd = 0.0
         try:
             ex = self._resolve()
             X = (live[0].X if len(live) == 1
                  else np.concatenate([r.X for r in live]))
-            with telemetry.span("serving_batch", rows=X.shape[0],
-                                requests=len(live)):
-                out = ex.forward(X)
+            with tracing.use(bctx):
+                with telemetry.span("serving_batch", rows=X.shape[0],
+                                    requests=len(live)):
+                    t0 = time.perf_counter()
+                    try:
+                        out = ex.forward(X)
+                    finally:
+                        # in finally so a forward that dies after 2 s
+                        # of device time still attributes those 2 s to
+                        # forward_ms in the error breakdown
+                        t_fwd = time.perf_counter() - t0
         except BaseException as e:  # noqa: BLE001 — delivered per-future
+            t_fail = time.perf_counter()
             for r in live:
+                self._finish_breakdown(
+                    r, ex, t_claim, t_fail, t_fwd, bctx, len(live),
+                    error=repr(e),
+                )
                 r.future.set_exception(e)
+            telemetry.inc("sbt_serving_batch_errors_total")
+            telemetry.emit_event({
+                "kind": "serving_batch_error",
+                "error": repr(e),
+                "requests": len(live),
+                "rows": sum(r.n for r in live),
+                "trace_id": bctx.trace_id if bctx else None,
+                "links": [t.trace_id for t in traced],
+            })
             return
-        with telemetry.span("serving_scatter", requests=len(live)):
-            off = 0
-            t_done = time.perf_counter()
-            for r in live:
-                piece = out[off:off + r.n]
-                off += r.n
-                try:
-                    if r.mode == "predict" and ex.task == "classification":
-                        piece = ex.classes_[piece.argmax(axis=1)]
-                    r.future.set_result(piece)
-                except BaseException as e:  # noqa: BLE001
-                    if not r.future.done():
-                        r.future.set_exception(e)
-                if telemetry.enabled():
-                    telemetry.observe("sbt_serving_latency_seconds",
-                                      t_done - r.t_submit)
+        # sbt-lint: disable=shared-state-unlocked — single-writer (this worker thread); /healthz readers tolerate a stale float
+        self._t_last_batch = time.monotonic()
+        with tracing.use(bctx):
+            with telemetry.span("serving_scatter", requests=len(live)):
+                off = 0
+                t_done = time.perf_counter()
+                for r in live:
+                    piece = out[off:off + r.n]
+                    off += r.n
+                    try:
+                        if (r.mode == "predict"
+                                and ex.task == "classification"):
+                            piece = ex.classes_[piece.argmax(axis=1)]
+                        self._finish_breakdown(
+                            r, ex, t_claim, t_done, t_fwd, bctx,
+                            len(live),
+                        )
+                        r.future.set_result(piece)
+                    except BaseException as e:  # noqa: BLE001
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                    if telemetry.enabled():
+                        telemetry.observe(
+                            "sbt_serving_latency_seconds",
+                            t_done - r.t_submit,
+                            exemplar=(r.trace.trace_id if r.trace
+                                      else None),
+                        )
+
+    @staticmethod
+    def _finish_breakdown(
+        r: _Request, ex: Any, t_claim: float, t_done: float,
+        t_fwd: float, bctx: "tracing.TraceContext | None",
+        n_requests: int, error: str | None = None,
+    ) -> None:
+        """Fill the request trace's timing breakdown — complete before
+        the future resolves, so `future.result(); future.trace.breakdown`
+        never races."""
+        if r.trace is None:
+            return
+        buckets = (bctx.annotations.get("bucket", []) if bctx else [])
+        bd = {
+            "queue_ms": (t_claim - r.t_submit) * 1e3,
+            "batch_ms": (t_done - t_claim) * 1e3,
+            "forward_ms": t_fwd * 1e3,
+            "total_ms": (t_done - r.t_submit) * 1e3,
+            "batch_size": n_requests,
+            "bucket": (buckets[0] if len(buckets) == 1
+                       else list(buckets) or None),
+            "model_version": getattr(ex, "model_version", None),
+            "batch_trace_id": bctx.trace_id if bctx else None,
+        }
+        if error is not None:
+            bd["error"] = error
+        r.trace.breakdown.update(bd)

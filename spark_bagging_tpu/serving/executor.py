@@ -29,6 +29,7 @@ import numpy as np
 
 from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.telemetry import tracing
 from spark_bagging_tpu.serving.buckets import (
     DEFAULT_MAX_ROWS,
     DEFAULT_MIN_ROWS,
@@ -81,6 +82,10 @@ class EnsembleExecutor:
         self._donate = bool(donate_input)
         self._compiled: dict[int, Any] = {}
         self._build_lock = make_lock("serving.executor.build")
+        # stamped by ModelRegistry on register/swap; standalone
+        # executors serve as anonymous version None
+        self.model_name: str | None = None
+        self.model_version: int | None = None
 
     # -- compile management --------------------------------------------
 
@@ -170,6 +175,9 @@ class EnsembleExecutor:
             telemetry.inc("sbt_serving_padding_rows_total",
                           float(bucket - n))
             telemetry.observe("sbt_serving_batch_fill_ratio", n / bucket)
+        # attach the bucket choice to whatever request/batch trace is
+        # current (slab-split oversize batches annotate once per slab)
+        tracing.annotate(bucket=bucket)
         Xp = pad_to_bucket(X, bucket)
         with telemetry.span("serving_forward", bucket=bucket, rows=n):
             out = compiled(self._params, self._subspaces, Xp)

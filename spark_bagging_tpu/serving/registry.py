@@ -55,6 +55,25 @@ class ModelRegistry:
         self._lock = make_lock("serving.registry")
         self._entries: dict[str, _Entry] = {}
         self._default_opts = default_executor_opts
+        # deferred import: the health registry lives in the exposition
+        # server module, whose http.server import chain (~100ms) only
+        # serving processes should pay
+        from spark_bagging_tpu.telemetry import server as telemetry_server
+
+        self._health_handle = telemetry_server.register_health_source(
+            "model_registry", self, ModelRegistry.health
+        )
+
+    def health(self) -> dict:
+        """``/healthz`` contribution: the live model/version map. A
+        registry is healthy by construction — its job is to always
+        hold a consistent serving pointer; per-batcher liveness is the
+        batchers' own report."""
+        with self._lock:
+            models = {
+                name: e.version for name, e in self._entries.items()
+            }
+        return {"healthy": True, "models": models}
 
     # -- introspection -------------------------------------------------
 
@@ -86,6 +105,16 @@ class ModelRegistry:
 
     # -- lifecycle -----------------------------------------------------
 
+    def _reject_swap(self, name: str, msg: str) -> None:
+        """Count + flight-record a contract violation, then raise.
+        A rejected swap is an incident (a retrain pipeline shipped an
+        incompatible model), so it triggers the armed recorder."""
+        telemetry.inc("sbt_serving_swap_rejected_total")
+        telemetry.emit_event({
+            "kind": "swap_rejected", "model": name, "error": msg,
+        })
+        raise ValueError(msg)
+
     def register(self, name: str, model: Any, *, warmup: bool = False,
                  **executor_opts: Any) -> EnsembleExecutor:
         """Install a fitted estimator as version 1 of ``name``.
@@ -107,7 +136,11 @@ class ModelRegistry:
                     "replace it"
                 )
             self._entries[name] = _Entry(name, 1, ex, opts)
+            ex.model_name = name
+            ex.model_version = 1
         telemetry.inc("sbt_serving_models_registered_total")
+        telemetry.set_gauge("sbt_serving_model_version", 1.0,
+                            labels={"model": name})
         return ex
 
     def swap(self, name: str, model: Any, *, warm: bool = True,
@@ -131,20 +164,23 @@ class ModelRegistry:
         opts = {**entry.opts, **executor_opts}
         new = EnsembleExecutor(model, **opts)
         if new.task != old.task:
-            raise ValueError(
-                f"swap would change task {old.task!r} -> {new.task!r}"
+            self._reject_swap(
+                name,
+                f"swap would change task {old.task!r} -> {new.task!r}",
             )
         if new.n_features != old.n_features:
-            raise ValueError(
+            self._reject_swap(
+                name,
                 f"swap would change feature width {old.n_features} -> "
-                f"{new.n_features}"
+                f"{new.n_features}",
             )
         if old.classes_ is not None and not np.array_equal(
             np.asarray(old.classes_), np.asarray(new.classes_)
         ):
-            raise ValueError(
+            self._reject_swap(
+                name,
                 "swap would change the served class set; register the "
-                "new label space under a new name instead"
+                "new label space under a new name instead",
             )
         if warm:
             from spark_bagging_tpu.serving.buckets import bucket_for
@@ -164,6 +200,8 @@ class ModelRegistry:
             entry.opts = opts
             entry.version += 1
             version = entry.version
+            new.model_name = name
+            new.model_version = version
         telemetry.inc("sbt_serving_swaps_total")
         telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})

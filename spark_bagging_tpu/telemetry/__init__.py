@@ -19,7 +19,17 @@ is the TPU-native equivalent, one subsystem with three layers:
    (spans + metric flushes) land in memory and, optionally, a
    schema-versioned JSONL file; ``render_prometheus()`` dumps the
    registry in Prometheus text format (also:
-   ``python -m spark_bagging_tpu.telemetry dump``).
+   ``python -m spark_bagging_tpu.telemetry dump``). Run artifacts
+   default into ``telemetry_dir()`` (``$SBT_TELEMETRY_DIR``, else
+   ``./telemetry/``).
+4. **Live plane** (``server.py`` / ``tracing.py`` / ``recorder.py``) —
+   an opt-in stdlib HTTP exposition server (``/metrics``, ``/healthz``,
+   ``/varz``, ``/debug/spans``, ``/debug/runs``; start with
+   ``SBT_METRICS_PORT`` or :func:`start_server`), per-request trace
+   contexts threading the serving path (every served future exposes
+   ``future.trace`` with a queue/batch/forward timing breakdown), and
+   a ring-buffer flight recorder that dumps ``flight_<ts>.json`` on
+   serving faults.
 
 Cost contract: **zero overhead when disabled** — every instrumentation
 site in the engines guards on :func:`enabled` (one attribute read) or
@@ -41,7 +51,9 @@ Typical use::
 from __future__ import annotations
 
 from spark_bagging_tpu.telemetry.registry import (
+    QUANTILES,
     Registry,
+    SERIES_HELP,
     render_prometheus as _render_snapshot,
 )
 from spark_bagging_tpu.telemetry.sinks import (
@@ -49,19 +61,30 @@ from spark_bagging_tpu.telemetry.sinks import (
     Run,
     capture,
     current_run,
+    default_log_path,
     last_metrics_snapshot,
     read_events,
     runs,
+    telemetry_dir,
 )
 from spark_bagging_tpu.telemetry.spans import phase, span
 from spark_bagging_tpu.telemetry.state import STATE as _state
+from spark_bagging_tpu.telemetry import recorder, tracing
+
+# the exposition server's names resolve lazily (module __getattr__
+# below): its http.server import chain costs ~100ms of stdlib, which
+# `import spark_bagging_tpu` consumers that never serve must not pay
+_SERVER_ATTRS = ("start_server", "stop_server", "server_address")
 
 __all__ = [
-    "SCHEMA_VERSION", "Run", "capture", "current_run", "enabled",
-    "enable", "disable", "set_device_sync", "device_sync_enabled",
-    "span", "phase", "inc", "set_gauge", "observe", "registry",
-    "render_prometheus", "read_events", "last_metrics_snapshot",
-    "runs", "record_fit_report", "Registry", "reset",
+    "SCHEMA_VERSION", "SERIES_HELP", "QUANTILES", "Run", "capture",
+    "current_run", "enabled", "enable", "disable", "set_device_sync",
+    "device_sync_enabled", "span", "phase", "inc", "set_gauge",
+    "observe", "emit_event", "registry", "render_prometheus",
+    "read_events", "last_metrics_snapshot", "runs",
+    "record_fit_report", "Registry", "reset", "telemetry_dir",
+    "default_log_path", "tracing", "recorder", "start_server",
+    "stop_server", "server_address",
 ]
 
 
@@ -114,9 +137,24 @@ def set_gauge(name: str, v: float, labels: dict | None = None) -> None:
         _state.registry.set(name, v, labels)
 
 
-def observe(name: str, v: float, labels: dict | None = None) -> None:
+def observe(name: str, v: float, labels: dict | None = None,
+            exemplar: str | None = None) -> None:
     if _state.enabled:
-        _state.registry.observe(name, v, labels)
+        _state.registry.observe(name, v, labels, exemplar=exemplar)
+
+
+def emit_event(event: dict) -> None:
+    """Deliver one raw event to every active sink (open captures, the
+    armed flight recorder). The serving fault events
+    (``serving_batch_error``, ``serving_overloaded``,
+    ``swap_rejected``) go through here — they are flight-recorder
+    triggers, not metrics. No-op (one attribute read + an empty-list
+    check) when disabled or nothing is listening."""
+    if _state.enabled and _state._sinks:
+        import time
+
+        event.setdefault("ts", time.time())
+        _state.emit(event)
 
 
 def render_prometheus(snapshot: list | None = None) -> str:
@@ -173,3 +211,28 @@ def record_fit_report(report: dict) -> FitReportView:
             reg.observe(metric, float(val))
     _state.emit({"kind": "fit_report", "report": dict(report)})
     return view
+
+
+def __getattr__(name: str):
+    if name in _SERVER_ATTRS:
+        from spark_bagging_tpu.telemetry import server
+
+        return getattr(server, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
+# -- live observability plane (opt-in) ---------------------------------
+# `SBT_METRICS_PORT=9100 python your_serving_script.py` is the whole
+# enable story: the exposition server starts with the package and
+# `curl :9100/healthz` works with zero code changes. Without the env
+# var this is one dict lookup at import (server.py stays unimported).
+import os as _os  # noqa: E402
+
+if _os.environ.get("SBT_METRICS_PORT", ""):
+    from spark_bagging_tpu.telemetry.server import (  # noqa: E402
+        maybe_start_from_env as _maybe_start_from_env,
+    )
+
+    _maybe_start_from_env()

@@ -6,12 +6,37 @@ an empty registry). With a JSONL event-log path (written by
 ``telemetry.capture(path)``), reconstructs the log's final ``metrics``
 snapshot and renders that — the offline way to turn a recorded run
 into a scrape-able dump.
+
+Every histogram additionally gets a ``# quantiles`` comment line with
+its p50/p95/p99 estimate (log-bucket interpolation) — comment lines
+are legal in the exposition format, so the output stays scrape-
+parseable while a human reading the dump gets the SLO trio for free
+(``--no-quantiles`` drops them for byte-stable diffs).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+
+
+def _quantile_comments(snapshot: list[dict]) -> str:
+    from spark_bagging_tpu.telemetry.registry import snapshot_quantiles
+
+    lines = []
+    for entry in snapshot:
+        if entry["kind"] != "histogram":
+            continue
+        qs = snapshot_quantiles(entry)
+        labels = "".join(
+            f",{k}={v}" for k, v in sorted(entry["labels"].items())
+        )
+        stats = " ".join(
+            f"{k}={'nan' if v is None else format(v, '.6g')}"
+            for k, v in qs.items()
+        )
+        lines.append(f"# quantiles {entry['name']}{labels} {stats}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -26,22 +51,28 @@ def main(argv: list[str] | None = None) -> int:
         "jsonl", nargs="?", default=None,
         help="JSONL event log to render (default: this process's registry)",
     )
+    dump.add_argument(
+        "--no-quantiles", action="store_true",
+        help="omit the per-histogram `# quantiles` comment lines",
+    )
     args = p.parse_args(argv)
 
     from spark_bagging_tpu import telemetry
 
     if args.jsonl is None:
-        sys.stdout.write(telemetry.render_prometheus())
-        return 0
-    events = telemetry.read_events(args.jsonl)
-    snap = telemetry.last_metrics_snapshot(events)
-    if snap is None:
-        print(
-            f"no metrics snapshot found in {args.jsonl!r} "
-            "(was the capture closed?)", file=sys.stderr,
-        )
-        return 1
+        snap = telemetry.registry().snapshot()
+    else:
+        events = telemetry.read_events(args.jsonl)
+        snap = telemetry.last_metrics_snapshot(events)
+        if snap is None:
+            print(
+                f"no metrics snapshot found in {args.jsonl!r} "
+                "(was the capture closed?)", file=sys.stderr,
+            )
+            return 1
     sys.stdout.write(telemetry.render_prometheus(snap))
+    if not args.no_quantiles:
+        sys.stdout.write(_quantile_comments(snap))
     return 0
 
 

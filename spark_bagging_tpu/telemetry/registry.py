@@ -20,6 +20,7 @@ every call site), not here.
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Iterable
 
 from spark_bagging_tpu.analysis.locks import make_lock
@@ -31,6 +32,63 @@ from spark_bagging_tpu.analysis.locks import make_lock
 DEFAULT_BUCKETS: tuple[float, ...] = tuple(
     10.0 ** e for e in range(-4, 4)
 ) + (math.inf,)
+
+# Central help-text table for every stable sbt_* series — the single
+# source `render_prometheus` emits `# HELP` lines from, and the
+# documentation a scraper's UI shows next to the graph. Dynamic series
+# (the per-fit-report `sbt_fit_<key>` gauges) are covered by prefix in
+# `_help_for`. Keep entries one line: the exposition format forbids
+# raw newlines in HELP text (escaped ones are legal but unreadable).
+SERIES_HELP: dict[str, str] = {
+    "sbt_replicas_fitted_total": "Base replicas fitted across all fit calls",
+    "sbt_compile_seconds": "XLA compile wall-clock per fit (histogram)",
+    "sbt_fit_seconds": "Device fit wall-clock per fit call (histogram)",
+    "sbt_h2d_seconds": "Host-to-device transfer seconds per fit (histogram)",
+    "sbt_h2d_bytes_total": "Bytes transferred host-to-device",
+    "sbt_d2h_bytes_total": "Bytes transferred device-to-host",
+    "sbt_oob_evaluations_total": "Out-of-bag scoring passes",
+    "sbt_collective_seconds": "Multihost collective wall-clock (histogram)",
+    "sbt_stream_epochs_total": "Streaming-fit epochs completed",
+    "sbt_stream_chunks_total": "Streaming-fit chunks consumed",
+    "sbt_chunks_yielded_total": "Chunks yielded by streaming sources",
+    "sbt_chunk_seconds": "Per-chunk step wall-clock (histogram)",
+    "sbt_prefetch_queue_depth": "Prefetch queue depth (gauge)",
+    "sbt_prefetch_stall_seconds_total": "Seconds the consumer stalled on prefetch",
+    "sbt_checkpoint_bytes_total": "Checkpoint bytes written",
+    "sbt_checkpoint_seconds": "Checkpoint save wall-clock (histogram)",
+    "sbt_compile_cache_hits_total": "Persistent compile-cache hits",
+    "sbt_compile_cache_misses_total": "Persistent compile-cache misses",
+    "sbt_shardmap_traces_total": "shard_map traced executions",
+    "sbt_serving_requests_total": "Requests admitted by MicroBatcher.submit()",
+    "sbt_serving_rows_total": "Rows served through the executor forward",
+    "sbt_serving_batches_total": "Coalesced micro-batches forwarded",
+    "sbt_serving_queue_depth": "Requests admitted but not yet forwarded (gauge)",
+    "sbt_serving_batch_fill_ratio": "Real rows / bucket rows per forward (histogram)",
+    "sbt_serving_padding_rows_total": "Padding rows added to reach bucket shapes",
+    "sbt_serving_compiles_total": "Serving bucket compiles (zero after warmup)",
+    "sbt_serving_compile_seconds": "Serving bucket compile wall-clock (histogram)",
+    "sbt_serving_latency_seconds": "Request latency submit-to-result (histogram)",
+    "sbt_serving_overloaded_total": "Requests shed with Overloaded backpressure",
+    "sbt_serving_models_registered_total": "Models registered for serving",
+    "sbt_serving_swaps_total": "Successful hot swaps",
+    "sbt_serving_swap_rejected_total": "Hot swaps rejected by contract validation",
+    "sbt_serving_model_version": "Live model version per registered name (gauge)",
+    "sbt_serving_batch_errors_total": "Micro-batches failed by an executor error",
+    "sbt_flight_dumps_total": "Flight-recorder dumps written",
+    "sbt_flight_dumps_suppressed_total": "Flight-recorder dumps suppressed by cooldown",
+}
+
+
+def _help_for(name: str) -> str | None:
+    text = SERIES_HELP.get(name)
+    if text is None and name.startswith("sbt_fit_"):
+        key = name[len("sbt_fit_"):]
+        text = f"fit_report_[{key!r}] exported as a gauge"
+    return text
+
+# The quantiles every histogram surfaces (snapshot/dump/varz/serving
+# stats): median, tail, far tail — the serve-SLO trio.
+QUANTILES: tuple[float, ...] = (0.5, 0.95, 0.99)
 
 
 def _label_key(labels: dict[str, Any] | None) -> tuple:
@@ -73,6 +131,10 @@ class Histogram:
 
     Buckets store per-bucket counts; cumulative ``le`` counts are
     produced at render time (the exposition format's convention).
+    ``observe(v, exemplar=...)`` additionally remembers the most
+    recent exemplar (a trace id) per bucket, so a latency spike in the
+    p99 bucket comes with a concrete request to go look up in the
+    span log — the histogram-to-trace jump of OpenMetrics exemplars.
     """
 
     kind = "histogram"
@@ -84,15 +146,76 @@ class Histogram:
         self.counts = [0] * len(self.bounds)
         self.sum = 0.0
         self.count = 0
+        # bucket index -> {"trace_id", "value", "ts"} (last write wins:
+        # the freshest example of that latency class is the useful one)
+        self.exemplars: dict[int, dict[str, Any]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
-        self.sum += v
-        self.count += 1
         for i, b in enumerate(self.bounds):
             if v <= b:
                 self.counts[i] += 1
-                return
+                if exemplar is not None:
+                    self.exemplars[i] = {
+                        "trace_id": exemplar, "value": v,
+                        "ts": time.time(),
+                    }
+                break
+        # count AFTER the bucket: quantile() reads the live object
+        # without the registry lock (stats paths), in the opposite
+        # order — count first, then the counts copy — so a concurrent
+        # reader can never see count > sum(counts)
+        self.count += 1
+        self.sum += v
+
+    def quantile(self, q: float) -> float:
+        """Estimate the ``q``-quantile by log-linear interpolation
+        inside the bucket where the cumulative count crosses it.
+
+        The grid is log-scale (decades by default), so interpolating
+        in log space matches the distribution model the buckets
+        already impose; the first bucket interpolates from one decade
+        below its bound, and mass in the ``+Inf`` bucket clamps to the
+        last finite bound (the estimate is a floor there — say so in
+        dashboards). NaN when empty.
+        """
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        # lock-free read off the live object (MicroBatcher.stats() and
+        # /varz call this while the worker observes under the registry
+        # lock): read count BEFORE copying counts — paired with
+        # observe()'s bucket-before-count write order this guarantees
+        # sum(counts) >= count, so the loop always crosses target
+        count = self.count
+        counts = list(self.counts)
+        if count == 0:
+            return math.nan
+        target = q * count
+        cum = 0
+        for i, (b, c) in enumerate(zip(self.bounds, counts)):
+            cum += c
+            if cum >= target and c > 0:
+                if not math.isfinite(b):
+                    # beyond the grid: the last finite bound is all we
+                    # can honestly claim
+                    return self.bounds[i - 1] if i > 0 else math.inf
+                lo = self.bounds[i - 1] if i > 0 else b / 10.0
+                if lo <= 0:
+                    lo = b / 10.0
+                frac = (target - (cum - c)) / c
+                return lo * (b / lo) ** frac
+        return math.nan  # pragma: no cover — cum == count >= target
+
+    def quantiles(self) -> dict[str, float | None]:
+        """The standard trio (p50/p95/p99) as a JSON-friendly dict.
+        Non-finite estimates (empty histogram) become None — `NaN` is
+        not JSON, and these dicts land verbatim in /varz responses,
+        flight dumps, and capture() metrics snapshots."""
+        out: dict[str, float | None] = {}
+        for q in QUANTILES:
+            v = self.quantile(q)
+            out[f"p{int(q * 100)}"] = v if math.isfinite(v) else None
+        return out
 
 
 # sbt-lint: shared-state
@@ -139,17 +262,27 @@ class Registry:
         with self._lock:
             self._get_locked(name, labels, Gauge).set(v)
 
-    def observe(self, name: str, v: float, labels: dict | None = None) -> None:
+    def observe(self, name: str, v: float, labels: dict | None = None,
+                exemplar: str | None = None) -> None:
         with self._lock:
-            self._get_locked(name, labels, Histogram).observe(v)
+            self._get_locked(name, labels, Histogram).observe(
+                v, exemplar=exemplar
+            )
 
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
 
-    def snapshot(self) -> list[dict]:
+    def snapshot(self, *, quantiles: bool = False) -> list[dict]:
         """JSON-serializable dump of every metric (the ``metrics``
-        JSONL event body, and the input to :func:`render_prometheus`)."""
+        JSONL event body, and the input to :func:`render_prometheus`).
+
+        ``quantiles=True`` adds interpolated p50/p95/p99 to each
+        histogram entry; the default skips that work because the two
+        hottest callers — the ``/metrics`` scrape and the JSONL
+        metrics flush — never read them (consumers of a bare snapshot
+        can always reconstruct via :func:`snapshot_quantiles`, the
+        bucket counts are in the entry)."""
         out = []
         with self._lock:
             for (name, labels), m in sorted(self._metrics.items()):
@@ -165,10 +298,46 @@ class Registry:
                     ]
                     entry["sum"] = m.sum
                     entry["count"] = m.count
+                    if m.exemplars:
+                        entry["exemplars"] = [
+                            {
+                                "le": ("+Inf"
+                                       if m.bounds[i] == math.inf
+                                       else m.bounds[i]),
+                                **ex,
+                            }
+                            for i, ex in sorted(m.exemplars.items())
+                        ]
                 else:
                     entry["value"] = m.value
                 out.append(entry)
+        # quantile interpolation happens OUTSIDE the lock, from each
+        # entry's copied bucket counts — every metric writer blocks on
+        # this lock
+        if quantiles:
+            for entry in out:
+                if entry["kind"] == "histogram":
+                    entry["quantiles"] = snapshot_quantiles(entry)
         return out
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline must be escaped or the sample line is unparseable (a model
+    name like ``c:\\models`` or ``he said "v2"`` would tear the whole
+    scrape otherwise). Order matters: backslash first."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping per the exposition format: backslash and
+    newline only (quotes are legal there)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
@@ -177,7 +346,9 @@ def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
         merged.update(extra)
     if not merged:
         return ""
-    body = ",".join(f'{k}="{v}"' for k, v in sorted(merged.items()))
+    body = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(merged.items())
+    )
     return "{" + body + "}"
 
 
@@ -191,13 +362,37 @@ def _fmt_value(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
+def snapshot_quantiles(entry: dict) -> dict[str, float]:
+    """p50/p95/p99 for one histogram snapshot entry. Live snapshots
+    carry them precomputed; entries read back from an old JSONL log
+    are reconstructed from their bucket counts (same interpolation)."""
+    if "quantiles" in entry:
+        return entry["quantiles"]
+    h = Histogram(buckets=[
+        math.inf if b == "+Inf" else float(b)
+        for b, _ in entry["buckets"]
+    ])
+    h.counts = [c for _, c in entry["buckets"]]
+    h.count = entry["count"]
+    h.sum = entry["sum"]
+    return h.quantiles()
+
+
 def render_prometheus(snapshot: list[dict]) -> str:
-    """Prometheus text exposition of a :meth:`Registry.snapshot`."""
+    """Prometheus text exposition of a :meth:`Registry.snapshot`.
+
+    Series with an entry in :data:`SERIES_HELP` (or an ``sbt_fit_*``
+    name) get a ``# HELP`` line ahead of their ``# TYPE``, once per
+    metric name. Label values are escaped per the format spec.
+    """
     lines: list[str] = []
     seen_type: set[str] = set()
     for entry in snapshot:
         name, kind, labels = entry["name"], entry["kind"], entry["labels"]
         if name not in seen_type:
+            help_text = _help_for(name)
+            if help_text is not None:
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
             lines.append(f"# TYPE {name} {kind}")
             seen_type.add(name)
         if kind == "histogram":

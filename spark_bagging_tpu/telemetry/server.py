@@ -1,0 +1,330 @@
+"""Live exposition server — scrape the process instead of reading dumps.
+
+Everything before this was passive observability: an in-process
+registry plus offline JSONL/Prometheus dumps. This module is the live
+half — a zero-dependency stdlib ``http.server`` endpoint an operator
+(or a Prometheus scraper, or ``curl``) points at a serving process:
+
+- ``GET /metrics`` — the registry in Prometheus text exposition
+  format, straight off the live process (``# HELP``/``# TYPE`` lines
+  included);
+- ``GET /healthz`` — aggregate liveness from every registered health
+  source (micro-batcher queue depth vs. bound, last-batch age, closed
+  flag; model registry live versions). 200 when every source is
+  healthy, 503 otherwise — load-balancer-compatible;
+- ``GET /varz`` — one JSON snapshot: metrics (with per-histogram
+  p50/p95/p99 quantiles and exemplar trace ids), health detail,
+  process info;
+- ``GET /debug/spans`` — recent span events from the flight
+  recorder's ring (``?trace_id=`` filters to one request's tree);
+- ``GET /debug/runs`` — the run registry (every ``capture()`` window
+  this process opened).
+
+Opt-in, two ways: ``telemetry.start_server(port)`` from code, or the
+``SBT_METRICS_PORT`` environment variable (checked at package import;
+port 0 picks an ephemeral port). The server runs on one daemon thread
+(requests themselves are handled on short-lived threads); when it is
+not started, nothing in this module runs — the serving hot path's
+zero-overhead contract is untouched. Binds loopback by default:
+metrics can leak data shapes and model names, so exposing beyond the
+host is a deliberate ``host=`` choice.
+
+Health sources register WEAKLY: a batcher garbage-collected with its
+serving stack disappears from ``/healthz`` instead of pinning the
+object alive or reporting a ghost. A closed-but-referenced batcher
+reports unhealthy by design — drop the reference once it is retired.
+(Close first: an un-closed batcher's worker thread holds a strong
+reference to it, so abandoning one without ``close()``/``retire()``
+leaks the thread AND keeps its health entry live.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+import weakref
+
+from spark_bagging_tpu.analysis.locks import make_lock
+
+_module_lock = make_lock("telemetry.server")
+_server: ThreadingHTTPServer | None = None
+_thread: threading.Thread | None = None
+_t_start: float | None = None
+
+# handle -> (source name, weakref to owner, bound health fn taking the
+# live owner). Owner death removes the entry lazily on read.
+_health_sources: dict[int, tuple[str, Any, Callable[[Any], dict]]] = {}
+_health_seq = [0]
+
+
+def register_health_source(
+    name: str, owner: Any, fn: Callable[[Any], dict],
+) -> int:
+    """Register ``fn(owner) -> dict`` as a ``/healthz`` contributor.
+
+    The dict must carry ``healthy: bool``; everything else is detail
+    surfaced verbatim. ``owner`` is held by weak reference. Returns a
+    handle for :func:`remove_health_source`.
+    """
+    with _module_lock:
+        # prune dead owners here too, not only in health_report():
+        # a process that never serves /healthz (no server started)
+        # but churns through batchers must not grow this dict forever
+        for h in [h for h, (_, r, _f) in _health_sources.items()
+                  if r() is None]:
+            del _health_sources[h]
+        _health_seq[0] += 1
+        handle = _health_seq[0]
+        _health_sources[handle] = (name, weakref.ref(owner), fn)
+    return handle
+
+
+def remove_health_source(handle: int) -> None:
+    with _module_lock:
+        _health_sources.pop(handle, None)
+
+
+def clear_health_sources() -> None:
+    """Drop every registered source (test isolation; embedders that
+    rebuild their serving stack in-process)."""
+    with _module_lock:
+        _health_sources.clear()
+
+
+def health_report() -> dict[str, Any]:
+    """Aggregate health: ``{"healthy": bool, "sources": {...}}``.
+    Healthy when every live source is (an empty source set is healthy:
+    nothing is wrong, there is just nothing serving yet)."""
+    with _module_lock:
+        items = list(_health_sources.items())
+    sources: dict[str, dict] = {}
+    healthy = True
+    dead: list[int] = []
+    for handle, (name, ref, fn) in items:
+        owner = ref()
+        if owner is None:
+            dead.append(handle)
+            continue
+        try:
+            detail = dict(fn(owner))
+        except Exception as e:  # noqa: BLE001 — a broken health probe
+            # IS unhealth, not a reason to take the endpoint down
+            detail = {"healthy": False, "error": repr(e)}
+        healthy = healthy and bool(detail.get("healthy"))
+        sources[f"{name}#{handle}"] = detail
+    if dead:
+        with _module_lock:
+            for handle in dead:
+                _health_sources.pop(handle, None)
+    return {"healthy": healthy, "sources": sources}
+
+
+def _varz() -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry.state import STATE
+
+    return {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "uptime_seconds": (
+            time.monotonic() - _t_start if _t_start is not None else None
+        ),
+        "telemetry_enabled": STATE.enabled,
+        "health": health_report(),
+        "metrics": STATE.registry.snapshot(quantiles=True),
+    }
+
+
+def _debug_spans(query: dict[str, list[str]]) -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import recorder
+
+    rec = recorder.get()
+    if rec is None:
+        return {"spans": [], "note": "flight recorder not armed"}
+    spans = rec.events(kind="span")
+    trace_id = (query.get("trace_id") or [None])[0]
+    if trace_id:
+        spans = [
+            s for s in spans
+            if s.get("trace_id") == trace_id
+            or trace_id in (s.get("links") or ())
+        ]
+    try:
+        limit = max(0, int((query.get("limit") or ["256"])[0]))
+    except ValueError:
+        # garbage ?limit= falls back to the default window rather than
+        # 500ing the scrape (negative values are clamped above — a raw
+        # spans[-limit:] would have INVERTED the slice and returned
+        # nearly the whole ring)
+        limit = 256
+    # limit=0 must mean "none", but spans[-0:] slices from the START
+    # and would return the whole ring
+    return {"spans": spans[-limit:] if limit else []}
+
+
+def _debug_runs() -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import sinks
+
+    active = {r.run_id for r in [sinks.current_run()] if r is not None}
+    return {
+        "runs": [
+            {
+                "run_id": r.run_id,
+                "label": r.label,
+                "path": r.path,
+                "t_start": r.t_start,
+                "n_events": r.n_events,
+                "active": r.run_id in active,
+            }
+            for r in sinks.runs()
+        ]
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "sbt-telemetry/1"
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        url = urlparse(self.path)
+        query = parse_qs(url.query)
+        try:
+            if url.path == "/metrics":
+                from spark_bagging_tpu.telemetry.registry import (
+                    render_prometheus,
+                )
+                from spark_bagging_tpu.telemetry.state import STATE
+
+                body = render_prometheus(STATE.registry.snapshot())
+                self._send(200, body, "text/plain; version=0.0.4")
+            elif url.path == "/healthz":
+                report = health_report()
+                self._send_json(200 if report["healthy"] else 503, report)
+            elif url.path == "/varz":
+                self._send_json(200, _varz())
+            elif url.path == "/debug/spans":
+                self._send_json(200, _debug_spans(query))
+            elif url.path == "/debug/runs":
+                self._send_json(200, _debug_runs())
+            elif url.path == "/":
+                self._send_json(200, {
+                    "endpoints": [
+                        "/metrics", "/healthz", "/varz",
+                        "/debug/spans", "/debug/runs",
+                    ],
+                })
+            else:
+                self._send_json(404, {"error": f"no route {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            # the client hung up mid-response (scrape timeout, Ctrl-C'd
+            # curl) — there is nothing to report and no socket left to
+            # report it on; writing a 500 here would raise again and
+            # spam handle_error tracebacks on every aborted scrape
+            pass
+        except Exception as e:  # noqa: BLE001 — the instrument panel
+            # must report its own faults, not close the connection
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except OSError:
+                pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        self._send(code, json.dumps(obj, default=str),
+                   "application/json")
+
+    def log_message(self, format: str, *args: Any) -> None:
+        """Silence per-request stderr lines — scrapes every few seconds
+        would otherwise drown the process's real logging."""
+
+
+def start_server(
+    port: int | None = None, host: str = "127.0.0.1",
+) -> int:
+    """Start the exposition server on a daemon thread; returns the
+    bound port (useful with ``port=0``). Idempotent while running —
+    a second call returns the live server's port. ``port=None`` reads
+    ``SBT_METRICS_PORT``. Arms the default flight recorder so
+    ``/debug/spans`` has an event window to serve."""
+    global _server, _thread, _t_start
+    from spark_bagging_tpu.telemetry import recorder
+
+    with _module_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        if port is None:
+            env = os.environ.get("SBT_METRICS_PORT", "")
+            if not env:
+                raise ValueError(
+                    "no port given and SBT_METRICS_PORT is not set"
+                )
+            port = int(env)
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        srv.daemon_threads = True
+        thread = threading.Thread(
+            target=srv.serve_forever, kwargs={"poll_interval": 0.25},
+            daemon=True, name="sbt-telemetry-server",
+        )
+        # start INSIDE the lock: a concurrent stop_server() that saw
+        # the published globals would otherwise call srv.shutdown(),
+        # which blocks forever unless serve_forever() is already
+        # running (socketserver's __is_shut_down handshake)
+        thread.start()
+        _server, _thread, _t_start = srv, thread, time.monotonic()
+    recorder.arm()
+    return srv.server_address[1]
+
+
+def stop_server() -> None:
+    """Shut the server down and join its thread (idempotent). Leaves
+    the flight recorder armed — failures after the scrape endpoint
+    goes away are exactly the ones worth recording."""
+    global _server, _thread, _t_start
+    with _module_lock:
+        srv, thread = _server, _thread
+        _server = _thread = _t_start = None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if thread is not None:
+        thread.join(5.0)
+
+
+def server_address() -> tuple[str, int] | None:
+    """``(host, port)`` while running, else None."""
+    with _module_lock:
+        if _server is None:
+            return None
+        addr = _server.server_address
+        return (str(addr[0]), int(addr[1]))
+
+
+def maybe_start_from_env() -> int | None:
+    """Start iff ``SBT_METRICS_PORT`` is set (the package calls this at
+    import, making ``SBT_METRICS_PORT=9100 python serve.py`` the whole
+    opt-in story). Never raises — a bad port or an occupied socket
+    must not take down the workload it observes."""
+    if not os.environ.get("SBT_METRICS_PORT", ""):
+        return None
+    try:
+        return start_server()
+    except Exception as e:  # noqa: BLE001 — observability is optional
+        import warnings
+
+        warnings.warn(
+            f"SBT_METRICS_PORT is set but the telemetry server failed "
+            f"to start: {e!r}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return None

@@ -35,6 +35,26 @@ from typing import Iterator
 
 SCHEMA_VERSION = 1
 
+
+def telemetry_dir() -> str:
+    """The directory run artifacts (JSONL event logs, flight-recorder
+    dumps) land in: ``$SBT_TELEMETRY_DIR`` when set, else
+    ``./telemetry/`` under the current working directory. Created on
+    first use — artifacts are working state, not source, and live
+    outside version control (``.gitignore`` covers the default)."""
+    path = os.environ.get("SBT_TELEMETRY_DIR") or os.path.join(
+        os.getcwd(), "telemetry"
+    )
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def default_log_path(name: str = "telemetry.jsonl") -> str:
+    """``name`` resolved inside :func:`telemetry_dir` — what bench.py
+    and the serving benchmark pass to :func:`capture` by default."""
+    return os.path.join(telemetry_dir(), name)
+
+
 _run_seq = itertools.count(1)
 _runs_lock = threading.Lock()
 _runs: list["Run"] = []

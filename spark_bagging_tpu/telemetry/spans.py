@@ -6,7 +6,11 @@ carry a full ``path`` like ``fit/compile``), and composes with
 ``jax.named_scope``: a span opened inside a jit trace enters the same
 name as a scope, so host spans and device traces (TensorBoard/Perfetto
 via ``utils/profiling.trace``) segment by the SAME phase names — the
-Spark-UI-stages analog [SURVEY §5].
+Spark-UI-stages analog [SURVEY §5]. When a request trace context is
+installed on the thread (``telemetry.tracing``), every span event
+additionally carries ``trace_id``/``span_id``/``parent_id`` (and, for
+batch-level contexts, ``links`` to member request traces), turning the
+event stream into a queryable per-request span tree.
 
 Two cost tiers, per the zero-overhead-when-disabled contract:
 
@@ -28,6 +32,7 @@ import time
 from contextlib import contextmanager
 from typing import Any, Iterator
 
+from spark_bagging_tpu.telemetry import tracing
 from spark_bagging_tpu.telemetry.state import STATE as _state
 
 
@@ -83,6 +88,8 @@ def _record_span(
         _device_barrier()
     stack.append(name)
     path = "/".join(stack)
+    tctx = tracing.current()
+    trace_fields = tctx.begin_span() if tctx is not None else None
     t0 = time.perf_counter()
     t0_epoch = time.time()
     try:
@@ -91,6 +98,8 @@ def _record_span(
         # pop FIRST — later spans on this thread must not inherit a
         # stale path prefix no matter what the barrier below does
         stack.pop()
+        if tctx is not None:
+            tctx.end_span()
         if do_sync:
             try:
                 _device_barrier()
@@ -109,6 +118,8 @@ def _record_span(
             "seconds": dt,
             "sync": bool(do_sync),
         }
+        if trace_fields is not None:
+            event.update(trace_fields)
         if attrs:
             event["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
         _state.emit(event)

@@ -1,0 +1,240 @@
+"""Live exposition server [ISSUE 5]: /metrics, /healthz, /varz and the
+debug endpoints scraped over real HTTP during live serving traffic —
+the tier-1 smoke for the observability plane. (The sbt-lint
+cleanliness of the new telemetry modules is enforced by the PR-4
+self-hosting gate in tests/test_analysis.py, which lints the whole
+tree.)
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.telemetry import server as tserver
+from spark_bagging_tpu.serving import ModelRegistry
+
+
+def _get(port: int, path: str):
+    """(status, body) — never raises on HTTP error codes."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    tserver.clear_health_sources()
+    yield
+    tserver.stop_server()
+    # start_server() armed the default flight recorder (dir=None →
+    # ./telemetry/ under the test cwd); detach it so later test
+    # modules that deliberately induce serving faults don't write
+    # stray flight_*.json on every run
+    telemetry.recorder.disarm()
+    tserver.clear_health_sources()
+    telemetry.reset()
+    telemetry.enable()
+
+
+@pytest.fixture(scope="module")
+def clf():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(96, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3),
+        n_estimators=4, seed=0,
+    ).fit(X, y)
+    clf._test_X = X  # stash the matching request pool on the model
+    return clf
+
+
+def test_server_lifecycle_and_routes():
+    port = tserver.start_server(0)
+    assert tserver.server_address() == ("127.0.0.1", port)
+    assert tserver.start_server(0) == port  # idempotent while running
+    status, body = _get(port, "/")
+    assert status == 200 and "/metrics" in body
+    status, _ = _get(port, "/nope")
+    assert status == 404
+    tserver.stop_server()
+    tserver.stop_server()  # idempotent
+    assert tserver.server_address() is None
+
+
+def test_scrape_during_live_serving_traffic(clf):
+    """The acceptance scenario: during sustained traffic a scrape
+    returns live sbt_serving_* series (HELP lines included), /varz
+    carries latency quantiles, /debug/spans resolves a request's
+    trace, and /healthz flips unhealthy when the batcher closes."""
+    X = clf._test_X
+    port = tserver.start_server(0)
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    with reg.batcher("m", max_delay_ms=2, max_queue=256) as b:
+        futs = [b.submit(X[i:i + 2]) for i in range(24)]
+        # scrape WHILE requests are in flight (some may already be
+        # done — "during traffic" means the process is serving)
+        status, metrics = _get(port, "/metrics")
+        for f in futs:
+            f.result(30)
+        assert status == 200
+        status2, metrics2 = _get(port, "/metrics")
+        assert status2 == 200
+        assert "# TYPE sbt_serving_requests_total counter" in metrics2
+        assert ("# HELP sbt_serving_requests_total Requests admitted"
+                in metrics2)
+        assert "sbt_serving_batches_total" in metrics2
+        assert 'sbt_serving_model_version{model="m"} 1' in metrics2
+
+        status, healthz = _get(port, "/healthz")
+        assert status == 200
+        health = json.loads(healthz)
+        assert health["healthy"] is True
+        batcher_sources = [
+            v for k, v in health["sources"].items()
+            if k.startswith("batcher")
+        ]
+        assert batcher_sources and batcher_sources[0]["max_queue"] == 256
+        assert batcher_sources[0]["last_batch_age_s"] is not None
+        registry_sources = [
+            v for k, v in health["sources"].items()
+            if k.startswith("model_registry")
+        ]
+        assert registry_sources[0]["models"] == {"m": 1}
+
+        status, varz = _get(port, "/varz")
+        v = json.loads(varz)
+        assert v["health"]["healthy"] is True
+        lat = [
+            m for m in v["metrics"]
+            if m["name"] == "sbt_serving_latency_seconds"
+        ]
+        assert lat and set(lat[0]["quantiles"]) == {"p50", "p95", "p99"}
+        assert lat[0]["exemplars"]  # trace-id exemplars ride the scrape
+
+        tid = futs[0].trace.trace_id
+        status, spans = _get(port, f"/debug/spans?trace_id={tid}")
+        names = {s["name"] for s in json.loads(spans)["spans"]}
+        assert "serving_enqueue" in names
+        assert "serving_batch" in names
+
+    # batcher closed: /healthz must flip unhealthy (503 for LBs)
+    status, healthz = _get(port, "/healthz")
+    assert status == 503
+    health = json.loads(healthz)
+    assert health["healthy"] is False
+    closed = [
+        v for k, v in health["sources"].items()
+        if k.startswith("batcher")
+    ]
+    assert closed[0]["closed"] is True
+
+
+def test_debug_runs_lists_captures():
+    port = tserver.start_server(0)
+    with telemetry.capture(label="window") as run:
+        with telemetry.span("x"):
+            pass
+        status, body = _get(port, "/debug/runs")
+    runs = json.loads(body)["runs"]
+    mine = [r for r in runs if r["run_id"] == run.run_id]
+    assert mine and mine[0]["label"] == "window"
+    assert mine[0]["active"] is True
+
+
+def test_retire_leaves_healthz_while_close_poisons_it(clf):
+    """close() keeps the batcher in the health set reporting unhealthy
+    (the LB drain signal); retire() removes it so a same-process
+    rollover to a fresh batcher doesn't 503 a healthy node."""
+    X = clf._test_X
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=False)
+    old = reg.batcher("m", max_delay_ms=2, max_queue=16)
+    old.submit(X[:2]).result(30)
+    old.retire()  # close + leave /healthz
+    fresh = reg.batcher("m", max_delay_ms=2, max_queue=16)
+    try:
+        report = tserver.health_report()
+        assert report["healthy"] is True  # retired batcher is gone
+        batcher_sources = [
+            k for k in report["sources"] if k.startswith("batcher")
+        ]
+        assert len(batcher_sources) == 1  # only the fresh one
+    finally:
+        fresh.close()
+    assert tserver.health_report()["healthy"] is False  # drain signal
+
+
+def test_dead_health_source_disappears():
+    class Box:
+        def health(self):
+            return {"healthy": False}
+
+    box = Box()
+    tserver.register_health_source("box", box, Box.health)
+    assert tserver.health_report()["healthy"] is False
+    del box  # owner collected: the ghost must not haunt /healthz
+    import gc
+
+    gc.collect()
+    report = tserver.health_report()
+    assert report["healthy"] is True and report["sources"] == {}
+
+
+def test_broken_health_probe_reports_unhealthy_not_500():
+    class Bad:
+        def health(self):
+            raise RuntimeError("probe broke")
+
+    bad = Bad()
+    tserver.register_health_source("bad", bad, Bad.health)
+    port = tserver.start_server(0)
+    status, body = _get(port, "/healthz")
+    assert status == 503
+    (detail,) = json.loads(body)["sources"].values()
+    assert "probe broke" in detail["error"]
+
+
+def test_env_opt_in(monkeypatch):
+    monkeypatch.delenv("SBT_METRICS_PORT", raising=False)
+    assert tserver.maybe_start_from_env() is None  # unset: no server
+    assert tserver.server_address() is None
+    monkeypatch.setenv("SBT_METRICS_PORT", "0")
+    port = tserver.maybe_start_from_env()
+    assert port is not None
+    status, _ = _get(port, "/metrics")
+    assert status == 200
+
+
+def test_bad_env_port_warns_not_raises(monkeypatch):
+    monkeypatch.setenv("SBT_METRICS_PORT", "not-a-port")
+    with pytest.warns(RuntimeWarning, match="failed to start"):
+        assert tserver.maybe_start_from_env() is None
+
+
+def test_metrics_endpoint_renders_escaped_labels():
+    telemetry.set_gauge(
+        "sbt_serving_model_version", 3.0,
+        labels={"model": 'he said "v2"\\final'},
+    )
+    port = tserver.start_server(0)
+    status, body = _get(port, "/metrics")
+    assert status == 200
+    assert (
+        r'sbt_serving_model_version{model="he said \"v2\"\\final"} 3'
+        in body
+    )

@@ -212,6 +212,130 @@ class TestPrometheus:
         assert "sbt_neg -Inf" in text
 
 
+class TestQuantiles:
+    def test_log_bucket_interpolation_brackets_truth(self):
+        """Quantile estimates from decade buckets must land inside the
+        bucket that truly contains the quantile (interpolation can't
+        do better than the grid, but must never leave the bucket)."""
+        r = Registry()
+        rng_vals = [0.002, 0.003, 0.004, 0.005, 0.05, 0.06, 0.5, 2.0]
+        for v in rng_vals:
+            r.observe("sbt_h_seconds", v)
+        h = r.histogram("sbt_h_seconds")
+        assert 0.001 < h.quantile(0.5) <= 0.1
+        assert 0.1 < h.quantile(0.99) <= 10.0
+        qs = h.quantiles()
+        assert set(qs) == {"p50", "p95", "p99"}
+        assert qs["p50"] <= qs["p95"] <= qs["p99"]
+
+    def test_quantile_edge_cases(self):
+        import math
+
+        from spark_bagging_tpu.telemetry.registry import Histogram
+
+        h = Histogram()
+        assert math.isnan(h.quantile(0.5))  # empty
+        h.observe(1e9)  # beyond the grid: +Inf bucket
+        assert h.quantile(0.5) == h.bounds[-2]  # clamps to last finite
+        with pytest.raises(ValueError, match="quantile"):
+            h.quantile(1.5)
+
+    def test_snapshot_and_offline_reconstruction_agree(self):
+        from spark_bagging_tpu.telemetry.registry import (
+            snapshot_quantiles,
+        )
+
+        r = Registry()
+        for v in (0.01, 0.02, 0.5, 3.0):
+            r.observe("sbt_h_seconds", v)
+        (entry,) = r.snapshot(quantiles=True)
+        direct = entry["quantiles"]
+        entry_no_q = {k: v for k, v in entry.items() if k != "quantiles"}
+        rebuilt = snapshot_quantiles(entry_no_q)  # the old-JSONL path
+        for k in direct:
+            assert rebuilt[k] == pytest.approx(direct[k])
+
+    def test_cli_dump_emits_quantile_comments(self, tmp_path, capsys):
+        from spark_bagging_tpu.telemetry.__main__ import main
+
+        telemetry.observe("sbt_chunk_seconds", 0.02)
+        assert main(["dump"]) == 0
+        out = capsys.readouterr().out
+        assert "# quantiles sbt_chunk_seconds p50=" in out
+        assert main(["dump", "--no-quantiles"]) == 0
+        assert "# quantiles" not in capsys.readouterr().out
+
+    def test_exemplar_recorded_and_snapshotted(self):
+        r = Registry()
+        r.observe("sbt_lat_seconds", 0.05, exemplar="tr-1")
+        r.observe("sbt_lat_seconds", 0.06, exemplar="tr-2")
+        r.observe("sbt_lat_seconds", 40.0, exemplar="tr-slow")
+        (entry,) = r.snapshot()
+        by_bucket = {e["le"]: e["trace_id"] for e in entry["exemplars"]}
+        assert by_bucket[0.1] == "tr-2"  # last write wins per bucket
+        assert by_bucket[100.0] == "tr-slow"
+
+
+class TestHelpAndEscaping:
+    def test_help_lines_from_series_table(self):
+        from spark_bagging_tpu.telemetry.registry import SERIES_HELP
+
+        r = Registry()
+        r.inc("sbt_serving_requests_total", 3)
+        text = render_prometheus(r.snapshot())
+        expected = SERIES_HELP["sbt_serving_requests_total"]
+        assert f"# HELP sbt_serving_requests_total {expected}" in text
+        # HELP precedes TYPE, each exactly once
+        assert text.index("# HELP") < text.index("# TYPE")
+        assert text.count("# HELP sbt_serving_requests_total") == 1
+
+    def test_fit_gauges_get_prefix_help(self):
+        r = Registry()
+        r.set("sbt_fit_fits_per_sec", 8.0)
+        text = render_prometheus(r.snapshot())
+        assert "# HELP sbt_fit_fits_per_sec" in text
+
+    def test_unknown_series_get_no_help(self):
+        r = Registry()
+        r.inc("sbt_mystery_total")
+        text = render_prometheus(r.snapshot())
+        assert "# HELP" not in text
+        assert "# TYPE sbt_mystery_total counter" in text
+
+    def test_label_values_escaped(self):
+        r = Registry()
+        r.set("sbt_serving_model_version", 1.0,
+              {"model": 'a"b\\c\nd'})
+        text = render_prometheus(r.snapshot())
+        assert r'{model="a\"b\\c\nd"}' in text
+        # and the line count survives: the newline did NOT split a
+        # sample across two lines
+        sample_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("sbt_serving_model_version")
+        ]
+        assert len(sample_lines) == 1
+
+
+class TestEmitEvent:
+    def test_reaches_open_capture_with_ts(self):
+        with telemetry.capture() as run:
+            telemetry.emit_event({"kind": "serving_overloaded"})
+        evs = [e for e in run.events
+               if e["kind"] == "serving_overloaded"]
+        assert len(evs) == 1 and "ts" in evs[0]
+
+    def test_noop_when_disabled_or_unobserved(self):
+        telemetry.emit_event({"kind": "nobody_listening"})  # no sink
+        telemetry.disable()
+        with telemetry.capture() as run:
+            telemetry.disable()  # capture force-enabled; flip back
+            telemetry.emit_event({"kind": "while_disabled"})
+            telemetry.enable()
+        assert not [e for e in run.events
+                    if e["kind"] == "while_disabled"]
+
+
 class TestDisabledOverhead:
     def test_disabled_span_is_noop_singleton(self):
         telemetry.disable()

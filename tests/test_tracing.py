@@ -1,0 +1,397 @@
+"""Per-request distributed tracing [ISSUE 5]: trace/request identity
+through the serving path, span linkage, timing breakdowns, the
+flight recorder's failure dumps, and the disabled-mode cost contract.
+
+The load-bearing property: EVERY served request — coalesced,
+slab-split oversize, or in flight across a hot swap — must resolve to
+a complete trace: ``future.trace.breakdown`` populated before the
+future resolves, and the span log containing its linked
+enqueue/batch/forward/scatter spans.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.telemetry import recorder, tracing
+from spark_bagging_tpu.serving import MicroBatcher, ModelRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.reset()
+    telemetry.enable()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(128, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def clf(data):
+    X, y = data
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3),
+        n_estimators=4, seed=0,
+    ).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def registry(clf):
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    return reg
+
+
+# -- context mechanics -------------------------------------------------
+
+def test_context_ids_and_span_nesting():
+    ctx = tracing.request_context()
+    assert ctx.trace_id and ctx.request_id.startswith("req-")
+    with telemetry.capture() as run:
+        with tracing.use(ctx):
+            with telemetry.span("outer"):
+                with telemetry.span("inner"):
+                    pass
+    inner, outer = run.spans("inner")[0], run.spans("outer")[0]
+    assert inner["trace_id"] == outer["trace_id"] == ctx.trace_id
+    assert inner["parent_id"] == outer["span_id"]
+    assert "parent_id" not in outer
+    json.dumps([inner, outer])  # ids must be JSONL-clean
+
+
+def test_use_restores_previous_context():
+    a, b = tracing.request_context(), tracing.request_context()
+    with tracing.use(a):
+        with tracing.use(b):
+            assert tracing.current() is b
+        assert tracing.current() is a
+    assert tracing.current() is None
+
+
+def test_annotate_accumulates_lists():
+    ctx = tracing.request_context()
+    with tracing.use(ctx):
+        tracing.annotate(bucket=8)
+        tracing.annotate(bucket=16)
+    assert ctx.annotations["bucket"] == [8, 16]
+    tracing.annotate(bucket=32)  # no context installed: no-op
+    assert ctx.annotations["bucket"] == [8, 16]
+
+
+# -- through the batcher -----------------------------------------------
+
+def test_breakdown_populated_and_sums_to_total(registry, data):
+    X, _ = data
+    with registry.batcher("m", max_delay_ms=5) as b:
+        fut = b.submit(X[:3])
+        fut.result(30)
+    tr = fut.trace
+    bd = tr.breakdown
+    for key in ("queue_ms", "batch_ms", "forward_ms", "total_ms",
+                "batch_size", "bucket", "model_version"):
+        assert key in bd, key
+    # the breakdown partitions the request's life: admission wait plus
+    # batch processing IS the total, and the device forward is inside
+    # the batch segment
+    assert bd["queue_ms"] + bd["batch_ms"] == pytest.approx(
+        bd["total_ms"], rel=1e-6
+    )
+    assert 0 <= bd["forward_ms"] <= bd["batch_ms"]
+    assert bd["bucket"] == 8
+    assert bd["model_version"] == 1
+
+
+def test_span_log_links_enqueue_batch_forward_scatter(registry, data):
+    """The acceptance resolvability contract: from one future's
+    trace_id, the span log yields the request's enqueue span (by
+    trace_id) and the batch/forward/scatter spans that served it (by
+    links), with forward parented under batch."""
+    X, _ = data
+    with telemetry.capture() as run:
+        with registry.batcher("m", max_delay_ms=5) as b:
+            fut = b.submit(X[:3])
+            fut.result(30)
+    tid = fut.trace.trace_id
+
+    def linked(name):
+        return [
+            s for s in run.spans(name)
+            if s.get("trace_id") == tid or tid in s.get("links", ())
+        ]
+
+    enq = linked("serving_enqueue")
+    bat = linked("serving_batch")
+    fwd = linked("serving_forward")
+    sca = linked("serving_scatter")
+    assert len(enq) == len(bat) == len(fwd) == len(sca) == 1
+    assert enq[0]["trace_id"] == tid
+    assert enq[0]["request_id"] == fut.trace.request_id
+    # batch-level spans share ONE batch trace and link the request
+    assert bat[0]["trace_id"] == fwd[0]["trace_id"]
+    assert fwd[0]["parent_id"] == bat[0]["span_id"]
+    assert fut.trace.breakdown["batch_trace_id"] == bat[0]["trace_id"]
+
+
+def test_concurrent_clients_unique_ids_and_linkage(registry, data):
+    """N threads submitting concurrently: every request gets a UNIQUE
+    request_id/trace_id, a breakdown whose parts sum to ~its total,
+    and resolvable batch linkage — even though many requests share
+    one coalesced batch."""
+    X, _ = data
+    n_threads, per_thread = 8, 6
+    futs: dict[int, list] = {i: [] for i in range(n_threads)}
+
+    with telemetry.capture() as run:
+        with registry.batcher(
+            "m", max_delay_ms=20, max_queue=256
+        ) as b:
+            def client(i):
+                rng = np.random.default_rng(i)
+                for _ in range(per_thread):
+                    k = int(rng.integers(0, len(X) - 4))
+                    futs[i].append(b.submit(X[k:k + 2]))
+
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            all_futs = [f for fs in futs.values() for f in fs]
+            for f in all_futs:
+                f.result(30)
+
+    traces = [f.trace for f in all_futs]
+    assert len({t.trace_id for t in traces}) == len(traces)
+    assert len({t.request_id for t in traces}) == len(traces)
+    batch_spans = {
+        s["span_id"]: s for s in run.spans("serving_batch")
+    }
+    for t in traces:
+        bd = t.breakdown
+        assert bd["queue_ms"] + bd["batch_ms"] == pytest.approx(
+            bd["total_ms"], rel=1e-6
+        )
+        assert bd["total_ms"] >= 0
+        # the batch that served this request recorded the link back
+        served_by = [
+            s for s in batch_spans.values()
+            if t.trace_id in s.get("links", ())
+        ]
+        assert len(served_by) == 1, t.trace_id
+    # enqueue spans: exactly one per request, correct identity
+    enq_ids = {
+        s["trace_id"] for s in run.spans("serving_enqueue")
+    }
+    assert enq_ids == {t.trace_id for t in traces}
+
+
+def test_oversize_slab_split_traces_every_bucket(registry, data):
+    """A request larger than max_batch_rows runs as slabs (full slabs
+    at the top bucket, the tail re-bucketed to its own size); the
+    breakdown records EVERY slab's bucket."""
+    X, _ = data
+    with registry.batcher("m", max_delay_ms=1) as b:
+        fut = b.submit(X[:70])  # 70 rows -> slabs of 32 + 32 + 6
+        out = fut.result(30)
+    assert out.shape == (70, 2)
+    # the 6-row tail pads to bucket 8, not the top bucket
+    assert fut.trace.breakdown["bucket"] == [32, 32, 8]
+
+
+def test_trace_survives_hot_swap(registry, clf, data):
+    """Requests in flight across a swap stay resolvable and report the
+    model_version that actually served them."""
+    X, y = data
+    clf2 = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3),
+        n_estimators=4, seed=1,
+    ).fit(X, y)
+    versions = set()
+    with registry.batcher("m", max_delay_ms=1, max_queue=256) as b:
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                f = b.submit(X[:2])
+                f.result(30)
+                versions.add(f.trace.breakdown["model_version"])
+
+        t = threading.Thread(target=client)
+        t.start()
+        v_before = registry.version("m")
+        registry.swap("m", clf2)
+        time.sleep(0.1)
+        stop.set()
+        t.join(30)
+    assert versions <= {v_before, v_before + 1}
+    assert registry.version("m") in versions  # post-swap traffic flowed
+    registry.swap("m", clf)  # restore for sibling tests
+
+
+def test_disabled_telemetry_mints_no_trace(registry, data):
+    X, _ = data
+    telemetry.disable()
+    try:
+        with registry.batcher("m", max_delay_ms=1) as b:
+            fut = b.submit(X[:2])
+            fut.result(30)
+        assert fut.trace is None
+    finally:
+        telemetry.enable()
+
+
+def test_disabled_tracing_hot_path_overhead():
+    """The serving-side analog of the telemetry micro-benchmark: the
+    per-request tracing hooks (current(), use(None)) must be
+    attribute-read cheap when no context rides the thread."""
+    telemetry.disable()
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tracing.use(None):
+            tracing.current()
+            tracing.annotate(bucket=1)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 20e-6, f"{per_call * 1e6:.2f}us per disabled site"
+
+
+def test_latency_histogram_carries_exemplar_trace(registry, data):
+    X, _ = data
+    with registry.batcher("m", max_delay_ms=1) as b:
+        fut = b.submit(X[:2])
+        fut.result(30)
+    snap = {
+        e["name"]: e for e in telemetry.registry().snapshot()
+    }
+    exemplars = snap["sbt_serving_latency_seconds"].get("exemplars")
+    assert exemplars, "latency histogram should carry exemplars"
+    assert any(
+        ex["trace_id"] == fut.trace.trace_id for ex in exemplars
+    )
+
+
+# -- flight recorder ---------------------------------------------------
+
+class _Flaky:
+    task = "classification"
+    n_features = 6
+    classes_ = np.array([0, 1])
+
+    def __init__(self, executor):
+        self._executor = executor
+        self.boom = True
+
+    def forward(self, Xb):
+        if self.boom:
+            self.boom = False
+            raise RuntimeError("injected fault")
+        return self._executor.forward(Xb)
+
+
+def test_batch_failure_produces_exactly_one_dump(
+    registry, data, tmp_path
+):
+    """THE black-box contract: an induced batch failure writes exactly
+    one flight dump, and the failing request's trace_id is resolvable
+    inside it (trigger links + captured enqueue span)."""
+    X, _ = data
+    rec = recorder.FlightRecorder(dir=str(tmp_path), cooldown_s=60)
+    rec.arm()
+    try:
+        flaky = _Flaky(registry.executor("m"))
+        with MicroBatcher(flaky, max_delay_ms=1, max_queue=16) as b:
+            bad = b.submit(X[:2])
+            with pytest.raises(RuntimeError, match="injected"):
+                bad.result(30)
+            good = b.submit(X[:2])
+            good.result(30)  # the worker survived the failed batch
+    finally:
+        rec.disarm()
+    assert len(rec.dumps) == 1
+    dump = json.loads(open(rec.dumps[0]).read())
+    assert dump["trigger"]["kind"] == "serving_batch_error"
+    assert bad.trace.trace_id in dump["trigger"]["links"]
+    assert bad.trace.breakdown["error"].startswith("RuntimeError")
+    captured = {
+        e.get("trace_id") for e in dump["events"]
+        if e.get("kind") == "span"
+    }
+    assert bad.trace.trace_id in captured  # its enqueue span is there
+    assert any(
+        m["name"] == "sbt_serving_batch_errors_total"
+        for m in dump["metrics"]
+    )
+    assert {"held", "violations", "edges"} <= set(dump["locks"])
+
+
+def test_swap_rejection_triggers_dump(registry, data, tmp_path):
+    X, y = data
+    rec = recorder.FlightRecorder(dir=str(tmp_path), cooldown_s=60)
+    rec.arm()
+    try:
+        wrong = BaggingClassifier(n_estimators=2, seed=0).fit(
+            X[:, :3], y
+        )
+        with pytest.raises(ValueError, match="feature width"):
+            registry.swap("m", wrong)
+    finally:
+        rec.disarm()
+    assert len(rec.dumps) == 1
+    dump = json.loads(open(rec.dumps[0]).read())
+    assert dump["trigger"]["kind"] == "swap_rejected"
+    assert dump["trigger"]["model"] == "m"
+
+
+def test_overload_burst_dumps_once(tmp_path):
+    """Single sheds never dump (backpressure working as designed); a
+    burst inside the window dumps exactly once (cooldown)."""
+    rec = recorder.FlightRecorder(
+        dir=str(tmp_path), burst_threshold=5, burst_window_s=5.0,
+        cooldown_s=60,
+    )
+    rec.arm()
+    try:
+        for _ in range(3):
+            telemetry.emit_event({"kind": "serving_overloaded"})
+        assert rec.dumps == []
+        for _ in range(10):
+            telemetry.emit_event({"kind": "serving_overloaded"})
+    finally:
+        rec.disarm()
+    assert len(rec.dumps) == 1
+    assert (
+        json.loads(open(rec.dumps[0]).read())["trigger"]["kind"]
+        == "serving_overloaded"
+    )
+
+
+def test_ring_buffer_is_bounded(tmp_path):
+    rec = recorder.FlightRecorder(capacity=16, dir=str(tmp_path))
+    rec.arm()
+    try:
+        for i in range(100):
+            telemetry.emit_event({"kind": "noise", "i": i})
+    finally:
+        rec.disarm()
+    events = rec.events(kind="noise")
+    assert len(events) == 16
+    assert events[-1]["i"] == 99  # newest kept, oldest evicted
