@@ -1,0 +1,8 @@
+"""Benchmark + replay tooling as an importable package.
+
+Scripts here remain directly runnable (``python benchmarks/x.py``);
+the package form exists so the replay gate has a stable CLI address —
+``python -m benchmarks.replay --check`` — and so tests can drive the
+replay engine in-process instead of paying a subprocess JAX import
+per assertion.
+"""

@@ -1,0 +1,722 @@
+#!/usr/bin/env python
+"""Deterministic workload replay: the regression half of record→replay.
+
+``telemetry/workload.py`` captures (or synthesizes) a request arrival
+stream; this module replays it against a REAL serving stack — an
+:class:`~spark_bagging_tpu.serving.executor.EnsembleExecutor` behind a
+:class:`~spark_bagging_tpu.serving.batcher.MicroBatcher` — and reports
+what the tracing plane observed: exact latency percentiles, rps,
+padding waste (rows and, when cost attribution ran, FLOPs), overload
+sheds, post-warmup compile count, and digests proving determinism.
+
+Two drive modes:
+
+- ``virtual`` (default): the arrival schedule is interpreted on a
+  virtual clock. Arrivals are grouped into coalescing windows by the
+  batcher's own time rule applied to the RECORDED timestamps
+  (``max_delay_ms`` window from the first arrival, early close on an
+  ``idle_flush_ms`` gap), each window is submitted to a stepped
+  (``threaded=False``) batcher and served synchronously via
+  ``run_pending()``. No wall-clock enters any batching decision, so
+  the same workload file + the same seed produce IDENTICAL batch
+  compositions and bitwise-identical model outputs, run after run —
+  the property the SLO gate's baseline comparison leans on.
+  The determinism contract's one idealization: the virtual clock
+  advances on arrivals only (service time does not push later
+  arrivals into the next window the way a busy worker would).
+- ``timed``: real open-loop replay — a worker-threaded batcher, the
+  schedule paced by sleeping until each arrival (compressed by
+  ``--speed``). Realistic queueing and latency, NOT deterministic;
+  for soak runs and incident reproduction, not CI gates.
+
+Scenario injection makes incidents scripted: ``--burst N`` splices
+``N`` near-simultaneous extra requests into the schedule (overload /
+backpressure drill — sheds are counted, never fatal), and
+``--swaps K`` performs ``K`` registry hot-swaps spread through the
+replay (swap-under-fire drill; the swapped-in model is the same
+fitted estimator, so outputs stay bitwise-identical while the full
+swap machinery — validation, bucket pre-compile, version bump —
+exercises under live traffic).
+
+The gate::
+
+    python -m benchmarks.replay --synthetic poisson --check \
+        --baseline telemetry/replay_report.json
+
+evaluates the report against an :class:`telemetry.slo.SLOSpec`
+(``--slo spec.json``; default: zero post-warmup compiles) plus, with
+``--baseline``, the relative regression bands of
+``telemetry.slo.compare_to_baseline`` — exit 0 on pass, 2 on any
+violated check. tests/test_replay.py asserts both directions (clean
+baseline passes; a throttled executor trips the gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REPLAY_SCHEMA_VERSION = 1
+
+
+def _percentile(sorted_vals: list, q: float) -> float | None:
+    """serving_latency's nearest-rank percentile, with the empty case
+    mapped to None instead of NaN (these values land verbatim in JSON
+    reports, and NaN is not JSON)."""
+    from benchmarks.serving_latency import _percentile as _p
+
+    if not sorted_vals:
+        return None
+    return _p(sorted_vals, q)
+
+
+def plan_windows(
+    requests,
+    *,
+    max_delay_s: float,
+    idle_flush_s: float,
+) -> list[list[int]]:
+    """Group arrival indices into coalescing windows on the virtual
+    clock — the batcher worker's time rule applied to recorded
+    timestamps: a window opens at its first arrival, admits arrivals
+    until ``open + max_delay_s``, and closes early when the gap to the
+    next arrival exceeds ``idle_flush_s`` (the idle flush). Row
+    bounds are NOT applied here: ``MicroBatcher.run_pending()`` splits
+    each window by the same row rule the worker uses, so composition
+    stays a pure function of (workload, batcher params)."""
+    windows: list[list[int]] = []
+    i, n = 0, len(requests)
+    while i < n:
+        t_open = requests[i].t
+        deadline = t_open + max_delay_s
+        window = [i]
+        last_t = t_open
+        j = i + 1
+        while j < n:
+            t = requests[j].t
+            if t > deadline or t - last_t > idle_flush_s:
+                break
+            window.append(j)
+            last_t = t
+            j += 1
+        windows.append(window)
+        i = j
+    return windows
+
+
+def inject_burst(workload, n: int, *, at_frac: float = 0.5,
+                 rows: int = 1):
+    """A new workload with ``n`` extra near-simultaneous requests
+    spliced in at ``at_frac`` of the duration — the scripted overload.
+    Pure function of its arguments: burst offsets are evenly spaced
+    (no RNG), so an injected replay is as deterministic as a plain
+    one."""
+    from spark_bagging_tpu.telemetry.workload import (
+        Workload, WorkloadRequest,
+    )
+
+    if n < 1:
+        return workload
+    base = workload.requests
+    t_b = workload.duration_s * at_frac
+    width = base[0].width if base else None
+    extra = [
+        WorkloadRequest(t=t_b + k * 1e-5, rows=rows, width=width)
+        for k in range(n)
+    ]
+    merged = sorted(
+        [copy.copy(r) for r in base] + extra, key=lambda r: r.t
+    )
+    # base requests keep the epoch structure they were captured or
+    # generated with (the gap parameter that produced it is not
+    # recorded, so re-deriving would silently rewrite it); each burst
+    # request joins the epoch active at its splice point
+    spliced = {id(r) for r in extra}
+    epoch = 0
+    for r in merged:
+        if id(r) in spliced:
+            r.epoch = epoch
+        else:
+            epoch = r.epoch
+    return Workload(
+        merged, source=workload.source, generator=workload.generator,
+        seed=workload.seed, created_ts=workload.created_ts,
+    )
+
+
+def workload_digest(workload) -> str:
+    """Stable identity of a request schedule (arrival times + shapes):
+    baseline comparisons only trust bitwise-output equality when both
+    replays ran the SAME schedule."""
+    h = hashlib.sha256()
+    for r in workload.requests:
+        h.update(
+            f"{r.t:.9f}|{r.rows}|{r.width}|{r.dtype}\n".encode()
+        )
+    return h.hexdigest()
+
+
+def _payloads(workload, n_features: int, seed: int):
+    """Deterministic per-request feature blocks: one seeded pool, each
+    request slicing at an index-keyed offset. The workload file records
+    the SCHEDULE, not the bytes — payload content comes from the seed,
+    which is why the determinism contract is 'same capture + same
+    seed'."""
+    import numpy as np
+
+    rows_max = max((r.rows for r in workload.requests), default=1)
+    pool_n = max(1024, 2 * rows_max)
+    rng = np.random.default_rng(seed)
+    pool = rng.normal(size=(pool_n, n_features)).astype(np.float32)
+
+    def payload(idx: int, rows: int):
+        start = (idx * 131) % (pool_n - rows_max + 1)
+        return pool[start:start + rows]
+
+    return payload
+
+
+class ThrottledExecutor:
+    """Executor wrapper adding a fixed host-side delay per forward —
+    the scripted 'someone slowed the hot path' regression the SLO gate
+    exists to catch (tests inject it; never used in production
+    serving)."""
+
+    def __init__(self, executor, delay_s: float):
+        self._executor = executor
+        self.delay_s = float(delay_s)
+        self.task = executor.task
+        self.n_features = executor.n_features
+        self.classes_ = executor.classes_
+        self.min_bucket_rows = executor.min_bucket_rows
+        self.max_batch_rows = executor.max_batch_rows
+        self.model_name = executor.model_name
+        self.model_version = executor.model_version
+        self.bucket_costs = executor.bucket_costs
+
+    def warmup(self, buckets=None):
+        return self._executor.warmup(buckets)
+
+    def forward(self, X):
+        time.sleep(self.delay_s)
+        return self._executor.forward(X)
+
+
+def replay(
+    workload,
+    *,
+    executor=None,
+    registry=None,
+    model_name: str | None = None,
+    mode: str = "virtual",
+    speed: float = 1.0,
+    burst: int = 0,
+    burst_at: float = 0.5,
+    burst_rows: int = 1,
+    swaps: int = 0,
+    max_delay_ms: float = 2.0,
+    idle_flush_ms: float = 1.0,
+    max_batch_rows: int = 256,
+    max_queue: int = 1024,
+    warmup: bool = True,
+    seed: int = 0,
+    timeout_s: float = 120.0,
+) -> dict:
+    """Drive one replay; returns the metric report (see module doc).
+
+    Target is either a bare ``executor`` or a ``registry`` +
+    ``model_name`` pair (required for ``swaps > 0`` — hot swaps are a
+    registry operation). Telemetry is force-enabled for the drive (the
+    report is BUILT from the tracing plane's breakdowns).
+    """
+    import numpy as np
+
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
+
+    if (executor is None) == (registry is None):
+        raise ValueError("pass exactly one of executor / registry")
+    if registry is not None and model_name is None:
+        raise ValueError("registry replay needs model_name")
+    if swaps > 0 and registry is None:
+        raise ValueError("--swaps needs a registry target")
+    if mode not in ("virtual", "timed"):
+        raise ValueError(f"unknown mode {mode!r}")
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+
+    telemetry.enable()
+    if burst > 0:
+        workload = inject_burst(workload, burst, at_frac=burst_at,
+                                rows=burst_rows)
+    requests = workload.requests
+    if not requests:
+        raise ValueError("empty workload")
+
+    target = (registry.executor(model_name) if registry is not None
+              else executor)
+    ex_provider = ((lambda: registry.executor(model_name))
+                   if registry is not None else executor)
+    payload = _payloads(workload, target.n_features, seed)
+    if warmup and hasattr(target, "warmup"):
+        target.warmup()
+
+    reg_counters = telemetry.registry()
+
+    def counter(name: str) -> float:
+        return reg_counters.counter(name).value
+
+    c0 = {
+        name: counter(name)
+        for name in (
+            "sbt_serving_compiles_total",
+            "sbt_serving_rows_total",
+            "sbt_serving_padding_rows_total",
+            "sbt_serving_flops_total",
+            "sbt_serving_padding_flops_total",
+            "sbt_serving_batches_total",
+        )
+    }
+
+    n = len(requests)
+    futs: dict[int, object] = {}
+    overloads = 0
+    swaps_done = 0
+    swap_compiles = 0.0
+
+    def do_swap() -> None:
+        # same fitted estimator, fresh executor: the swap machinery
+        # (validation, bucket pre-compile, version bump) exercises
+        # under fire while outputs stay bitwise-identical. The warm
+        # pre-compiles a swap performs are deliberate swap cost, not
+        # steady-state recompiles — measured here and excluded from
+        # the report's post_warmup_compiles (which gates to zero)
+        nonlocal swaps_done, swap_compiles
+        before = counter("sbt_serving_compiles_total")
+        registry.swap(model_name, registry.model(model_name))
+        swap_compiles += counter("sbt_serving_compiles_total") - before
+        swaps_done += 1
+    batcher = MicroBatcher(
+        ex_provider,
+        max_delay_ms=max_delay_ms,
+        idle_flush_ms=idle_flush_ms,
+        max_batch_rows=max_batch_rows,
+        max_queue=max_queue,
+        threaded=(mode == "timed"),
+    )
+    t_wall0 = time.perf_counter()
+    try:
+        if mode == "virtual":
+            windows = plan_windows(
+                requests,
+                max_delay_s=max_delay_ms / 1e3,
+                idle_flush_s=idle_flush_ms / 1e3,
+            )
+            swap_at = (
+                {int((k + 1) * len(windows) / (swaps + 1))
+                 for k in range(swaps)}
+                if swaps > 0 else set()
+            )
+            for w_i, window in enumerate(windows):
+                if w_i in swap_at:
+                    do_swap()
+                for idx in window:
+                    try:
+                        futs[idx] = batcher.submit(
+                            payload(idx, requests[idx].rows)
+                        )
+                    except Overloaded:
+                        overloads += 1
+                batcher.run_pending()
+        else:
+            swap_at = (
+                {int((k + 1) * n / (swaps + 1)) for k in range(swaps)}
+                if swaps > 0 else set()
+            )
+            for idx, r in enumerate(requests):
+                if idx in swap_at:
+                    do_swap()
+                delay = (t_wall0 + r.t / speed) - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    futs[idx] = batcher.submit(payload(idx, r.rows))
+                except Overloaded:
+                    overloads += 1
+            for f in futs.values():
+                try:
+                    f.exception(timeout_s)  # wait without re-raising
+                except Exception:  # noqa: BLE001 — counted below
+                    pass
+        wall = time.perf_counter() - t_wall0
+    finally:
+        batcher.close()
+
+    # -- collect what the tracing plane observed -----------------------
+    out_h = hashlib.sha256()
+    comp_h = hashlib.sha256()
+    latencies: list[float] = []
+    forward_ms = 0.0
+    errors = 0
+    served = 0
+    batch_first_seen: dict[str, int] = {}
+    composition: list[tuple] = []
+    for idx in sorted(futs):
+        f = futs[idx]
+        try:
+            err = f.exception(timeout_s)
+        except Exception as e:  # noqa: BLE001 — a future still RUNNING
+            # (wedged device forward survived close()'s join timeout)
+            # raises TimeoutError here; a report with the request
+            # counted as an error beats a traceback with no report
+            err = e
+        tr = getattr(f, "trace", None)
+        bd = tr.breakdown if tr is not None else {}
+        if err is not None:
+            errors += 1
+            continue
+        served += 1
+        res = f.result(0)
+        arr = np.asarray(res)
+        out_h.update(str(arr.shape).encode())
+        out_h.update(str(arr.dtype).encode())
+        out_h.update(arr.tobytes())
+        if bd:
+            latencies.append(bd["total_ms"])
+            forward_ms += bd.get("forward_ms") or 0.0
+            bid = bd.get("batch_trace_id") or "?"
+            batch = batch_first_seen.setdefault(
+                bid, len(batch_first_seen)
+            )
+            composition.append(
+                (idx, batch, bd.get("batch_size"),
+                 str(bd.get("bucket")))
+            )
+    comp_h.update(json.dumps(composition).encode())
+    latencies.sort()
+
+    c1 = {name: counter(name) for name in c0}
+    rows_d = c1["sbt_serving_rows_total"] - c0["sbt_serving_rows_total"]
+    pad_d = (c1["sbt_serving_padding_rows_total"]
+             - c0["sbt_serving_padding_rows_total"])
+    flops_d = (c1["sbt_serving_flops_total"]
+               - c0["sbt_serving_flops_total"])
+    pad_flops_d = (c1["sbt_serving_padding_flops_total"]
+                   - c0["sbt_serving_padding_flops_total"])
+    padded_total = rows_d + pad_d
+    padding = {
+        "rows": pad_d,
+        "rows_total": padded_total,
+        "waste_rows_frac": (round(pad_d / padded_total, 6)
+                            if padded_total else None),
+        "flops": pad_flops_d or None,
+        "flops_total": flops_d or None,
+        "waste_flops_frac": (round(pad_flops_d / flops_d, 6)
+                             if flops_d else None),
+    }
+
+    import jax
+
+    live = (registry.executor(model_name) if registry is not None
+            else executor)
+    return {
+        "metric": "workload_replay",
+        "schema": REPLAY_SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "mode": mode,
+        "speed": speed,
+        "seed": seed,
+        "workload": workload.summary(),
+        "workload_digest": workload_digest(workload),
+        # the output-digest baseline gate requires these to match too:
+        # payload bytes come from the seed, composition from the
+        # batcher knobs — differing ones mean a DIFFERENT experiment,
+        # not a determinism breach
+        "batcher": {
+            "max_delay_ms": max_delay_ms,
+            "idle_flush_ms": idle_flush_ms,
+            "max_batch_rows": max_batch_rows,
+            "max_queue": max_queue,
+        },
+        "burst": burst,
+        "swaps": swaps_done,
+        "n_requests": n,
+        "served": served,
+        "errors": errors,
+        "overloads": overloads,
+        "batches": int(c1["sbt_serving_batches_total"]
+                       - c0["sbt_serving_batches_total"]),
+        "post_warmup_compiles": int(
+            c1["sbt_serving_compiles_total"]
+            - c0["sbt_serving_compiles_total"]
+            - swap_compiles
+        ),
+        "swap_compiles": int(swap_compiles),
+        "wall_seconds": round(wall, 6),
+        "rps": round(served / wall, 2) if wall > 0 else None,
+        "latency_ms": {
+            "p50": _percentile(latencies, 0.50),
+            "p95": _percentile(latencies, 0.95),
+            "p99": _percentile(latencies, 0.99),
+            "max": latencies[-1] if latencies else None,
+        },
+        "forward_ms_total": round(forward_ms, 3),
+        "padding": padding,
+        "model": {
+            "name": getattr(live, "model_name", None),
+            "version": getattr(live, "model_version", None),
+        },
+        "composition_digest": comp_h.hexdigest(),
+        "output_digest": out_h.hexdigest(),
+    }
+
+
+def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
+    """Median-of-``repeats`` replay (the BENCH protocol: thread noise
+    on small hosts swings single runs; the median is the stable
+    center). Composition/output digests must be IDENTICAL across
+    repeats — that is the determinism contract, and a mismatch raises
+    rather than gating on garbage (virtual mode only: timed mode is
+    documented non-deterministic, so its repeats merge timing without
+    the cross-repeat identity assertions). Timing fields merge
+    element-wise: median rps/wall, median of each latency percentile.
+    The returned report carries ``repeats`` plus the per-run rps
+    spread."""
+    import statistics
+
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    runs = [replay(workload, **kwargs) for _ in range(repeats)]
+    head = runs[0]
+    if head["mode"] == "virtual":
+        for r in runs[1:]:
+            for key in ("composition_digest", "output_digest",
+                        "post_warmup_compiles", "served", "overloads",
+                        "batches"):
+                if r[key] != head[key]:
+                    raise AssertionError(
+                        f"determinism violation across repeats: {key} "
+                        f"changed ({head[key]!r} -> {r[key]!r})"
+                    )
+    merged = dict(head)
+    merged["repeats"] = repeats
+    merged["rps_runs"] = sorted(r["rps"] for r in runs)
+    merged["rps"] = round(statistics.median(merged["rps_runs"]), 2)
+    merged["wall_seconds"] = round(
+        statistics.median(r["wall_seconds"] for r in runs), 6
+    )
+    merged["forward_ms_total"] = round(
+        statistics.median(r["forward_ms_total"] for r in runs), 3
+    )
+    merged["latency_ms"] = {
+        q: (statistics.median(vals) if None not in vals else None)
+        for q in head["latency_ms"]
+        for vals in [[r["latency_ms"][q] for r in runs]]
+    }
+    return merged
+
+
+def check_report(report: dict, *, spec=None, baseline: dict | None = None,
+                 rps_tolerance: float | None = None,
+                 latency_tolerance: float | None = None):
+    """Gate a replay report: absolute SLO spec plus (optionally) the
+    baseline regression bands. Returns one combined
+    :class:`telemetry.slo.SLOResult`."""
+    from spark_bagging_tpu.telemetry import slo
+
+    if spec is None:
+        spec = slo.SLOSpec()
+    checks = list(slo.evaluate(spec, report).checks)
+    kind = "absolute"
+    if baseline is not None:
+        kw = {}
+        if rps_tolerance is not None:
+            kw["rps_tolerance"] = rps_tolerance
+        if latency_tolerance is not None:
+            kw["latency_tolerance"] = latency_tolerance
+        checks += slo.compare_to_baseline(report, baseline, **kw).checks
+        kind = "absolute+baseline"
+    return slo.SLOResult(checks, kind=kind)
+
+
+def _default_model(width: int, n_estimators: int, seed: int = 0):
+    """Self-contained CLI target: a seeded synthetic logistic bag (the
+    serving bench's shape, scaled down)."""
+    import numpy as np
+
+    from spark_bagging_tpu import BaggingClassifier, LogisticRegression
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(512, width)).astype(np.float32)
+    w = rng.normal(size=width)
+    y = (X @ w > 0).astype(np.int32)
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=n_estimators, seed=seed,
+    ).fit(X, y)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic workload replay + SLO gate"
+    )
+    src = ap.add_argument_group("workload source")
+    src.add_argument("--workload", default=None,
+                     help="a *.workload.jsonl captured by "
+                          "telemetry.workload (default: synthetic)")
+    src.add_argument("--synthetic", default="poisson",
+                     choices=("poisson", "bursty", "diurnal"))
+    src.add_argument("--rate", type=float, default=200.0,
+                     help="synthetic arrival rate (rps)")
+    src.add_argument("--duration", type=float, default=1.0,
+                     help="synthetic duration (virtual seconds)")
+    src.add_argument("--rows", type=int, default=1,
+                     help="rows per synthetic request")
+    src.add_argument("--width", type=int, default=16,
+                     help="synthetic feature width")
+    src.add_argument("--seed", type=int, default=0,
+                     help="workload + payload seed (the determinism "
+                          "contract's other half)")
+    src.add_argument("--save-workload", default=None,
+                     help="also write the workload file used")
+
+    drv = ap.add_argument_group("drive")
+    drv.add_argument("--mode", default="virtual",
+                     choices=("virtual", "timed"))
+    drv.add_argument("--speed", type=float, default=1.0,
+                     help="timed-mode time compression factor")
+    drv.add_argument("--burst", type=int, default=0,
+                     help="inject N extra simultaneous requests")
+    drv.add_argument("--burst-at", type=float, default=0.5)
+    drv.add_argument("--swaps", type=int, default=0,
+                     help="hot-swap the model N times mid-replay")
+    drv.add_argument("--max-delay-ms", type=float, default=2.0)
+    drv.add_argument("--idle-flush-ms", type=float, default=1.0)
+    drv.add_argument("--max-batch-rows", type=int, default=256)
+    drv.add_argument("--max-queue", type=int, default=1024)
+    drv.add_argument("--repeats", type=int, default=3,
+                     help="median-of-N timing protocol (composition "
+                          "and outputs are asserted identical across "
+                          "repeats)")
+
+    tgt = ap.add_argument_group("target model")
+    tgt.add_argument("--model-checkpoint", default=None,
+                     help="serve this checkpoint dir instead of the "
+                          "built-in synthetic bag")
+    tgt.add_argument("--n-estimators", type=int, default=8)
+    tgt.add_argument("--min-bucket-rows", type=int, default=8)
+    tgt.add_argument("--bucket-max-rows", type=int, default=256)
+    tgt.add_argument("--throttle-ms", type=float, default=0.0,
+                     help="inject a fixed per-forward delay (gate "
+                          "self-test: a clean baseline plus "
+                          "--throttle-ms must exit nonzero)")
+
+    gate = ap.add_argument_group("report / gate")
+    gate.add_argument("--out", default=None,
+                      help="report JSON path (default: "
+                           "replay_report.json in $SBT_TELEMETRY_DIR)")
+    gate.add_argument("--check", action="store_true",
+                      help="evaluate the SLO gate; exit 2 on violation")
+    gate.add_argument("--slo", default=None,
+                      help="SLO spec JSON (default: zero post-warmup "
+                           "compiles only)")
+    gate.add_argument("--baseline", default=None,
+                      help="previous report JSON to regression-diff "
+                           "against")
+    args = ap.parse_args(argv)
+
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.telemetry import slo as slo_mod
+    from spark_bagging_tpu.telemetry import workload as workload_mod
+    from spark_bagging_tpu.serving import ModelRegistry
+
+    if args.workload:
+        wl = workload_mod.load_workload(args.workload)
+        width = next(
+            (r.width for r in wl.requests if r.width is not None),
+            args.width,
+        )
+    else:
+        wl = workload_mod.synthetic_workload(
+            args.synthetic, rate_rps=args.rate,
+            duration_s=args.duration, seed=args.seed, rows=args.rows,
+            width=args.width,
+            bucket_bounds=(args.min_bucket_rows, args.bucket_max_rows),
+        )
+        width = args.width
+    if args.save_workload:
+        wl.save(args.save_workload)
+
+    reg = ModelRegistry(
+        min_bucket_rows=args.min_bucket_rows,
+        max_batch_rows=args.bucket_max_rows,
+    )
+    if args.model_checkpoint:
+        reg.load("replay", args.model_checkpoint, warm=True)
+    else:
+        reg.register(
+            "replay",
+            _default_model(width, args.n_estimators, seed=args.seed),
+            warmup=True,
+        )
+
+    target: dict = {"registry": reg, "model_name": "replay"}
+    if args.throttle_ms > 0:
+        if args.swaps:
+            ap.error("--throttle-ms wraps a bare executor; it cannot "
+                     "combine with --swaps (a registry operation)")
+        target = {"executor": ThrottledExecutor(
+            reg.executor("replay"), delay_s=args.throttle_ms / 1e3,
+        )}
+
+    report = replay_median(
+        wl, repeats=args.repeats, **target,
+        mode=args.mode, speed=args.speed,
+        burst=args.burst, burst_at=args.burst_at, swaps=args.swaps,
+        max_delay_ms=args.max_delay_ms,
+        idle_flush_ms=args.idle_flush_ms,
+        max_batch_rows=args.max_batch_rows,
+        max_queue=args.max_queue,
+        seed=args.seed,
+    )
+
+    out = args.out or os.path.join(
+        telemetry.telemetry_dir(), "replay_report.json"
+    )
+    result = None
+    if args.check:
+        spec = (slo_mod.SLOSpec.load(args.slo) if args.slo
+                else slo_mod.SLOSpec())
+        baseline = None
+        if args.baseline:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        result = check_report(report, spec=spec, baseline=baseline)
+        report["slo"] = result.to_dict()
+
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        k: report[k] for k in (
+            "mode", "n_requests", "served", "overloads", "batches",
+            "post_warmup_compiles", "rps", "latency_ms", "swaps",
+        )
+    }))
+    print(f"report: {out}")
+    if result is not None:
+        print(result.render())
+        return 0 if result.ok else 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

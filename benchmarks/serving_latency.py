@@ -248,6 +248,23 @@ def main() -> int:
         "sbt_serving_compiles_total"
     ).value - compiles_after_warmup
 
+    # first-class visibility for the low-concurrency story (ROADMAP
+    # item 3: micro-batching currently LOSES to naive dispatch at
+    # concurrency 1): surface the ratio as its own top-level key and a
+    # stdout line so the trajectory is diffable run-over-run. No hard
+    # gate yet — the number is the work item, not a regression.
+    conc1 = next(
+        (lvl for lvl in result["levels"] if lvl["concurrency"] == 1),
+        None,
+    )
+    if conc1 is not None:
+        result["served_vs_naive_concurrency1"] = conc1["speedup_rps"]
+        print(
+            f"concurrency-1 served-vs-naive: {conc1['speedup_rps']}x "
+            f"(served {conc1['served']['rps']} rps vs naive "
+            f"{conc1['naive']['rps']} rps; >= 1.0 is the open target)"
+        )
+
     # telemetry artifact: a short instrumented burst — the final
     # metrics snapshot carries the CUMULATIVE serving counters from
     # everything above (the registry is process-wide)

@@ -15,6 +15,11 @@ With the live observability plane (scrape it while it runs):
     curl :9100/healthz        # batcher liveness + live model version
     curl :9100/metrics        # Prometheus text, sbt_serving_* series
     curl :9100/varz           # JSON snapshot incl. latency quantiles
+
+The traffic is also CAPTURED as a replayable workload file — the
+record half of record→replay→report; replay it afterwards with:
+
+    python -m benchmarks.replay --workload telemetry/example09.workload.jsonl --check
 """
 
 import os
@@ -65,6 +70,8 @@ def client(cid: int, batcher) -> None:
         results[cid] = ok
 
 
+recorder = telemetry.workload.record()  # capture the arrival stream
+
 with registry.batcher("cancer", max_delay_ms=2.0, max_queue=512) as b:
     threads = [
         threading.Thread(target=client, args=(c, b))
@@ -96,3 +103,13 @@ print(f"batches         : {int(reg.counter('sbt_serving_batches_total').value)}"
       " requests/forward)")
 print(f"compiles        : {int(reg.counter('sbt_serving_compiles_total').value)}"
       " (all during warmup/swap — zero per-request)")
+
+# -- the captured workload: this traffic is now a regression test -----
+captured = telemetry.workload.stop()
+wl_path = os.path.join(telemetry.telemetry_dir(),
+                       "example09.workload.jsonl")
+captured.save(wl_path)
+print(f"workload        : {captured.n_requests} arrivals over "
+      f"{captured.duration_s:.2f}s -> {wl_path}")
+print("replay it       : python -m benchmarks.replay "
+      f"--workload {wl_path} --check")

@@ -30,6 +30,20 @@ Contracts that matter under load:
   events carry the ids, and batch failures / overload rejections emit
   flight-recorder trigger events. All of it vanishes when telemetry
   is disabled (``future.trace is None``).
+- **The arrival stream is capturable.** While an arrival consumer is
+  active (a recording ``telemetry.workload.WorkloadRecorder`` or an
+  open ``capture()`` window), ``submit()`` also emits one
+  ``serving_request`` event (rows, width, dtype, bucket, queue depth,
+  monotonic arrival stamp) — the stream the workload recorder
+  serializes into replayable ``*.workload.jsonl`` files. No consumer,
+  no event, no cost — an armed flight recorder alone does not count
+  (it deliberately ignores arrival events).
+- **Replay can step it deterministically.** ``threaded=False`` starts
+  no worker thread; the owner drives batching explicitly with
+  :meth:`run_pending`, which drains the queue into batches by the
+  same row rule the worker uses — but on the caller's thread, with no
+  timing dependence, so a replay harness gets identical batch
+  composition (and therefore bitwise-identical outputs) on every run.
 """
 
 from __future__ import annotations
@@ -44,6 +58,7 @@ import numpy as np
 
 from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.serving.buckets import bucket_for
 from spark_bagging_tpu.telemetry import tracing
 
 _SHUTDOWN = object()
@@ -95,6 +110,10 @@ class MicroBatcher:
     zero extra coalescing, so the default flushes fast; raise it toward
     ``max_delay_ms`` when clients are open-loop and stragglers trickle
     in, lower it to 0 to launch the instant the queue empties.
+
+    ``threaded=False`` is stepped mode: no worker thread runs, and the
+    owner serves queued requests synchronously via :meth:`run_pending`
+    (the deterministic-replay seam — see ``benchmarks/replay.py``).
     """
 
     def __init__(
@@ -105,6 +124,7 @@ class MicroBatcher:
         max_batch_rows: int = 2048,
         max_queue: int = 256,
         idle_flush_ms: float = 0.25,
+        threaded: bool = True,
     ):
         if max_delay_ms < 0 or idle_flush_ms < 0:
             raise ValueError(
@@ -124,6 +144,17 @@ class MicroBatcher:
         ex0 = self._resolve()
         self._n_features = int(ex0.n_features)
         self._task = ex0.task
+        # bucket-ladder snapshot for the arrival-stream events: swap
+        # validation keeps task/width invariant per entry, and bucket
+        # bounds are registry-sticky options, so capture-time bucket
+        # attribution from this snapshot stays honest across swaps
+        # (plain callables without a ladder record bucket=None)
+        if hasattr(ex0, "min_bucket_rows") and hasattr(
+                ex0, "max_batch_rows"):
+            self._bucket_bounds = (int(ex0.min_bucket_rows),
+                                   int(ex0.max_batch_rows))
+        else:
+            self._bucket_bounds = None
         self.max_delay_s = max_delay_ms / 1e3
         self.idle_flush_s = idle_flush_ms / 1e3
         self.max_batch_rows = int(max_batch_rows)
@@ -137,10 +168,12 @@ class MicroBatcher:
         # the first forward compiles) gets the full STALL_S grace
         # before /healthz calls it a stall
         self._t_last_batch: float = time.monotonic()
-        self._worker = threading.Thread(
-            target=self._loop, daemon=True, name="serving-batcher"
-        )
-        self._worker.start()
+        self._worker: threading.Thread | None = None
+        if threaded:
+            self._worker = threading.Thread(
+                target=self._loop, daemon=True, name="serving-batcher"
+            )
+            self._worker.start()
         # deferred import: the health registry lives in the exposition
         # server module, whose http.server import chain (~100ms) only
         # serving processes should pay. Register AFTER the worker
@@ -206,6 +239,24 @@ class MicroBatcher:
             telemetry.inc("sbt_serving_requests_total")
             telemetry.set_gauge("sbt_serving_queue_depth",
                                 self._q.qsize())
+            if telemetry.arrival_events_wanted():
+                # the capturable arrival stream (workload recorders,
+                # open capture files): dict built only when a consumer
+                # is listening — an always-armed flight recorder alone
+                # (the standard serving deployment) costs nothing here
+                bucket = None
+                if self._bucket_bounds is not None:
+                    bucket = bucket_for(req.n, *self._bucket_bounds)
+                telemetry.emit_event({
+                    "kind": "serving_request",
+                    "rows": req.n,
+                    "width": self._n_features,
+                    "dtype": str(req.X.dtype),
+                    "bucket": bucket,
+                    "queue_depth": self._q.qsize(),
+                    "trace_id": trace.trace_id if trace else None,
+                    "t_mono": time.monotonic(),
+                })
         return req.future
 
     def predict(self, X, timeout: float | None = 30.0) -> np.ndarray:
@@ -238,7 +289,10 @@ class MicroBatcher:
         batchers all report unhealthy so a load balancer stops routing
         here."""
         depth = self._q.qsize()
-        alive = self._worker.is_alive()
+        # stepped mode has no worker by design: liveness there is just
+        # "not closed" (the owner serves on its own thread)
+        alive = (self._worker.is_alive() if self._worker is not None
+                 else not self._closed)
         age = time.monotonic() - self._t_last_batch
         stalled = depth >= self._q.maxsize and age > self.STALL_S
         return {
@@ -288,7 +342,8 @@ class MicroBatcher:
             self._q.put_nowait(_SHUTDOWN)
         except Full:
             pass
-        self._worker.join(timeout)
+        if self._worker is not None:
+            self._worker.join(timeout)
         # anything still queued was never forwarded — fail it loudly
         while True:
             try:
@@ -319,6 +374,49 @@ class MicroBatcher:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # -- stepped mode (deterministic replay) ---------------------------
+
+    def run_pending(self, max_batches: int | None = None) -> int:
+        """Serve everything queued, synchronously, on THIS thread.
+
+        Stepped-mode (``threaded=False``) counterpart of the worker
+        loop: drains the queue into batches by the same row rule
+        (gather until ``max_batch_rows``; one request may overshoot,
+        exactly like the worker) and runs each through
+        :meth:`_run_batch` — real padding, real tracing, real
+        telemetry. What it deliberately does NOT have is the worker's
+        clock: batch composition is a pure function of the submission
+        order, which is what makes ``same capture + same seed ⇒
+        identical batches, bitwise-identical outputs`` a contract the
+        replay harness can assert rather than hope for. Returns the
+        number of batches served.
+        """
+        if self._worker is not None:
+            raise RuntimeError(
+                "run_pending() is stepped-mode only; this batcher "
+                "runs a worker thread (construct with threaded=False)"
+            )
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        ran = 0
+        while max_batches is None or ran < max_batches:
+            batch: list = []
+            rows = 0
+            while rows < self.max_batch_rows:
+                try:
+                    req = self._q.get_nowait()
+                except Empty:
+                    break
+                if req is _SHUTDOWN:
+                    continue
+                batch.append(req)
+                rows += req.n
+            if not batch:
+                break
+            self._run_batch(batch)
+            ran += 1
+        return ran
 
     # -- worker side ---------------------------------------------------
 

@@ -39,6 +39,30 @@ from spark_bagging_tpu.serving.buckets import (
 )
 
 
+def _compiled_cost(compiled: Any) -> dict[str, float | None]:
+    """FLOPs / bytes-accessed for one compiled executable, from XLA's
+    ``cost_analysis()``, normalized across jax vintages (plain dict in
+    recent releases, per-device list-of-dict in 0.4.x). Best-effort:
+    backends that report nothing yield ``None`` values — cost
+    attribution degrades to rows, it never breaks a compile."""
+    flops: float | None = None
+    nbytes: float | None = None
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        if isinstance(analysis, dict):
+            f = analysis.get("flops")
+            b = analysis.get("bytes accessed")
+            if f is not None and float(f) > 0:
+                flops = float(f)
+            if b is not None and float(b) > 0:
+                nbytes = float(b)
+    except Exception:  # noqa: BLE001 — optional instrumentation only
+        pass
+    return {"flops": flops, "bytes": nbytes}
+
+
 # sbt-lint: shared-state
 class EnsembleExecutor:
     """Serve one fitted bagging estimator with bucketed AOT compiles.
@@ -81,6 +105,11 @@ class EnsembleExecutor:
         self._subspaces = subspaces
         self._donate = bool(donate_input)
         self._compiled: dict[int, Any] = {}
+        # bucket -> {"flops", "bytes"} from compiled.cost_analysis()
+        # at build time (None values when the backend reports none):
+        # the cost denominator that turns the padding-waste gauge from
+        # rows into FLOPs
+        self.bucket_costs: dict[int, dict[str, float | None]] = {}
         self._build_lock = make_lock("serving.executor.build")
         # stamped by ModelRegistry on register/swap; standalone
         # executors serve as anonymous version None
@@ -133,6 +162,16 @@ class EnsembleExecutor:
             telemetry.inc("sbt_serving_compiles_total")
             telemetry.observe("sbt_serving_compile_seconds",
                               time.perf_counter() - t0)
+            cost = _compiled_cost(compiled)
+            self.bucket_costs[bucket] = cost
+            if telemetry.enabled():
+                labels = {"bucket": str(bucket)}
+                if cost["flops"] is not None:
+                    telemetry.set_gauge("sbt_serving_bucket_cost_flops",
+                                        cost["flops"], labels=labels)
+                if cost["bytes"] is not None:
+                    telemetry.set_gauge("sbt_serving_bucket_cost_bytes",
+                                        cost["bytes"], labels=labels)
             self._compiled[bucket] = compiled
             return compiled
 
@@ -175,6 +214,14 @@ class EnsembleExecutor:
             telemetry.inc("sbt_serving_padding_rows_total",
                           float(bucket - n))
             telemetry.observe("sbt_serving_batch_fill_ratio", n / bucket)
+            flops = self.bucket_costs.get(bucket, {}).get("flops")
+            if flops:
+                # rows are interchangeable within a bucket's program,
+                # so padding's FLOP share is its row share — waste in
+                # compute terms, not just rows
+                telemetry.inc("sbt_serving_flops_total", flops)
+                telemetry.inc("sbt_serving_padding_flops_total",
+                              (bucket - n) / bucket * flops)
         # attach the bucket choice to whatever request/batch trace is
         # current (slab-split oversize batches annotate once per slab)
         tracing.annotate(bucket=bucket)

@@ -60,6 +60,7 @@ from spark_bagging_tpu.telemetry.sinks import (
     SCHEMA_VERSION,
     Run,
     capture,
+    capture_open as _capture_open,
     current_run,
     default_log_path,
     last_metrics_snapshot,
@@ -69,7 +70,7 @@ from spark_bagging_tpu.telemetry.sinks import (
 )
 from spark_bagging_tpu.telemetry.spans import phase, span
 from spark_bagging_tpu.telemetry.state import STATE as _state
-from spark_bagging_tpu.telemetry import recorder, tracing
+from spark_bagging_tpu.telemetry import recorder, slo, tracing, workload
 
 # the exposition server's names resolve lazily (module __getattr__
 # below): its http.server import chain costs ~100ms of stdlib, which
@@ -83,7 +84,8 @@ __all__ = [
     "observe", "emit_event", "registry", "render_prometheus",
     "read_events", "last_metrics_snapshot", "runs",
     "record_fit_report", "Registry", "reset", "telemetry_dir",
-    "default_log_path", "tracing", "recorder", "start_server",
+    "default_log_path", "tracing", "recorder", "workload", "slo",
+    "sinks_active", "arrival_events_wanted", "start_server",
     "stop_server", "server_address",
 ]
 
@@ -113,6 +115,25 @@ def set_device_sync(on: bool) -> None:
 
 def device_sync_enabled() -> bool:
     return _state.device_sync
+
+
+def sinks_active() -> bool:
+    """True when at least one event sink is attached (an open capture,
+    the armed flight recorder, a workload recorder)."""
+    return bool(_state._sinks)
+
+
+def arrival_events_wanted() -> bool:
+    """True when a sink that actually CONSUMES ``serving_request``
+    arrival events is attached: a recording workload recorder or an
+    open ``capture()`` window. The batcher's submit path gates event
+    construction on this rather than on :func:`sinks_active` — the
+    standard serving deployment keeps the flight recorder armed for
+    its whole lifetime, and that sink deliberately ignores arrival
+    events, so gating on "any sink" would charge every request for a
+    dict nothing reads. Runs per submit: no imports, two module-int
+    reads."""
+    return workload.capture_active() or _capture_open()
 
 
 def registry() -> Registry:

@@ -95,6 +95,14 @@ class FlightRecorder:
 
     def emit(self, event: dict) -> None:
         """Record one event; dump if it is (or completes) a trigger."""
+        if event.get("kind") == "serving_request":
+            # the per-request arrival stream (workload capture, PR 6)
+            # is the highest-rate event in the process and carries no
+            # forensic value the enqueue span doesn't: ringing it
+            # would evict the span/error window — the thing a flight
+            # dump exists to preserve — in under a second of real
+            # traffic. Workload recorders subscribe separately.
+            return
         trigger: dict | None = None
         with self._lock:
             self._ring.append(event)

@@ -74,8 +74,14 @@ SERIES_HELP: dict[str, str] = {
     "sbt_serving_swap_rejected_total": "Hot swaps rejected by contract validation",
     "sbt_serving_model_version": "Live model version per registered name (gauge)",
     "sbt_serving_batch_errors_total": "Micro-batches failed by an executor error",
+    "sbt_serving_bucket_cost_flops": "Compiled FLOPs per forward at this bucket (gauge, label bucket)",
+    "sbt_serving_bucket_cost_bytes": "Compiled bytes accessed per forward at this bucket (gauge, label bucket)",
+    "sbt_serving_flops_total": "FLOPs dispatched by serving forwards (cost-analysis attributed)",
+    "sbt_serving_padding_flops_total": "FLOPs spent on padding rows (waste, cost-analysis attributed)",
     "sbt_flight_dumps_total": "Flight-recorder dumps written",
     "sbt_flight_dumps_suppressed_total": "Flight-recorder dumps suppressed by cooldown",
+    "sbt_process_uptime_seconds": "Seconds since the exposition server started (gauge)",
+    "sbt_process_rss_bytes": "Resident set size of this process (gauge, sampled at scrape)",
 }
 
 

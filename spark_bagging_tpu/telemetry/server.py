@@ -18,7 +18,10 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
 - ``GET /debug/spans`` — recent span events from the flight
   recorder's ring (``?trace_id=`` filters to one request's tree);
 - ``GET /debug/runs`` — the run registry (every ``capture()`` window
-  this process opened).
+  this process opened);
+- ``GET /debug/workload`` — the active workload recorder's capture
+  summary (request count, duration, rps, epochs) while recording is
+  on — the live view of the record half of record→replay→report.
 
 Opt-in, two ways: ``telemetry.start_server(port)`` from code, or the
 ``SBT_METRICS_PORT`` environment variable (checked at package import;
@@ -124,15 +127,35 @@ def health_report() -> dict[str, Any]:
     return {"healthy": healthy, "sources": sources}
 
 
+def _refresh_process_gauges() -> tuple[float | None, int | None]:
+    """Sample uptime + RSS and mirror them as ``sbt_process_*``
+    registry gauges. Called from BOTH exposition routes — a
+    Prometheus deployment that only ever scrapes ``/metrics`` (the
+    normal setup) must see fresh values, not ones frozen at the last
+    manual ``/varz`` curl. Returns the pair for ``/varz``'s JSON."""
+    from spark_bagging_tpu.telemetry.state import STATE
+    from spark_bagging_tpu.utils.memory import host_rss_bytes
+
+    uptime = (time.monotonic() - _t_start
+              if _t_start is not None else None)
+    rss = host_rss_bytes()
+    if STATE.enabled:
+        if uptime is not None:
+            STATE.registry.set("sbt_process_uptime_seconds", uptime)
+        if rss is not None:
+            STATE.registry.set("sbt_process_rss_bytes", float(rss))
+    return uptime, rss
+
+
 def _varz() -> dict[str, Any]:
     from spark_bagging_tpu.telemetry.state import STATE
 
+    uptime, rss = _refresh_process_gauges()
     return {
         "ts": time.time(),
         "pid": os.getpid(),
-        "uptime_seconds": (
-            time.monotonic() - _t_start if _t_start is not None else None
-        ),
+        "uptime_seconds": uptime,
+        "rss_bytes": rss,
         "telemetry_enabled": STATE.enabled,
         "health": health_report(),
         "metrics": STATE.registry.snapshot(quantiles=True),
@@ -164,6 +187,19 @@ def _debug_spans(query: dict[str, list[str]]) -> dict[str, Any]:
     # limit=0 must mean "none", but spans[-0:] slices from the START
     # and would return the whole ring
     return {"spans": spans[-limit:] if limit else []}
+
+
+def _debug_workload() -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import workload
+
+    rec = workload.active()
+    if rec is None:
+        return {
+            "recording": False,
+            "note": "no workload recorder active; start one with "
+                    "telemetry.workload.record()",
+        }
+    return rec.summary()
 
 
 def _debug_runs() -> dict[str, Any]:
@@ -198,6 +234,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 from spark_bagging_tpu.telemetry.state import STATE
 
+                _refresh_process_gauges()
                 body = render_prometheus(STATE.registry.snapshot())
                 self._send(200, body, "text/plain; version=0.0.4")
             elif url.path == "/healthz":
@@ -209,11 +246,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _debug_spans(query))
             elif url.path == "/debug/runs":
                 self._send_json(200, _debug_runs())
+            elif url.path == "/debug/workload":
+                self._send_json(200, _debug_workload())
             elif url.path == "/":
                 self._send_json(200, {
                     "endpoints": [
                         "/metrics", "/healthz", "/varz",
                         "/debug/spans", "/debug/runs",
+                        "/debug/workload",
                     ],
                 })
             else:

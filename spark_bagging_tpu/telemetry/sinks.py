@@ -138,6 +138,13 @@ def current_run() -> Run | None:
         return _active[-1] if _active else None
 
 
+def capture_open() -> bool:
+    """True while any ``capture()`` window is open. Lock-free read of
+    the active list's truthiness — this sits on per-request gates
+    (serving arrival events), where a benign race beats a lock."""
+    return bool(_active)
+
+
 @contextmanager
 def capture(
     path: str | None = None,

@@ -30,6 +30,8 @@ TPU compile per candidate chunk — slower than the fit it protects.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 SAFETY = 0.35
@@ -51,6 +53,33 @@ def device_memory_budget(safety: float = SAFETY) -> float:
         free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
         return max(free, 0) * safety
     return FALLBACK_BUDGET_BYTES * safety
+
+
+def host_rss_bytes() -> int | None:
+    """Current resident set size of THIS process, or None when the
+    platform exposes neither ``/proc`` nor ``getrusage``.
+
+    ``/proc/self/statm`` gives the live value on Linux (field 2 is
+    resident pages); the ``ru_maxrss`` fallback is the lifetime PEAK
+    (kilobytes on Linux, bytes on macOS) — still the right order of
+    magnitude for a leak-watch gauge, but biased HIGH: a peak never
+    shrinks, so after a transient allocation it over-reports current
+    RSS (a floor on the peak, not on what is resident now).
+    """
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(peak) if sys.platform == "darwin" else int(peak) * 1024
+    except Exception:  # noqa: BLE001 — observability must not raise
+        return None
 
 
 def auto_chunk_size(

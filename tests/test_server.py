@@ -226,6 +226,95 @@ def test_bad_env_port_warns_not_raises(monkeypatch):
         assert tserver.maybe_start_from_env() is None
 
 
+def test_debug_spans_trace_filter_under_concurrent_writers():
+    """PR-5 edge path: the ?trace_id= filter must never leak another
+    request's spans while the span ring is being written concurrently
+    — every span a filtered scrape returns belongs to the queried
+    trace (by trace_id or by batch links), under sustained writes."""
+    import threading
+
+    from spark_bagging_tpu.telemetry import tracing
+
+    port = tserver.start_server(0)  # arms the default flight recorder
+    ctxs = [tracing.request_context() for _ in range(4)]
+    stop = threading.Event()
+
+    def writer(ctx):
+        while not stop.is_set():
+            with tracing.use(ctx):
+                with telemetry.span("writer_span"):
+                    pass
+
+    threads = [threading.Thread(target=writer, args=(c,))
+               for c in ctxs]
+    for t in threads:
+        t.start()
+    try:
+        tid = ctxs[0].trace_id
+        saw_mine = 0
+        for _ in range(25):
+            status, body = _get(port, f"/debug/spans?trace_id={tid}")
+            assert status == 200
+            spans = json.loads(body)["spans"]
+            for s in spans:
+                assert (
+                    s.get("trace_id") == tid
+                    or tid in (s.get("links") or ())
+                ), f"foreign span leaked through the filter: {s}"
+            saw_mine += len(spans)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    assert saw_mine > 0, "filter returned nothing for a live writer"
+
+
+def test_varz_reports_rss_and_uptime():
+    port = tserver.start_server(0)
+    # /metrics FIRST: a Prometheus deployment that never touches
+    # /varz must still get fresh process gauges (the scrape itself
+    # samples them — they cannot depend on a prior /varz call)
+    status, metrics = _get(port, "/metrics")
+    assert status == 200
+    assert "sbt_process_rss_bytes" in metrics
+    assert "sbt_process_uptime_seconds" in metrics
+    assert ("# HELP sbt_process_rss_bytes Resident set size"
+            in metrics)
+    status, body = _get(port, "/varz")
+    assert status == 200
+    v = json.loads(body)
+    assert v["uptime_seconds"] >= 0
+    assert v["rss_bytes"] and v["rss_bytes"] > 1024 * 1024  # > 1 MiB
+
+
+def test_debug_workload_route(clf):
+    X = clf._test_X
+    port = tserver.start_server(0)
+    status, body = _get(port, "/debug/workload")
+    assert status == 200
+    assert json.loads(body)["recording"] is False
+
+    telemetry.workload.record()
+    try:
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+        reg.register("m", clf, warmup=True)
+        with reg.batcher("m", max_delay_ms=2) as b:
+            futs = [b.submit(X[i:i + 2]) for i in range(6)]
+            for f in futs:
+                f.result(30)
+        status, body = _get(port, "/debug/workload")
+    finally:
+        wl = telemetry.workload.stop()
+    summary = json.loads(body)
+    assert summary["recording"] is True
+    assert summary["n_requests"] == 6
+    assert summary["total_rows"] == 12
+    assert wl.n_requests == 6
+    # stopped: the route reports idle again
+    status, body = _get(port, "/debug/workload")
+    assert json.loads(body)["recording"] is False
+
+
 def test_metrics_endpoint_renders_escaped_labels():
     telemetry.set_gauge(
         "sbt_serving_model_version", 3.0,

@@ -384,6 +384,31 @@ def test_overload_burst_dumps_once(tmp_path):
     )
 
 
+def test_failed_dump_releases_cooldown(tmp_path):
+    """PR-5 edge path: a dump that fails to write (bad dir, full disk)
+    must give back the cooldown stamp its trigger consumed — otherwise
+    one transient I/O failure silences every further trigger of that
+    kind for cooldown_s and the incident yields zero artifacts."""
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a FILE where the dump dir should be")
+    rec = recorder.FlightRecorder(dir=str(blocked), cooldown_s=300)
+    rec.arm()
+    try:
+        with pytest.warns(RuntimeWarning, match="failed to write"):
+            telemetry.emit_event({"kind": "swap_rejected", "model": "x"})
+        assert rec.dumps == []
+        # the disk "recovers"; the SAME kind re-triggers well inside
+        # what would have been the cooldown window
+        rec.dir = str(tmp_path / "ok")
+        telemetry.emit_event({"kind": "swap_rejected", "model": "x"})
+        assert len(rec.dumps) == 1
+        # and the successful dump re-establishes a REAL cooldown
+        telemetry.emit_event({"kind": "swap_rejected", "model": "x"})
+        assert len(rec.dumps) == 1
+    finally:
+        rec.disarm()
+
+
 def test_ring_buffer_is_bounded(tmp_path):
     rec = recorder.FlightRecorder(capacity=16, dir=str(tmp_path))
     rec.arm()

@@ -1,0 +1,440 @@
+"""Workload capture & format [ISSUE 6]: the record half of
+record→replay→report. Live capture off the serving arrival stream,
+the versioned *.workload.jsonl roundtrip, seeded synthetic generators
+(byte-identical per seed), and the SLO spec/verdict machinery the
+replay gate evaluates."""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.telemetry import slo, workload
+from spark_bagging_tpu.serving import EnsembleExecutor, MicroBatcher
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.reset()
+    telemetry.enable()
+
+
+@pytest.fixture(scope="module")
+def executor():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(96, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3),
+        n_estimators=4, seed=0,
+    ).fit(X, y)
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=32)
+    ex.warmup()
+    ex._test_X = X
+    return ex
+
+
+# -- live capture ------------------------------------------------------
+
+def test_recorder_captures_live_arrival_stream(executor):
+    X = executor._test_X
+    rec = workload.WorkloadRecorder()
+    rec.start()
+    try:
+        with MicroBatcher(executor, max_delay_ms=1) as b:
+            futs = [b.submit(X[i:i + 2]) for i in range(12)]
+            for f in futs:
+                f.result(30)
+    finally:
+        wl = rec.stop()
+    assert wl.n_requests == 12
+    assert wl.total_rows == 24
+    ts = [r.t for r in wl.requests]
+    assert ts[0] == 0.0  # re-based to the first arrival
+    assert ts == sorted(ts)
+    # bucket attribution from the executor's ladder snapshot
+    assert all(r.bucket == 8 for r in wl.requests)
+    assert all(r.width == 6 for r in wl.requests)
+    assert all(r.dtype == "float32" for r in wl.requests)
+    # stopped recorder is detached: later traffic must not append
+    with MicroBatcher(executor, max_delay_ms=1) as b:
+        b.submit(X[:2]).result(30)
+    assert rec.workload().n_requests == 12
+
+
+def test_direct_recorder_visible_to_live_view():
+    """A directly-constructed recorder (the documented alternative
+    when the default is busy) must be visible to active() — and
+    therefore to /debug/workload — while it records."""
+    assert workload.active() is None
+    rec = workload.WorkloadRecorder()
+    rec.start()
+    try:
+        assert workload.active() is rec
+    finally:
+        rec.stop()
+    assert workload.active() is None
+
+
+def test_instance_restart_begins_fresh_session():
+    """start() after stop() is a new session — entries, t0, epochs,
+    and aggregates reset (the stale-resume hazard, instance API)."""
+    rec = workload.WorkloadRecorder()
+    rec.start()
+    telemetry.emit_event({"kind": "serving_request", "rows": 5})
+    wl1 = rec.stop()
+    assert wl1.n_requests == 1
+    assert rec.workload().n_requests == 1  # readable until restart
+    rec.start()
+    try:
+        telemetry.emit_event({"kind": "serving_request", "rows": 7})
+    finally:
+        wl2 = rec.stop()
+    assert wl2.n_requests == 1
+    assert wl2.requests[0].rows == 7
+    assert wl2.requests[0].t == 0.0
+    assert rec.summary()["total_rows"] == 7
+
+
+def test_arrival_events_do_not_flood_the_flight_ring(executor):
+    """The flight recorder's forensic window must not ring the
+    per-request arrival stream — at production rates it would evict
+    the span/error context a dump exists to preserve. Both sinks see
+    the stream; only the workload recorder keeps it."""
+    from spark_bagging_tpu.telemetry import recorder as flight
+
+    X = executor._test_X
+    ring = flight.FlightRecorder(capacity=64)
+    ring.arm()
+    wrec = workload.WorkloadRecorder()
+    wrec.start()
+    try:
+        with MicroBatcher(executor, max_delay_ms=1) as b:
+            futs = [b.submit(X[i:i + 1]) for i in range(8)]
+            for f in futs:
+                f.result(30)
+    finally:
+        wl = wrec.stop()
+        ring.disarm()
+    assert wl.n_requests == 8
+    assert ring.events(kind="serving_request") == []
+    assert ring.events(kind="span")  # spans still ring
+
+
+def test_recorder_ignores_nonarrival_events():
+    rec = workload.WorkloadRecorder()
+    rec.start()
+    try:
+        telemetry.emit_event({"kind": "serving_batch_error"})
+        telemetry.emit_event({"kind": "span", "name": "x"})
+        telemetry.emit_event({"kind": "serving_request", "rows": 3})
+    finally:
+        wl = rec.stop()
+    assert wl.n_requests == 1
+    assert wl.requests[0].rows == 3
+
+
+def test_recorder_capacity_bounded_and_counted():
+    rec = workload.WorkloadRecorder(capacity=8)
+    rec.start()
+    try:
+        for i in range(20):
+            telemetry.emit_event({"kind": "serving_request", "rows": i})
+    finally:
+        wl = rec.stop()
+    assert wl.n_requests == 8
+    assert [r.rows for r in wl.requests][-1] == 19  # newest kept
+    assert rec.summary()["dropped"] == 12
+
+
+def test_no_arrival_events_without_a_consumer(executor):
+    """The cost contract: arrival events are built only for a sink
+    that consumes them. An armed flight recorder alone — the standard
+    serving deployment — must not flip the gate."""
+    from spark_bagging_tpu.telemetry import recorder as flight
+
+    X = executor._test_X
+    assert not telemetry.arrival_events_wanted()
+    ring = flight.FlightRecorder(capacity=64)
+    ring.arm()
+    try:
+        assert telemetry.sinks_active()  # a sink, but not a consumer
+        assert not telemetry.arrival_events_wanted()
+        with MicroBatcher(executor, max_delay_ms=1) as b:
+            b.submit(X[:2]).result(30)
+    finally:
+        ring.disarm()
+    # a workload recorder started AFTER the traffic saw nothing, and
+    # while recording it IS a consumer
+    rec = workload.WorkloadRecorder()
+    rec.start()
+    try:
+        assert telemetry.arrival_events_wanted()
+    finally:
+        assert rec.stop().n_requests == 0
+    assert not telemetry.arrival_events_wanted()
+
+
+def test_record_warns_when_telemetry_disabled():
+    """A capture session opened while telemetry is off would silently
+    stay empty — start() must say so."""
+    telemetry.disable()
+    try:
+        rec = workload.WorkloadRecorder()
+        with pytest.warns(RuntimeWarning, match="stay EMPTY"):
+            rec.start()
+        rec.stop()
+    finally:
+        telemetry.enable()
+
+
+def test_default_recorder_record_stop_active():
+    assert workload.active() is None
+    rec = workload.record()
+    try:
+        assert workload.active() is rec
+        with pytest.warns(RuntimeWarning, match="options"):
+            workload.record(capacity=5)  # options on a LIVE default warn
+        telemetry.emit_event({"kind": "serving_request", "rows": 1})
+    finally:
+        wl = workload.stop()
+    assert workload.active() is None
+    assert wl.n_requests == 1
+    # stop() RETIRES the default: the next record() is a fresh capture
+    # — no entries, t0 anchor, or epochs bleeding across sessions
+    assert workload.stop() is None
+    rec2 = workload.record()
+    try:
+        assert rec2 is not rec
+        telemetry.emit_event({"kind": "serving_request", "rows": 2})
+    finally:
+        wl2 = workload.stop()
+    assert wl2.n_requests == 1
+    assert wl2.requests[0].t == 0.0
+    # the INSTANCE-level stop() ends the session just as thoroughly:
+    # record() must not hand the stale recorder back
+    rec3 = workload.record()
+    telemetry.emit_event({"kind": "serving_request", "rows": 3})
+    rec3.stop()  # the natural call — it is public and returns the data
+    rec4 = workload.record(capacity=64)  # options apply: fresh creation
+    try:
+        assert rec4 is not rec3
+        assert rec4.capacity == 64
+    finally:
+        assert workload.stop().n_requests == 0
+
+
+# -- format ------------------------------------------------------------
+
+def test_save_load_roundtrip(tmp_path):
+    wl = workload.synthetic_workload(
+        "poisson", rate_rps=300, duration_s=0.2, seed=5, width=4,
+        bucket_bounds=(8, 32),
+    )
+    path = wl.save(str(tmp_path / "w.workload.jsonl"))
+    back = workload.load_workload(path)
+    assert back.source == "synthetic"
+    assert back.generator == "poisson"
+    assert back.seed == 5
+    assert [r.to_dict() for r in back.requests] == [
+        r.to_dict() for r in wl.requests
+    ]
+    # header is the first line and declares the body truthfully
+    first = json.loads(open(path).readline())
+    assert first["kind"] == "workload_header"
+    assert first["schema"] == workload.WORKLOAD_SCHEMA_VERSION
+    assert first["n_requests"] == wl.n_requests
+
+
+def test_load_rejects_bad_files(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text("")
+    with pytest.raises(ValueError, match="empty"):
+        workload.load_workload(str(p))
+    p.write_text('{"kind": "nope"}\n')
+    with pytest.raises(ValueError, match="workload_header"):
+        workload.load_workload(str(p))
+    p.write_text('{"kind": "workload_header", "schema": 999}\n')
+    with pytest.raises(ValueError, match="schema"):
+        workload.load_workload(str(p))
+    # truncated body vs header count must be loud
+    wl = workload.synthetic_workload(
+        "poisson", rate_rps=200, duration_s=0.2, seed=1
+    )
+    full = wl.save(str(tmp_path / "full.jsonl"))
+    lines = open(full).read().splitlines()
+    (tmp_path / "torn.jsonl").write_text("\n".join(lines[:-2]) + "\n")
+    with pytest.raises(ValueError, match="truncated"):
+        workload.load_workload(str(tmp_path / "torn.jsonl"))
+
+
+def test_epoch_assignment_marks_traffic_waves():
+    reqs = [workload.WorkloadRequest(t=t, rows=1, width=2)
+            for t in (0.0, 0.1, 0.2, 5.0, 5.1, 30.0)]
+    workload.assign_epochs(reqs, gap_s=1.0)
+    assert [r.epoch for r in reqs] == [0, 0, 0, 1, 1, 2]
+
+
+# -- synthetic generators ----------------------------------------------
+
+def test_synthetic_deterministic_per_seed():
+    a = workload.synthetic_workload("bursty", rate_rps=100,
+                                    duration_s=0.5, seed=9)
+    b = workload.synthetic_workload("bursty", rate_rps=100,
+                                    duration_s=0.5, seed=9)
+    c = workload.synthetic_workload("bursty", rate_rps=100,
+                                    duration_s=0.5, seed=10)
+    assert [r.to_dict() for r in a.requests] == [
+        r.to_dict() for r in b.requests
+    ]
+    assert [r.to_dict() for r in a.requests] != [
+        r.to_dict() for r in c.requests
+    ]
+
+
+def test_poisson_rate_roughly_honored():
+    wl = workload.synthetic_workload("poisson", rate_rps=1000,
+                                     duration_s=1.0, seed=0)
+    assert 800 <= wl.n_requests <= 1200  # ~4 sigma around 1000
+
+
+def test_bursty_adds_bursts_on_top_of_base():
+    base = workload.synthetic_workload("poisson", rate_rps=50,
+                                       duration_s=1.0, seed=2)
+    bursty = workload.synthetic_workload(
+        "bursty", rate_rps=50, duration_s=1.0, seed=2,
+        burst_every_s=0.25, burst_size=40,
+    )
+    assert bursty.n_requests >= base.n_requests + 4 * 40 - 40
+    # a burst is a dense cluster: some 10ms window holds >= burst_size
+    ts = np.array([r.t for r in bursty.requests])
+    counts = [
+        int(((ts >= t0) & (ts < t0 + 0.01)).sum())
+        for t0 in np.arange(0.0, 1.0, 0.005)
+    ]
+    assert max(counts) >= 40
+
+
+def test_diurnal_rate_swings():
+    wl = workload.synthetic_workload(
+        "diurnal", rate_rps=2000, duration_s=1.0, seed=4,
+        diurnal_depth=0.9,
+    )
+    ts = np.array([r.t for r in wl.requests])
+    # sin peaks in the first half-period and troughs in the second
+    first = int(((ts >= 0.0) & (ts < 0.5)).sum())
+    second = int((ts >= 0.5).sum())
+    assert first > 2 * second
+
+
+def test_rows_choices_and_bad_kind():
+    wl = workload.synthetic_workload(
+        "poisson", rate_rps=500, duration_s=0.3, seed=0,
+        rows=(1, 2, 4),
+    )
+    assert {r.rows for r in wl.requests} <= {1, 2, 4}
+    with pytest.raises(ValueError, match="unknown workload kind"):
+        workload.synthetic_workload("square-wave")
+    with pytest.raises(ValueError, match="rate_rps"):
+        workload.synthetic_workload("poisson", rate_rps=0)
+
+
+# -- SLO spec / verdicts -----------------------------------------------
+
+def _report(**over):
+    base = {
+        "rps": 1000.0,
+        "latency_ms": {"p50": 1.0, "p95": 2.0, "p99": 4.0},
+        "padding": {"waste_rows_frac": 0.4, "waste_flops_frac": 0.3},
+        "overloads": 0,
+        "post_warmup_compiles": 0,
+    }
+    base.update(over)
+    return base
+
+
+def test_slo_spec_roundtrip_and_unknown_fields(tmp_path):
+    spec = slo.SLOSpec(p99_ms=5.0, min_rps=100.0)
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec.to_dict()))
+    back = slo.SLOSpec.load(str(p))
+    assert back.to_dict() == spec.to_dict()
+    with pytest.raises(ValueError, match="unknown SLO spec fields"):
+        slo.SLOSpec.from_dict({"p42_ms": 1.0})
+
+
+def test_evaluate_passes_and_fails_per_criterion():
+    spec = slo.SLOSpec(p50_ms=2.0, p99_ms=5.0, min_rps=500,
+                       max_padding_waste=0.5, max_overloads=0)
+    res = slo.evaluate(spec, _report())
+    assert res.ok, res.render()
+    # FLOPs-weighted waste preferred over the row fraction
+    (waste,) = [c for c in res.checks
+                if c["name"].startswith("padding_waste")]
+    assert waste["name"] == "padding_waste_flops_frac"
+    assert waste["actual"] == 0.3
+
+    bad = slo.evaluate(spec, _report(rps=100.0, overloads=3))
+    assert not bad.ok
+    assert {c["name"] for c in bad.failures} == {"rps", "overloads"}
+    assert "SLO VIOLATION" in bad.render()
+
+
+def test_evaluate_missing_value_fails_loudly():
+    spec = slo.SLOSpec(p95_ms=1.0)
+    res = slo.evaluate(spec, {"latency_ms": {}})
+    (c,) = [x for x in res.checks if x["name"] == "latency_p95_ms"]
+    assert not c["ok"] and c["actual"] is None
+
+
+def test_baseline_compare_bands_and_digest():
+    base = _report(workload_digest="wl1", output_digest="out1")
+    good = _report(rps=900.0, workload_digest="wl1",
+                   output_digest="out1")
+    assert slo.compare_to_baseline(good, base).ok
+    slow = _report(
+        rps=400.0,
+        latency_ms={"p50": 3.0, "p95": 6.0, "p99": 30.0},
+        workload_digest="wl1", output_digest="out1",
+    )
+    res = slo.compare_to_baseline(slow, base)
+    names = {c["name"] for c in res.failures}
+    assert "rps_vs_baseline" in names
+    assert "latency_p50_vs_baseline" in names
+    # bitwise-determinism breach is its own failure
+    mutant = _report(workload_digest="wl1", output_digest="outX")
+    res = slo.compare_to_baseline(mutant, base)
+    (dig,) = [c for c in res.checks
+              if c["name"] == "output_digest_vs_baseline"]
+    assert not dig["ok"]
+    # different workloads: digests are not comparable, check skipped
+    other = _report(workload_digest="wl2", output_digest="outX")
+    assert not any(
+        c["name"] == "output_digest_vs_baseline"
+        for c in slo.compare_to_baseline(other, base).checks
+    )
+    # timed mode is documented non-deterministic: differing output
+    # bytes there are expected, not a breach — check skipped
+    timed = _report(mode="timed", workload_digest="wl1",
+                    output_digest="outX")
+    assert not any(
+        c["name"] == "output_digest_vs_baseline"
+        for c in slo.compare_to_baseline(timed, base).checks
+    )
+    # a different payload seed (or batcher config) is a different
+    # EXPERIMENT, not a determinism breach — check skipped
+    reseeded = _report(seed=1, workload_digest="wl1",
+                       output_digest="outX")
+    base_seeded = dict(base, seed=0)
+    assert not any(
+        c["name"] == "output_digest_vs_baseline"
+        for c in slo.compare_to_baseline(reseeded, base_seeded).checks
+    )
