@@ -17,11 +17,14 @@ bucket forward per delay window. (The async API is not a benchmark
 trick; it IS the subsystem's interface — thread-per-request clients
 would re-import the GIL convoy the batcher exists to remove.)
 
-Measurement protocol: every (path, level) is repeated ``--repeats``
-times and the MEDIAN throughput is reported (thread-scheduling noise
-on small hosts swings single runs 2-3x in both directions; the median
-is the stable center — same motivation as BASELINE.md's best-of-N,
-but robust on both tails). Latency percentiles pool all repeats.
+Measurement protocol: every (path, level) runs ONE discarded warmup
+run, then ``--repeats`` measured runs whose MEDIAN throughput is
+reported (thread-scheduling noise on small hosts swings single runs
+2-3x in both directions; the median is the stable center — same
+motivation as BASELINE.md's best-of-N, but robust on both tails; the
+discarded run keeps first-touch costs out of the low-concurrency
+window, which used to span 304-1376 rps at c=1). Latency percentiles
+pool the measured repeats.
 Measurements run OUTSIDE any telemetry capture (an open capture
 appends every serving span to the JSONL file, a per-request cost the
 naive path does not pay); a short instrumented burst afterwards
@@ -36,7 +39,8 @@ Writes ``BENCH_serving.json`` + ``telemetry.jsonl`` (the latter into
 
 The smoke variant is wired into tier-1 (tests/test_serving_bench.py):
 it must show micro-batched serving >= 3x naive throughput at
-concurrency 16 with zero post-warmup recompiles.
+concurrency 16 AND served >= naive at concurrency 1 (adaptive direct
+dispatch), with zero post-warmup recompiles.
 """
 
 from __future__ import annotations
@@ -122,7 +126,12 @@ def _run_window(window: int, n_requests: int, submit_row):
         one()
         issued += 1
     while pending:
-        done, _ = wait(list(pending), return_when=FIRST_COMPLETED)
+        # already-resolved futures (the direct-dispatch fast path
+        # returns them) need no waiter machinery — harness overhead
+        # must not be charged to the serving path it measures
+        done = [f for f in pending if f.done()]
+        if not done:
+            done, _ = wait(list(pending), return_when=FIRST_COMPLETED)
         now = time.perf_counter()
         for f in done:
             f.result()  # surface request failures loudly
@@ -135,7 +144,18 @@ def _run_window(window: int, n_requests: int, submit_row):
 
 
 def _measure(repeats, run_once):
-    """Median-throughput protocol over ``repeats`` runs."""
+    """Median-throughput protocol over ``repeats`` runs, after ONE
+    discarded warmup run.
+
+    The discarded run eats every first-touch cost the measurement
+    should not see — thread-pool spin-up, branch-predictor and
+    allocator warmth, the OS scheduler finding its feet on a loaded
+    host. Low-concurrency runs are the motivation: before the discard,
+    c=1 ``rps_runs`` spanned 304-1376 on this host (the first run
+    landing anywhere), which made any concurrency-1 gate a coin flip;
+    with it, the median-of-``repeats`` window only ever sees a warm
+    process."""
+    run_once()  # warmup run: results discarded by design
     lat_all: list[float] = []
     rps: list[float] = []
     for _ in range(repeats):
@@ -200,9 +220,15 @@ def main() -> int:
         n_estimators=n_estimators, seed=0,
     ).fit(_X, y)
 
-    # warm both paths' compiles before any measurement
+    # warm both paths' compiles before any measurement. The bottom
+    # rung is sized to the smallest real request (one row): direct
+    # dispatch then runs the SAME shape naive dispatch runs, so the
+    # concurrency-1 comparison is dispatch overhead vs dispatch
+    # overhead, not 1-row compute vs 8-row compute; the quarter rule
+    # in pack_plan keeps the small rungs from fragmenting coalesced
+    # windows into extra launches.
     clf.predict_proba(_X[:1])
-    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=256)
+    ex = EnsembleExecutor(clf, min_bucket_rows=1, max_batch_rows=256)
     ex.warmup()
     compiles_after_warmup = telemetry.registry().counter(
         "sbt_serving_compiles_total"
@@ -221,10 +247,17 @@ def main() -> int:
         "n_features": n_features,
         "requests_per_run": n_requests,
         "repeats": args.repeats,
+        "warmup_runs_discarded": 1,
         "batcher": {k: v for k, v in batcher_opts.items()
                     if k != "max_queue"},
         "levels": [],
     }
+
+    reg = telemetry.registry()
+
+    def _dispatch_split():
+        return (reg.counter("sbt_serving_direct_dispatch_total").value,
+                reg.counter("sbt_serving_coalesced_total").value)
 
     for conc in levels:
         naive = _measure(
@@ -232,11 +265,17 @@ def main() -> int:
             lambda: _run_clients(conc, n_requests,
                                  lambda row: clf.predict_proba(row)),
         )
+        d0, c0 = _dispatch_split()
         with MicroBatcher(ex, **batcher_opts) as batcher:
             served = _measure(
                 args.repeats,
                 lambda: _run_window(conc, n_requests, batcher.submit),
             )
+        d1, c1 = _dispatch_split()
+        # which path the traffic took (adaptive direct dispatch vs the
+        # coalescing worker) — includes the discarded warmup run's
+        # requests, the split RATIO is the signal
+        served["dispatch"] = {"direct": d1 - d0, "coalesced": c1 - c0}
         result["levels"].append({
             "concurrency": conc,
             "naive": naive,               # conc sync client threads
@@ -249,10 +288,9 @@ def main() -> int:
     ).value - compiles_after_warmup
 
     # first-class visibility for the low-concurrency story (ROADMAP
-    # item 3: micro-batching currently LOSES to naive dispatch at
-    # concurrency 1): surface the ratio as its own top-level key and a
-    # stdout line so the trajectory is diffable run-over-run. No hard
-    # gate yet — the number is the work item, not a regression.
+    # item 3): adaptive direct dispatch exists to win this number, and
+    # tests/test_serving_bench.py now GATES served >= naive at
+    # concurrency 1 (alongside the >= 3x concurrency-16 gate).
     conc1 = next(
         (lvl for lvl in result["levels"] if lvl["concurrency"] == 1),
         None,
@@ -262,7 +300,7 @@ def main() -> int:
         print(
             f"concurrency-1 served-vs-naive: {conc1['speedup_rps']}x "
             f"(served {conc1['served']['rps']} rps vs naive "
-            f"{conc1['naive']['rps']} rps; >= 1.0 is the open target)"
+            f"{conc1['naive']['rps']} rps; gate: >= 1.0)"
         )
 
     # telemetry artifact: a short instrumented burst — the final

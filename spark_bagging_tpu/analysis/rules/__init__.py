@@ -9,6 +9,7 @@ keeps the rule honest.
 from spark_bagging_tpu.analysis.rules import (  # noqa: F401
     donation,
     host_sync,
+    hotpath,
     prng,
     recompile,
     threads,

@@ -11,13 +11,22 @@ serving path on top of the fitted estimators:
   runs compiled executables only (**zero recompiles after warmup**,
   counted by ``sbt_serving_compiles_total``).
 - :class:`MicroBatcher` (``batcher.py``) — a bounded-queue background
-  coalescer: concurrent ``submit()`` calls ride ONE padded TPU forward
-  within a ``max_delay_ms``/``max_batch_rows`` window, with explicit
-  :class:`Overloaded` backpressure and per-request futures.
+  coalescer: concurrent ``submit()`` calls pack raggedly into the
+  executor's slab plan (full ladder rungs, minimal padding) within a
+  ``max_delay_ms``/``max_batch_rows`` window, with explicit
+  :class:`Overloaded` backpressure and per-request futures; when a
+  streak of singleton batches proves there is nobody to coalesce
+  with, **adaptive direct dispatch** serves lone requests inline on
+  the caller's thread (and hands back to the coalescer at the first
+  sign of concurrency).
 - :class:`ModelRegistry` (``registry.py``) — versioned registration
   and atomic hot-swap (``registry.swap(name, new_model)``), including
   load-from-checkpoint; swaps pre-compile the incoming executor on the
   live bucket set so traffic never sees a compile stall.
+  ``registry.save()`` persists compiled bucket executables next to the
+  weights (``aot_cache.py``) and ``registry.load()`` hydrates them, so
+  a fresh serving process is warm at startup — zero compiles, no
+  tracing.
 
 Telemetry rides the PR-1 registry end to end: ``sbt_serving_*``
 counters/gauges/histograms (requests, rows, batches, queue depth,
@@ -45,6 +54,7 @@ from spark_bagging_tpu.serving.buckets import (
     bucket_for,
     bucket_ladder,
     next_pow2,
+    pack_plan,
     pad_to_bucket,
 )
 from spark_bagging_tpu.serving.executor import EnsembleExecutor
@@ -58,5 +68,6 @@ __all__ = [
     "bucket_for",
     "bucket_ladder",
     "next_pow2",
+    "pack_plan",
     "pad_to_bucket",
 ]

@@ -1,0 +1,228 @@
+"""AOT executable persistence — instant-warm serving starts.
+
+An :class:`~spark_bagging_tpu.serving.executor.EnsembleExecutor`
+reaches its zero-recompile steady state only after every ladder rung
+has been lowered and compiled — seconds to minutes of warmup a freshly
+started serving process pays while traffic waits (or a load balancer
+holds it out of rotation). XLA's compiled executables are serializable
+(``jax.experimental.serialize_executable``), so the warmup is a
+write-once artifact: this module persists each bucket's executable
+next to the model checkpoint and hydrates a fresh executor from it —
+no tracing, no lowering, no compile, zero entries added to
+``sbt_serving_compiles_total``.
+
+Cache-key contract: a persisted executable is only valid for exactly
+the program it was compiled from, on the toolchain that compiled it.
+The manifest records — and :func:`restore_executables` requires equal —
+
+- ``model_fingerprint``: sha256 over the fitted params pytree (leaf
+  bytes + shapes + dtypes + treedef), the subspace matrix, estimator
+  class, task, feature width, and class set — two models that would
+  compile different programs fingerprint differently;
+- ``ladder``: the executor's ``(min_bucket_rows, max_batch_rows)``
+  bounds — the compile-shape universe;
+- ``jax_version`` / ``backend`` / ``n_devices`` — XLA serialization is
+  only stable within one toolchain + hardware shape;
+- ``donate``: donation changes the compiled program's aliasing.
+
+Any mismatch (or an absent/corrupt cache) is a MISS, never an error:
+the executor falls back to lowering exactly as if no cache existed,
+counting ``sbt_serving_aot_misses_total``. Like model checkpoints, the
+cache directory is TRUSTED input — payloads are unpickled (the same
+trust stance as ``utils/checkpoint._import_class``), so only load
+caches you produced.
+
+Layout (``<dir>/``)::
+
+    aot_manifest.json     # {"key": {...}, "buckets": {"8": "bucket_8.bin", ...}}
+    bucket_<b>.bin        # pickled (payload, in_tree, out_tree) triple
+
+``ModelRegistry.save()`` writes this directory as ``serving_aot/``
+inside the checkpoint dir; ``ModelRegistry.load()`` auto-detects it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import warnings
+from typing import Any
+
+from spark_bagging_tpu import telemetry
+
+FORMAT_VERSION = 1
+MANIFEST = "aot_manifest.json"
+
+
+def model_fingerprint(executor: Any) -> str:
+    """sha256 identity of the program an executor compiles: the fitted
+    params + subspaces pytree (bytes, shapes, dtypes, structure), the
+    estimator class, task, feature width, and class set."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    cls = type(executor.model)
+    h.update(
+        f"{cls.__module__}:{cls.__qualname__}|{executor.task}|"
+        f"{executor.n_features}\n".encode()
+    )
+    if executor.classes_ is not None:
+        c = np.asarray(executor.classes_)
+        h.update(str(c.dtype).encode())
+        h.update(c.tobytes())
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (executor._params, executor._subspaces)
+    )
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def cache_key(executor: Any) -> dict[str, Any]:
+    """The validity contract a restore checks for equality — see the
+    module docstring."""
+    import jax
+
+    return {
+        "format": FORMAT_VERSION,
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "ladder": [int(executor.min_bucket_rows),
+                   int(executor.max_batch_rows)],
+        "donate": bool(executor._donate),
+        "model_fingerprint": model_fingerprint(executor),
+    }
+
+
+def save_executables(executor: Any, path: str) -> tuple[int, ...]:
+    """Persist every bucket executable ``executor`` has compiled into
+    directory ``path`` (atomic install: built in a tmp dir, then
+    swapped in). Buckets whose executable the backend cannot serialize
+    are skipped with a warning. Returns the buckets saved."""
+    from jax.experimental import serialize_executable
+
+    with executor._build_lock:
+        compiled = dict(executor._compiled)
+    if not compiled:
+        raise ValueError(
+            "executor has no compiled buckets to persist; run "
+            "warmup() (or serve traffic) before save_executables()"
+        )
+    import shutil
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    saved: dict[str, str] = {}
+    for bucket in sorted(compiled):
+        try:
+            triple = serialize_executable.serialize(compiled[bucket])
+        except Exception as e:  # noqa: BLE001 — backend-dependent support
+            warnings.warn(
+                f"bucket {bucket} executable is not serializable on "
+                f"this backend ({e!r}); a warm start will lower it "
+                "instead",
+                stacklevel=2,
+            )
+            continue
+        fname = f"bucket_{bucket}.bin"
+        with open(os.path.join(tmp, fname), "wb") as f:
+            pickle.dump(triple, f)
+        saved[str(bucket)] = fname
+        telemetry.inc("sbt_serving_aot_saved_total")
+    with open(os.path.join(tmp, MANIFEST), "w") as f:
+        json.dump({"key": cache_key(executor), "buckets": saved}, f,
+                  indent=2)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+    return tuple(int(b) for b in sorted(saved, key=int))
+
+
+def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
+    """Hydrate ``executor`` from a cache written by
+    :func:`save_executables`. Every failure mode is a MISS (counted,
+    warned where surprising, never raised): the executor simply lowers
+    on demand as if no cache existed. Returns the buckets restored."""
+    from jax.experimental import serialize_executable
+
+    manifest_path = os.path.join(path, MANIFEST)
+    if not os.path.isfile(manifest_path):
+        telemetry.inc("sbt_serving_aot_misses_total")
+        return ()
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        telemetry.inc("sbt_serving_aot_misses_total")
+        warnings.warn(f"unreadable AOT manifest at {manifest_path!r} "
+                      f"({e!r}); warm start falls back to lowering",
+                      stacklevel=2)
+        return ()
+    key = cache_key(executor)
+    if manifest.get("key") != key:
+        # a different model / ladder / toolchain: the executables
+        # would be the WRONG program — fall back to lowering. A
+        # non-dict "key" (version skew, hand edit) is the same miss,
+        # not an AttributeError
+        telemetry.inc("sbt_serving_aot_misses_total")
+        found = manifest.get("key")
+        if not isinstance(found, dict):
+            found = {}
+        stale = {k for k in key if found.get(k) != key[k]}
+        warnings.warn(
+            f"AOT cache at {path!r} was built under a different key "
+            f"(mismatched: {sorted(stale)}); warm start falls back to "
+            "lowering",
+            stacklevel=2,
+        )
+        return ()
+    entries = manifest.get("buckets")
+    if not isinstance(entries, dict):
+        telemetry.inc("sbt_serving_aot_misses_total")
+        warnings.warn(
+            f"AOT manifest at {path!r} has a malformed buckets "
+            "section; warm start falls back to lowering",
+            stacklevel=2,
+        )
+        return ()
+    try:
+        ordered = sorted((int(b), f) for b, f in entries.items())
+    except (TypeError, ValueError):
+        # non-numeric bucket keys: same corrupt-manifest miss
+        telemetry.inc("sbt_serving_aot_misses_total")
+        warnings.warn(
+            f"AOT manifest at {path!r} has non-numeric bucket keys; "
+            "warm start falls back to lowering",
+            stacklevel=2,
+        )
+        return ()
+    restored = []
+    for bucket, fname in ordered:
+        try:
+            with open(os.path.join(path, fname), "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            compiled = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as e:  # noqa: BLE001 — per-bucket fallback
+            telemetry.inc("sbt_serving_aot_misses_total")
+            warnings.warn(
+                f"failed to restore bucket {bucket} executable from "
+                f"{path!r} ({e!r}); it will lower on demand",
+                stacklevel=2,
+            )
+            continue
+        if executor._adopt(bucket, compiled):
+            restored.append(bucket)
+            telemetry.inc("sbt_serving_aot_restored_total")
+    return tuple(restored)

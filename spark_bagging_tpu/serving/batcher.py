@@ -6,8 +6,27 @@ only pays when those requests ride one program launch. The
 ``MicroBatcher`` owns a bounded request queue and a single worker
 thread: the worker takes the first waiting request, keeps gathering
 until ``max_delay_ms`` elapses or ``max_batch_rows`` accumulate,
-concatenates the rows into one padded bucket forward on the executor,
-then scatters slices of the output back to per-request futures.
+packs the request blocks into the executor's ragged slab plan
+(``EnsembleExecutor.forward_parts`` — row-offset scatter, no
+concatenate-then-pad double copy), then delivers each block's slice
+of the output to its per-request future.
+
+Coalescing only pays when there is someone to coalesce WITH. At
+concurrency 1 the queue+worker+future handoff is pure overhead, so
+the batcher adapts (**adaptive direct dispatch**): after a streak of
+one-request batches proves the delay window is buying nothing, a
+submit that finds nothing in flight runs the forward inline on the
+caller's thread — naive-dispatch cost, no queue, no handoff. The
+decision is a lock-light occupancy counter plus the singleton streak
+(one short ``Lock`` held for counter ops only); the first contended
+submit, or the first multi-request batch, revokes direct mode on the
+spot. Starting in coalescing mode matters: a single-threaded async
+dispatcher keeping N futures in flight would be SERIALIZED by inline
+serving (each submit would resolve before the next), and the
+evidence rule keeps it coalescing because its batches are never
+singletons. ``sbt_serving_direct_dispatch_total`` /
+``sbt_serving_coalesced_total`` (and the ``path`` label on the
+latency histogram) make the split observable.
 
 Contracts that matter under load:
 
@@ -58,7 +77,7 @@ import numpy as np
 
 from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
-from spark_bagging_tpu.serving.buckets import bucket_for
+from spark_bagging_tpu.serving.buckets import bucket_for, pack_plan
 from spark_bagging_tpu.telemetry import tracing
 
 _SHUTDOWN = object()
@@ -111,6 +130,20 @@ class MicroBatcher:
     ``max_delay_ms`` when clients are open-loop and stragglers trickle
     in, lower it to 0 to launch the instant the queue empties.
 
+    ``direct_dispatch`` (default: on exactly when ``threaded``) is the
+    adaptive low-concurrency fast path: once
+    :data:`DIRECT_AFTER_SINGLETONS` consecutive batches have carried a
+    single request each (proof that the delay window coalesces
+    nothing), a ``submit()`` that finds nothing in flight and an empty
+    queue skips queue + worker + future handoff entirely and runs the
+    forward INLINE on the caller's thread — concurrency 1 pays
+    naive-dispatch cost instead of a coalescing window it can never
+    benefit from. The first contended submit or multi-request batch
+    revokes the mode, and traffic coalesces again until the streak
+    re-earns it. Stepped mode forces it off — replay determinism
+    requires batch composition to be a pure function of the queue
+    contents.
+
     ``threaded=False`` is stepped mode: no worker thread runs, and the
     owner serves queued requests synchronously via :meth:`run_pending`
     (the deterministic-replay seam — see ``benchmarks/replay.py``).
@@ -125,6 +158,7 @@ class MicroBatcher:
         max_queue: int = 256,
         idle_flush_ms: float = 0.25,
         threaded: bool = True,
+        direct_dispatch: bool | None = None,
     ):
         if max_delay_ms < 0 or idle_flush_ms < 0:
             raise ValueError(
@@ -155,6 +189,28 @@ class MicroBatcher:
                                    int(ex0.max_batch_rows))
         else:
             self._bucket_bounds = None
+        if direct_dispatch is None:
+            direct_dispatch = threaded
+        elif direct_dispatch and not threaded:
+            raise ValueError(
+                "direct_dispatch requires threaded=True; stepped mode "
+                "is the deterministic-replay seam and must keep batch "
+                "composition a pure function of the queue"
+            )
+        self._direct = bool(direct_dispatch)
+        # adaptive-dispatch state, all guarded by a dedicated lock held
+        # for the counter ops only. Direct mode is EARNED, not assumed:
+        # a batcher starts coalescing and demotes to inline serving
+        # only after DIRECT_AFTER_SINGLETONS consecutive one-request
+        # batches prove there is nobody to coalesce with. (Occupancy
+        # alone cannot see a single-threaded async dispatcher that
+        # wants 16 futures in flight — inline serving would serialize
+        # it — but such a dispatcher produces multi-request batches,
+        # which is exactly the signal that keeps coalescing on.)
+        self._occupancy = 0
+        self._mode_direct = False
+        self._singleton_streak = 0
+        self._occ_lock = make_lock("serving.batcher.occupancy")
         self.max_delay_s = max_delay_ms / 1e3
         self.idle_flush_s = idle_flush_ms / 1e3
         self.max_batch_rows = int(max_batch_rows)
@@ -187,6 +243,7 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------
 
+    # sbt-lint: hot-path
     def submit(self, X, *, mode: str = "aggregate") -> Future:
         """Enqueue one request; returns a ``concurrent.futures.Future``.
 
@@ -195,12 +252,17 @@ class MicroBatcher:
         resolves to class labels (classification) or predictions
         (regression). Raises :class:`Overloaded` when the queue is
         full and ``RuntimeError`` after :meth:`close`.
+
+        With direct dispatch enabled (the threaded-mode default), an
+        idle batcher serves the request INLINE before returning — the
+        future comes back already resolved, and concurrent arrivals
+        during the inline serve take the coalescing queue.
         """
         if mode not in ("aggregate", "predict"):
             raise ValueError(f"unknown mode {mode!r}")
         if self._closed:
             raise RuntimeError("MicroBatcher is closed")
-        X = np.ascontiguousarray(np.asarray(X, np.float32))
+        X = np.ascontiguousarray(X, dtype=np.float32)
         if X.ndim == 1:
             X = X[None, :]
         if X.ndim != 2 or X.shape[1] != self._n_features:
@@ -212,6 +274,22 @@ class MicroBatcher:
         trace = (tracing.request_context() if telemetry.enabled()
                  else None)
         req = _Request(X, mode, trace)
+        if self._direct:
+            # adaptive path decision: serve inline iff direct mode has
+            # been earned AND nothing else is in flight — one short
+            # lock for the counter ops only. A contended submit while
+            # in direct mode is the concurrency signal: revoke the
+            # mode on the spot and let the coalescer take over.
+            with self._occ_lock:
+                direct = (self._mode_direct and self._occupancy == 0
+                          and self._q.empty())
+                if direct:
+                    self._occupancy += 1
+                elif self._mode_direct:
+                    self._mode_direct = False
+                    self._singleton_streak = 0
+            if direct:
+                return self._serve_direct(req)
         with tracing.use(trace):
             with telemetry.span("serving_enqueue", rows=req.n):
                 try:
@@ -274,6 +352,131 @@ class MicroBatcher:
             )
         return self.submit(X, mode="aggregate").result(timeout)
 
+    def _serve_direct(self, req: _Request) -> Future:
+        """The idle fast path: run the forward on the caller's thread,
+        bypassing queue, worker, and future handoff. The occupancy slot
+        was claimed by :meth:`submit`; released here in ``finally`` so
+        a failed forward re-opens the path."""
+        try:
+            if not req.future.set_running_or_notify_cancel():
+                return req.future
+            t_claim = time.perf_counter()
+            if telemetry.enabled():
+                telemetry.inc_many((
+                    ("sbt_serving_requests_total", 1.0),
+                    ("sbt_serving_direct_dispatch_total", 1.0),
+                ))
+                if telemetry.arrival_events_wanted():
+                    # the capturable arrival stream sees direct serves
+                    # too — a replay replays them through the stepped
+                    # coalescer, which is exactly the virtual-mode
+                    # contract (composition is queue-order, not path)
+                    bucket = None
+                    if self._bucket_bounds is not None:
+                        bucket = bucket_for(req.n, *self._bucket_bounds)
+                    telemetry.emit_event({
+                        "kind": "serving_request",
+                        "rows": req.n,
+                        "width": self._n_features,
+                        "dtype": str(req.X.dtype),
+                        "bucket": bucket,
+                        "queue_depth": 0,
+                        "trace_id": (req.trace.trace_id if req.trace
+                                     else None),
+                        "t_mono": time.monotonic(),
+                    })
+            ex = None
+            t_fwd = 0.0
+            try:
+                ex = self._resolve()
+                if telemetry.sinks_active():
+                    # someone is consuming events (open capture, armed
+                    # recorder): full span treatment, trace installed
+                    # so serving_direct/serving_forward carry the ids
+                    with tracing.use(req.trace):
+                        with telemetry.span("serving_direct",
+                                            rows=req.n):
+                            t0 = time.perf_counter()
+                            try:
+                                out = ex.forward(req.X)
+                            finally:
+                                t_fwd = time.perf_counter() - t0
+                else:
+                    # lean inline serve: metrics still count (inside
+                    # the executor), spans are skipped — span events
+                    # with no sink are built only to be dropped, and
+                    # that build was a measurable slice of the
+                    # per-request budget at concurrency 1
+                    t0 = time.perf_counter()
+                    try:
+                        if hasattr(ex, "_forward_packed"):
+                            # submit() already validated: skip the
+                            # executor's re-validation pass
+                            (out,) = ex._forward_packed([req.X])
+                        else:
+                            out = ex.forward(req.X)
+                    finally:
+                        t_fwd = time.perf_counter() - t0
+                    if req.trace is not None and hasattr(
+                            ex, "min_bucket_rows"):
+                        # no context was installed, so the executor's
+                        # bucket annotations had nowhere to land —
+                        # recompute the (deterministic) plan for the
+                        # breakdown contract, from the RESOLVED
+                        # executor's bounds (a swap may have changed
+                        # them since this batcher snapshotted its own)
+                        req.trace.annotations["bucket"] = list(
+                            pack_plan(req.n, ex.min_bucket_rows,
+                                      ex.max_batch_rows)
+                        )
+            except BaseException as e:  # noqa: BLE001 — delivered via the future
+                self._finish_breakdown(
+                    req, ex, t_claim, time.perf_counter(), t_fwd,
+                    None, 1, error=repr(e), path="direct",
+                )
+                req.future.set_exception(e)
+                telemetry.inc("sbt_serving_batch_errors_total")
+                telemetry.emit_event({
+                    "kind": "serving_batch_error",
+                    "error": repr(e),
+                    "requests": 1,
+                    "rows": req.n,
+                    "path": "direct",
+                    "trace_id": (req.trace.trace_id if req.trace
+                                 else None),
+                    # same resolvability contract as the batch-path
+                    # event: flight dumps index incidents by links
+                    "links": ([req.trace.trace_id] if req.trace
+                              else []),
+                })
+                return req.future
+            t_done = time.perf_counter()
+            piece = out
+            try:
+                if req.mode == "predict" and ex.task == "classification":
+                    piece = ex.classes_[piece.argmax(axis=1)]
+                self._finish_breakdown(req, ex, t_claim, t_done, t_fwd,
+                                       None, 1, path="direct")
+                req.future.set_result(piece)
+            except BaseException as e:  # noqa: BLE001
+                if not req.future.done():
+                    req.future.set_exception(e)
+            if telemetry.enabled():
+                lat = t_done - req.t_submit
+                telemetry.observe(
+                    "sbt_serving_latency_seconds", lat,
+                    exemplar=(req.trace.trace_id if req.trace else None),
+                )
+                telemetry.observe("sbt_serving_latency_seconds", lat,
+                                  labels={"path": "direct"})
+            return req.future
+        finally:
+            with self._occ_lock:
+                self._occupancy -= 1
+                # last-batch stamp doubles as the direct path's
+                # liveness heartbeat for /healthz staleness math
+                self._t_last_batch = time.monotonic()
+
     # -- observability -------------------------------------------------
 
     # a full queue that has not drained a batch for this long means
@@ -307,17 +510,27 @@ class MicroBatcher:
 
     def stats(self) -> dict:
         """Serving stats off the live registry: cumulative counters
-        plus request-latency quantiles (p50/p95/p99, log-bucket
+        (including the direct-vs-coalesced dispatch split) plus
+        request-latency quantiles (p50/p95/p99, log-bucket
         interpolation — the same numbers ``/varz`` serves)."""
         reg = telemetry.registry()
         return {
             "requests": reg.counter("sbt_serving_requests_total").value,
             "batches": reg.counter("sbt_serving_batches_total").value,
+            "direct": reg.counter(
+                "sbt_serving_direct_dispatch_total").value,
+            "coalesced": reg.counter("sbt_serving_coalesced_total").value,
             "overloaded": reg.counter("sbt_serving_overloaded_total").value,
             "batch_errors": reg.counter(
                 "sbt_serving_batch_errors_total").value,
             "latency": reg.histogram(
                 "sbt_serving_latency_seconds").quantiles(),
+            "latency_direct": reg.histogram(
+                "sbt_serving_latency_seconds",
+                labels={"path": "direct"}).quantiles(),
+            "latency_coalesced": reg.histogram(
+                "sbt_serving_latency_seconds",
+                labels={"path": "coalesced"}).quantiles(),
             **self.health(),
         }
 
@@ -451,14 +664,66 @@ class MicroBatcher:
                 rows += req.n
             self._run_batch(batch)
 
+    #: consecutive one-request coalesced batches before the adaptive
+    #: dispatcher concludes there is nobody to coalesce with and
+    #: serves submits inline (direct mode); any multi-request batch or
+    #: contended submit resets the streak and the mode
+    DIRECT_AFTER_SINGLETONS = 8
+
     def _run_batch(self, batch: list) -> None:
         # claim the futures; drop requests cancelled while queued
         live = [r for r in batch if r.future.set_running_or_notify_cancel()]
         if not live:
             return
+        if self._direct:
+            # the adaptive-dispatch evidence loop: singleton batches
+            # mean the delay window buys nothing — after a streak of
+            # them, demote to inline serving; one coalesced batch
+            # proves concurrency and revokes it. The batch also HOLDS
+            # an occupancy slot while it forwards (released in
+            # _release_slot): without it, a submit landing while
+            # the worker is mid-forward on an empty queue would see
+            # "nothing in flight" and serve inline CONCURRENTLY with
+            # the worker — and direct mode could survive real
+            # concurrency-2 traffic because the revocation signal
+            # (occupancy > 0) never fired
+            with self._occ_lock:
+                self._occupancy += 1
+                if len(live) == 1:
+                    self._singleton_streak += 1
+                    if (self._singleton_streak
+                            >= self.DIRECT_AFTER_SINGLETONS):
+                        self._mode_direct = True
+                else:
+                    self._singleton_streak = 0
+                    self._mode_direct = False
+            token = [True]
+        else:
+            token = []
+        try:
+            self._run_batch_held(live, token)
+        finally:
+            self._release_slot(token)  # backstop; normally a no-op
+
+    def _release_slot(self, token: list) -> None:
+        """Release a batch's occupancy slot exactly once. Called right
+        after the FORWARD completes — before futures resolve — because
+        a closed-loop client wakes on its future and submits again
+        immediately: if the slot outlived the scatter, that submit
+        would read occupancy 1 and revoke direct mode the moment it
+        was earned. The slot's job is only to cover the device
+        forward (no inline serve may run concurrently with it)."""
+        if token:
+            token.clear()
+            with self._occ_lock:
+                self._occupancy -= 1
+
+    def _run_batch_held(self, live: list, token: list) -> None:
         t_claim = time.perf_counter()
         if telemetry.enabled():
             telemetry.inc("sbt_serving_batches_total")
+            telemetry.inc("sbt_serving_coalesced_total",
+                          float(len(live)))
             telemetry.set_gauge("sbt_serving_queue_depth",
                                 self._q.qsize())
         # one batch-level trace context linked to every member request:
@@ -470,20 +735,37 @@ class MicroBatcher:
         t_fwd = 0.0
         try:
             ex = self._resolve()
-            X = (live[0].X if len(live) == 1
-                 else np.concatenate([r.X for r in live]))
+            rows = sum(r.n for r in live)
+            ragged = hasattr(ex, "forward_parts")
             with tracing.use(bctx):
-                with telemetry.span("serving_batch", rows=X.shape[0],
+                with telemetry.span("serving_batch", rows=rows,
                                     requests=len(live)):
                     t0 = time.perf_counter()
                     try:
-                        out = ex.forward(X)
+                        if ragged:
+                            # ragged packing: request blocks scatter
+                            # straight into the pack plan's slabs (one
+                            # copy per row, minimal padding) and come
+                            # back pre-split per request
+                            pieces = ex.forward_parts(
+                                [r.X for r in live]
+                            )
+                        else:
+                            # plain-callable executors (no ragged
+                            # seam): concatenate and slice, as ever
+                            X = (live[0].X if len(live) == 1
+                                 else np.concatenate(
+                                     [r.X for r in live]))
+                            out = ex.forward(X)
                     finally:
                         # in finally so a forward that dies after 2 s
                         # of device time still attributes those 2 s to
                         # forward_ms in the error breakdown
                         t_fwd = time.perf_counter() - t0
         except BaseException as e:  # noqa: BLE001 — delivered per-future
+            # release BEFORE delivering: a client waking on the
+            # exception may submit immediately
+            self._release_slot(token)
             t_fail = time.perf_counter()
             for r in live:
                 self._finish_breakdown(
@@ -501,15 +783,21 @@ class MicroBatcher:
                 "links": [t.trace_id for t in traced],
             })
             return
-        # sbt-lint: disable=shared-state-unlocked — single-writer (this worker thread); /healthz readers tolerate a stale float
+        # the device forward is done: drop the occupancy slot BEFORE
+        # any future resolves (see _release_slot)
+        self._release_slot(token)
+        # sbt-lint: disable=shared-state-unlocked — last-write-wins monotonic stamp (worker thread + direct finishers); /healthz readers tolerate a stale float
         self._t_last_batch = time.monotonic()
         with tracing.use(bctx):
             with telemetry.span("serving_scatter", requests=len(live)):
                 off = 0
                 t_done = time.perf_counter()
-                for r in live:
-                    piece = out[off:off + r.n]
-                    off += r.n
+                for i, r in enumerate(live):
+                    if ragged:
+                        piece = pieces[i]
+                    else:
+                        piece = out[off:off + r.n]
+                        off += r.n
                     try:
                         if (r.mode == "predict"
                                 and ex.task == "classification"):
@@ -523,11 +811,15 @@ class MicroBatcher:
                         if not r.future.done():
                             r.future.set_exception(e)
                     if telemetry.enabled():
+                        lat = t_done - r.t_submit
                         telemetry.observe(
-                            "sbt_serving_latency_seconds",
-                            t_done - r.t_submit,
+                            "sbt_serving_latency_seconds", lat,
                             exemplar=(r.trace.trace_id if r.trace
                                       else None),
+                        )
+                        telemetry.observe(
+                            "sbt_serving_latency_seconds", lat,
+                            labels={"path": "coalesced"},
                         )
 
     @staticmethod
@@ -535,19 +827,24 @@ class MicroBatcher:
         r: _Request, ex: Any, t_claim: float, t_done: float,
         t_fwd: float, bctx: "tracing.TraceContext | None",
         n_requests: int, error: str | None = None,
+        path: str = "coalesced",
     ) -> None:
         """Fill the request trace's timing breakdown — complete before
         the future resolves, so `future.result(); future.trace.breakdown`
         never races."""
         if r.trace is None:
             return
-        buckets = (bctx.annotations.get("bucket", []) if bctx else [])
+        # bucket annotations land on the batch context when one exists
+        # (coalesced path); direct serves annotate the request trace
+        src = bctx if bctx is not None else r.trace
+        buckets = src.annotations.get("bucket", []) if src else []
         bd = {
             "queue_ms": (t_claim - r.t_submit) * 1e3,
             "batch_ms": (t_done - t_claim) * 1e3,
             "forward_ms": t_fwd * 1e3,
             "total_ms": (t_done - r.t_submit) * 1e3,
             "batch_size": n_requests,
+            "path": path,
             "bucket": (buckets[0] if len(buckets) == 1
                        else list(buckets) or None),
             "model_version": getattr(ex, "model_version", None),

@@ -35,7 +35,7 @@ from spark_bagging_tpu.serving.buckets import (
     DEFAULT_MIN_ROWS,
     bucket_for,
     bucket_ladder,
-    pad_to_bucket,
+    pack_plan,
 )
 
 
@@ -175,13 +175,50 @@ class EnsembleExecutor:
             self._compiled[bucket] = compiled
             return compiled
 
+    def _adopt(self, bucket: int, compiled: Any) -> bool:
+        """Install a deserialized executable for ``bucket`` (the AOT
+        warm-start path — no lowering, no compile, not counted in
+        ``sbt_serving_compiles_total``). First installer wins; returns
+        whether this call installed it."""
+        with self._build_lock:
+            if bucket in self._compiled:
+                return False
+            cost = _compiled_cost(compiled)
+            self.bucket_costs[bucket] = cost
+            if telemetry.enabled():
+                labels = {"bucket": str(bucket)}
+                if cost["flops"] is not None:
+                    telemetry.set_gauge("sbt_serving_bucket_cost_flops",
+                                        cost["flops"], labels=labels)
+                if cost["bytes"] is not None:
+                    telemetry.set_gauge("sbt_serving_bucket_cost_bytes",
+                                        cost["bytes"], labels=labels)
+            self._compiled[bucket] = compiled
+            return True
+
+    def save_executables(self, path: str) -> tuple[int, ...]:
+        """Persist every compiled bucket executable to directory
+        ``path`` (see :mod:`spark_bagging_tpu.serving.aot_cache` for
+        the key contract). Returns the buckets saved."""
+        from spark_bagging_tpu.serving.aot_cache import save_executables
+
+        return save_executables(self, path)
+
+    def restore_executables(self, path: str) -> tuple[int, ...]:
+        """Hydrate bucket executables from a directory written by
+        :meth:`save_executables` — instant warm start. Silently
+        restores nothing (and falls back to lowering on demand) when
+        the cache is absent or was built under a different key (model
+        fingerprint, bucket ladder, jax version, backend, donation).
+        Returns the buckets restored."""
+        from spark_bagging_tpu.serving.aot_cache import restore_executables
+
+        return restore_executables(self, path)
+
     # -- the forward ---------------------------------------------------
 
-    def forward(self, X) -> np.ndarray:
-        """Aggregated output for ``X`` — (n, C) probabilities for a
-        classifier, (n,) predictions for a regressor. Pads to the
-        bucket, runs the compiled executable, slices padding off."""
-        X = np.ascontiguousarray(np.asarray(X, np.float32))
+    def _validate(self, X) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float32)
         if X.ndim == 1:
             # single feature vector: the overwhelmingly common online
             # request shape — accept it as one row
@@ -190,47 +227,146 @@ class EnsembleExecutor:
             raise ValueError(
                 f"X must be (n, {self.n_features}), got {X.shape}"
             )
-        n = X.shape[0]
-        if n == 0:
+        if X.shape[0] == 0:
             raise ValueError("X has no rows")
-        if n <= self.max_batch_rows:
-            return self._forward_piece(X)
-        pieces = [
-            self._forward_piece(X[s:s + self.max_batch_rows])
-            for s in range(0, n, self.max_batch_rows)
-        ]
-        return np.concatenate(pieces)
+        return X
+
+    def forward(self, X) -> np.ndarray:
+        """Aggregated output for ``X`` — (n, C) probabilities for a
+        classifier, (n,) predictions for a regressor. Rows run through
+        the ragged pack plan (:func:`~spark_bagging_tpu.serving.
+        buckets.pack_plan`): full ladder rungs first, only the final
+        slab padded, padding sliced off before anything is returned."""
+        X = self._validate(X)
+        (out,) = self._forward_packed([X])
+        return out
 
     __call__ = forward
 
-    def _forward_piece(self, X: np.ndarray) -> np.ndarray:
-        n = X.shape[0]
-        bucket = bucket_for(n, self.min_bucket_rows, self.max_batch_rows)
+    def forward_parts(self, parts) -> list[np.ndarray]:
+        """Ragged batch: serve several independent row blocks as ONE
+        packed forward sequence and return one output per block.
+
+        The blocks are packed back-to-back into the pack plan's slabs
+        with a row-offset scatter — no intermediate concatenation, no
+        per-block padding: each row is copied into device-transfer
+        memory exactly once, and only the final slab carries padding.
+        A block may span a slab boundary; bagging aggregation is
+        row-local, so its rows' results are unaffected by which slab
+        (or which batch-mates) they rode with — served outputs stay
+        bitwise-equal to the batch ``predict``/``predict_proba`` of
+        each block alone. This is the micro-batcher's scatter seam.
+        """
+        if not parts:
+            return []
+        return self._forward_packed([self._validate(p) for p in parts])
+
+    def _forward_packed(self, parts: list[np.ndarray]) -> list[np.ndarray]:
+        """Pack validated row blocks into plan slabs, run each slab,
+        scatter outputs back per block."""
+        sizes = [p.shape[0] for p in parts]
+        n = sum(sizes)
+        plan = pack_plan(n, self.min_bucket_rows, self.max_batch_rows)
+        # gather: walk the blocks once, filling each slab in order;
+        # only the last slab is partial (pack_plan's fill rule)
+        slab_outs: list[np.ndarray] = []
+        part_i = 0
+        part_off = 0
+        remaining = n
+        for bucket in plan:
+            fill = min(bucket, remaining)
+            remaining -= fill
+            part = parts[part_i]
+            if fill == bucket and part.shape[0] - part_off >= fill:
+                # the whole slab comes from one block: serve the slice
+                # as-is (a view — zero-copy, the fast path for the
+                # single-request forward and for large blocks)
+                Xp = part[part_off:part_off + fill]
+                part_off += fill
+                if part_off == part.shape[0]:
+                    part_i += 1
+                    part_off = 0
+            else:
+                # row-offset scatter: one zeroed slab buffer, each
+                # block's rows copied in at its offset (this replaces
+                # concatenate-then-pad, which copied every row twice)
+                Xp = np.zeros((bucket, self.n_features), np.float32)
+                off = 0
+                while off < fill:
+                    part = parts[part_i]
+                    take = min(fill - off, part.shape[0] - part_off)
+                    Xp[off:off + take] = part[part_off:part_off + take]
+                    off += take
+                    part_off += take
+                    if part_off == part.shape[0]:
+                        part_i += 1
+                        part_off = 0
+            slab_outs.append(self._forward_piece(Xp, fill))
+        # scatter back: slice each block's rows out of the slab outputs
+        # (views when a block sat inside one slab; boundary-spanning
+        # blocks concatenate their pieces)
+        outs: list[np.ndarray] = []
+        slab_i = 0
+        slab_off = 0
+        for size in sizes:
+            pieces: list[np.ndarray] = []
+            need = size
+            while need:
+                out = slab_outs[slab_i]
+                take = min(need, out.shape[0] - slab_off)
+                pieces.append(out[slab_off:slab_off + take])
+                need -= take
+                slab_off += take
+                if slab_off == out.shape[0]:
+                    slab_i += 1
+                    slab_off = 0
+            outs.append(pieces[0] if len(pieces) == 1
+                        else np.concatenate(pieces))
+        return outs
+
+    # sbt-lint: hot-path
+    def _forward_piece(self, Xp: np.ndarray, fill: int) -> np.ndarray:
+        """Run one bucket-shaped slab (``fill`` real rows, the rest
+        padding) through its compiled executable; returns the real
+        rows' output."""
+        bucket = Xp.shape[0]
         compiled = self._compiled.get(bucket)
         if compiled is None:
             compiled = self._build(bucket)
         if telemetry.enabled():
-            telemetry.inc("sbt_serving_rows_total", float(n))
-            telemetry.inc("sbt_serving_padding_rows_total",
-                          float(bucket - n))
-            telemetry.observe("sbt_serving_batch_fill_ratio", n / bucket)
+            counts = [
+                ("sbt_serving_rows_total", float(fill)),
+                ("sbt_serving_padding_rows_total", float(bucket - fill)),
+            ]
             flops = self.bucket_costs.get(bucket, {}).get("flops")
             if flops:
                 # rows are interchangeable within a bucket's program,
                 # so padding's FLOP share is its row share — waste in
                 # compute terms, not just rows
-                telemetry.inc("sbt_serving_flops_total", flops)
-                telemetry.inc("sbt_serving_padding_flops_total",
-                              (bucket - n) / bucket * flops)
+                counts.append(("sbt_serving_flops_total", flops))
+                counts.append(("sbt_serving_padding_flops_total",
+                               (bucket - fill) / bucket * flops))
+            # one registry lock round-trip for the whole panel: this
+            # runs per slab on the request hot path
+            telemetry.inc_many(counts)
+            telemetry.observe("sbt_serving_batch_fill_ratio",
+                              fill / bucket)
         # attach the bucket choice to whatever request/batch trace is
-        # current (slab-split oversize batches annotate once per slab)
+        # current (multi-slab packs annotate once per slab)
         tracing.annotate(bucket=bucket)
-        Xp = pad_to_bucket(X, bucket)
-        with telemetry.span("serving_forward", bucket=bucket, rows=n):
-            out = compiled(self._params, self._subspaces, Xp)
-            # sbt-lint: disable=host-sync-in-span — the served result must reach the host here; the span times the true forward latency
-            out = np.asarray(out)
-        return out[:n]
+        if telemetry.sinks_active():
+            with telemetry.span("serving_forward", bucket=bucket,
+                                rows=fill):
+                out = compiled(self._params, self._subspaces, Xp)
+                # sbt-lint: disable=host-sync-in-span — the served result must reach the host here; the span times the true forward latency
+                out = np.asarray(out)
+        else:
+            # nobody is listening for span events (no open capture, no
+            # armed recorder, no scrape server): skip the span
+            # machinery — it was a measurable slice of the direct
+            # path's per-request budget
+            out = np.asarray(compiled(self._params, self._subspaces, Xp))
+        return out[:fill]
 
     # -- sklearn-flavored conveniences ---------------------------------
 

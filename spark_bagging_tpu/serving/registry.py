@@ -27,6 +27,7 @@ swap instant. The registry owns that lifecycle:
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import numpy as np
@@ -116,16 +117,23 @@ class ModelRegistry:
         raise ValueError(msg)
 
     def register(self, name: str, model: Any, *, warmup: bool = False,
+                 executable_cache: str | None = None,
                  **executor_opts: Any) -> EnsembleExecutor:
         """Install a fitted estimator as version 1 of ``name``.
 
         ``warmup=True`` compiles the full bucket ladder before the
         method returns (serve-ready, zero compiles afterwards).
+        ``executable_cache`` names an AOT cache directory
+        (:mod:`~spark_bagging_tpu.serving.aot_cache`) to hydrate
+        executables from FIRST — with a full-ladder cache hit, warmup
+        compiles nothing and the entry is serve-ready instantly.
         ``executor_opts`` (bucket bounds, donation) override the
         registry defaults and stick to the name across swaps.
         """
         opts = {**self._default_opts, **executor_opts}
         ex = EnsembleExecutor(model, **opts)
+        if executable_cache is not None:
+            ex.restore_executables(executable_cache)
         if warmup:
             ex.warmup()
         with self._lock:
@@ -144,6 +152,7 @@ class ModelRegistry:
         return ex
 
     def swap(self, name: str, model: Any, *, warm: bool = True,
+             executable_cache: str | None = None,
              **executor_opts: Any) -> EnsembleExecutor:
         """Atomically replace ``name``'s serving model; returns the new
         executor and bumps the version.
@@ -158,6 +167,9 @@ class ModelRegistry:
         changed the bucket bounds). ``executor_opts`` update the
         entry's sticky options — committed only if the swap succeeds;
         a rejected swap leaves the live entry fully untouched.
+        ``executable_cache`` hydrates the replacement from a persisted
+        AOT cache before the warm pre-compile pass, so even a
+        cold-cache swap stalls only on the rungs the cache missed.
         """
         entry = self._entry(name)
         old = entry.executor
@@ -182,6 +194,8 @@ class ModelRegistry:
                 "swap would change the served class set; register the "
                 "new label space under a new name instead",
             )
+        if executable_cache is not None:
+            new.restore_executables(executable_cache)
         if warm:
             from spark_bagging_tpu.serving.buckets import bucket_for
 
@@ -207,21 +221,39 @@ class ModelRegistry:
                             labels={"model": name})
         return new
 
+    #: subdirectory of a checkpoint dir where :meth:`save` persists the
+    #: bucket executables and :meth:`load` looks for them
+    AOT_SUBDIR = "serving_aot"
+
     def load(self, name: str, path: str, *, warm: bool = True,
+             executable_cache: str | None = "auto",
              **executor_opts: Any) -> EnsembleExecutor:
         """Register-or-swap ``name`` from a checkpoint directory saved
         with ``estimator.save()`` / ``utils/checkpoint.save_model`` —
         the hand-off seam from a retraining job. ``executor_opts``
         apply either way: on an existing name they ride the swap
-        (committed to the entry's sticky options only on success)."""
+        (committed to the entry's sticky options only on success).
+
+        Executables ride alongside weights: ``executable_cache="auto"``
+        (default) hydrates from ``<path>/serving_aot`` when
+        :meth:`save` left one there — a fresh serving process reaches
+        zero-recompile steady state at startup instead of after
+        warmup. A key mismatch (different model, ladder, jax version,
+        backend) silently falls back to lowering. Pass ``None`` to
+        skip, or an explicit directory to use a cache kept elsewhere.
+        """
         from spark_bagging_tpu.utils.checkpoint import load_model
 
         model = load_model(path)
+        if executable_cache == "auto":
+            auto = os.path.join(path, self.AOT_SUBDIR)
+            executable_cache = auto if os.path.isdir(auto) else None
         with self._lock:
             exists = name in self._entries
         if not exists:
             try:
                 return self.register(name, model, warmup=warm,
+                                     executable_cache=executable_cache,
                                      **executor_opts)
             except ValueError:
                 # register-or-swap must be race-safe: another load()
@@ -230,7 +262,25 @@ class ModelRegistry:
                 with self._lock:
                     if name not in self._entries:
                         raise
-        return self.swap(name, model, warm=warm, **executor_opts)
+        return self.swap(name, model, warm=warm,
+                         executable_cache=executable_cache,
+                         **executor_opts)
+
+    def save(self, name: str, path: str, *, compress: bool | str = "auto",
+             executables: bool = True) -> None:
+        """Checkpoint ``name``'s live model to directory ``path`` —
+        and, with ``executables=True``, persist its compiled bucket
+        executables into ``<path>/serving_aot`` so :meth:`load` in a
+        fresh process warm-starts without a single compile. The
+        executable pass is best-effort: an executor with nothing
+        compiled yet, or a backend without executable serialization,
+        saves weights only."""
+        from spark_bagging_tpu.utils.checkpoint import save_model
+
+        ex = self._entry(name).executor
+        save_model(ex.model, path, compress=compress)
+        if executables and ex.compiled_buckets:
+            ex.save_executables(os.path.join(path, self.AOT_SUBDIR))
 
     def batcher(self, name: str, **batcher_opts: Any):
         """A micro-batcher bound to THIS registry entry by name: each

@@ -80,7 +80,8 @@ _SERVER_ATTRS = ("start_server", "stop_server", "server_address")
 __all__ = [
     "SCHEMA_VERSION", "SERIES_HELP", "QUANTILES", "Run", "capture",
     "current_run", "enabled", "enable", "disable", "set_device_sync",
-    "device_sync_enabled", "span", "phase", "inc", "set_gauge",
+    "device_sync_enabled", "span", "phase", "inc", "inc_many",
+    "set_gauge",
     "observe", "emit_event", "registry", "render_prometheus",
     "read_events", "last_metrics_snapshot", "runs",
     "record_fit_report", "Registry", "reset", "telemetry_dir",
@@ -151,6 +152,13 @@ def reset() -> None:
 def inc(name: str, v: float = 1.0, labels: dict | None = None) -> None:
     if _state.enabled:
         _state.registry.inc(name, v, labels)
+
+
+def inc_many(items) -> None:
+    """Increment several unlabeled counters in one registry lock
+    round-trip (hot-path fusion; see ``Registry.inc_many``)."""
+    if _state.enabled:
+        _state.registry.inc_many(items)
 
 
 def set_gauge(name: str, v: float, labels: dict | None = None) -> None:

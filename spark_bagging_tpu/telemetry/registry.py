@@ -67,7 +67,12 @@ SERIES_HELP: dict[str, str] = {
     "sbt_serving_padding_rows_total": "Padding rows added to reach bucket shapes",
     "sbt_serving_compiles_total": "Serving bucket compiles (zero after warmup)",
     "sbt_serving_compile_seconds": "Serving bucket compile wall-clock (histogram)",
-    "sbt_serving_latency_seconds": "Request latency submit-to-result (histogram)",
+    "sbt_serving_latency_seconds": "Request latency submit-to-result (histogram; optional path label: direct/coalesced)",
+    "sbt_serving_direct_dispatch_total": "Requests served inline by adaptive direct dispatch (idle fast path)",
+    "sbt_serving_coalesced_total": "Requests served via the coalescing worker path",
+    "sbt_serving_aot_saved_total": "Compiled bucket executables persisted to an AOT cache",
+    "sbt_serving_aot_restored_total": "Bucket executables hydrated from a persisted AOT cache (no compile)",
+    "sbt_serving_aot_misses_total": "AOT cache lookups that fell back to lowering (absent/key-mismatched/unreadable)",
     "sbt_serving_overloaded_total": "Requests shed with Overloaded backpressure",
     "sbt_serving_models_registered_total": "Models registered for serving",
     "sbt_serving_swaps_total": "Successful hot swaps",
@@ -263,6 +268,15 @@ class Registry:
     def inc(self, name: str, v: float = 1.0, labels: dict | None = None) -> None:
         with self._lock:
             self._get_locked(name, labels, Counter).inc(v)
+
+    def inc_many(self, items: Iterable[tuple[str, float]]) -> None:
+        """Increment several (unlabeled) counters under ONE lock
+        round-trip — the serving hot path counts 4+ series per
+        forward, and per-call lock acquisitions were measurable
+        there."""
+        with self._lock:
+            for name, v in items:
+                self._get_locked(name, None, Counter).inc(v)
 
     def set(self, name: str, v: float, labels: dict | None = None) -> None:
         with self._lock:

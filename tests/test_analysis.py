@@ -222,6 +222,35 @@ def make_key(seed: int):
     return jax.random.PRNGKey(seed)
 """,
     ),
+    "hot-path-alloc": (
+        """
+import os
+import logging
+
+log = logging.getLogger("serving")
+
+# sbt-lint: hot-path
+def submit(req):
+    token = os.urandom(8).hex()
+    attrs = {k: str(v) for k, v in req.items()}
+    log.debug("request %s %s", token, attrs)
+    return token
+""",
+        """
+import itertools
+import os
+
+_ids = itertools.count()
+
+# sbt-lint: hot-path
+def submit(req):
+    return next(_ids), req
+
+def cold_path(req):
+    # un-marked functions may allocate freely: the rule is opt-in
+    return os.urandom(8).hex(), {k: str(v) for k, v in req.items()}
+""",
+    ),
     "shared-state-unlocked": (
         """
 import threading

@@ -84,7 +84,10 @@ def test_scrape_during_live_serving_traffic(clf):
     port = tserver.start_server(0)
     reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
     reg.register("m", clf, warmup=True)
-    with reg.batcher("m", max_delay_ms=2, max_queue=256) as b:
+    # coalesced-path series (sbt_serving_batches_total) are asserted
+    # below, so pin the adaptive direct path off for this traffic
+    with reg.batcher("m", max_delay_ms=2, max_queue=256,
+                     direct_dispatch=False) as b:
         futs = [b.submit(X[i:i + 2]) for i in range(24)]
         # scrape WHILE requests are in flight (some may already be
         # done — "during traffic" means the process is serving)

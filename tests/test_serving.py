@@ -245,8 +245,12 @@ def test_micro_batch_coalesces_waiting_requests(clf, executor, data):
     X, _ = data
     before = _counter("sbt_serving_batches_total")
     ref = clf.predict_proba(X[:16])
+    # direct dispatch pinned off: this test exercises the coalescing
+    # queue, and back-to-back sequential submits from one thread would
+    # (correctly) all take the adaptive inline path otherwise
     with MicroBatcher(executor, max_delay_ms=250, idle_flush_ms=250,
-                      max_batch_rows=64, max_queue=64) as b:
+                      max_batch_rows=64, max_queue=64,
+                      direct_dispatch=False) as b:
         futs = [b.submit(X[i:i + 1]) for i in range(16)]
         results = [f.result(30) for f in futs]
     for i, r in enumerate(results):
@@ -298,7 +302,10 @@ def test_backpressure_overloaded_is_explicit():
     ex = _StallingExecutor()
     X1 = np.zeros((1, 12), np.float32)
     before = _counter("sbt_serving_overloaded_total")
-    b = MicroBatcher(ex, max_delay_ms=0, max_queue=2)
+    # queue-path semantics under test; direct dispatch would run the
+    # stalling forward inline on this thread
+    b = MicroBatcher(ex, max_delay_ms=0, max_queue=2,
+                     direct_dispatch=False)
     try:
         first = b.submit(X1)           # worker takes it, stalls in forward
         assert ex.entered.wait(10)
@@ -338,7 +345,10 @@ def test_batch_failure_is_per_batch_not_fatal(clf, executor, data):
                 raise RuntimeError("injected")
             return executor.forward(Xb)
 
-    with MicroBatcher(_Flaky(), max_delay_ms=1, max_queue=8) as b:
+    # worker-path failure isolation under test (direct-path failure
+    # delivery has its own test in test_serving_fastpath.py)
+    with MicroBatcher(_Flaky(), max_delay_ms=1, max_queue=8,
+                      direct_dispatch=False) as b:
         bad = b.submit(X[:2])
         with pytest.raises(RuntimeError, match="injected"):
             bad.result(30)

@@ -1,0 +1,484 @@
+"""Serving hot-path tests [ISSUE 7]: ragged pack planning, the
+row-offset scatter, adaptive direct dispatch (bitwise parity, the
+direct->coalesced->direct flip, error delivery), AOT executable
+persistence (save / fresh reload / zero compiles without tracing), and
+the replay padding-waste gate against the committed pre-change
+baseline.
+
+The invariant carried over from ISSUE 2: whatever path a request takes
+— direct inline, coalesced worker, single slab or a ragged multi-slab
+pack — its result must be BITWISE-equal to the batch
+``predict``/``predict_proba`` of exactly its rows.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.serving import (
+    EnsembleExecutor,
+    MicroBatcher,
+    ModelRegistry,
+    pack_plan,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+BASELINE = os.path.join(REPO, "benchmarks", "baselines",
+                        "replay_smoke_baseline.json")
+
+
+def _counter(name: str) -> float:
+    return telemetry.registry().counter(name).value
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(256, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def clf(data):
+    X, y = data
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=4),
+        n_estimators=6, seed=0,
+    ).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def executor(clf):
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=64)
+    ex.warmup()
+    return ex
+
+
+# -- ragged pack planning ----------------------------------------------
+
+def test_pack_plan_rungs_and_padding():
+    # decomposition engages when it saves >= a quarter of the single
+    # bucket's rows; ties and near-ties keep the single launch
+    assert pack_plan(20, 8, 64) == (16, 8)   # pad 4, not 12
+    assert pack_plan(24, 8, 64) == (16, 8)   # pad 0, not 8
+    assert pack_plan(17, 8, 64) == (16, 8)   # pad 7, not 15
+    assert pack_plan(13, 8, 64) == (16,)     # tie -> one launch
+    assert pack_plan(5, 8, 64) == (8,)
+    assert pack_plan(64, 8, 64) == (64,)
+    # equal tail rungs re-merge ([32, 8, 8] -> [32, 16])
+    assert pack_plan(44, 8, 64) == (32, 16)
+    # ... cascading all the way back to the single bucket
+    assert pack_plan(60, 8, 64) == (64,)
+    # oversize rows still emit full top-rung slabs first
+    assert pack_plan(100, 8, 64) == (64, 32, 8)
+    assert pack_plan(130, 8, 64) == (64, 64, 8)
+    with pytest.raises(ValueError):
+        pack_plan(0)
+
+
+def test_pack_plan_invariants_exhaustive():
+    """Every plan uses ladder rungs only (the zero-recompile universe),
+    covers n, never pads more than the single-bucket plan, and keeps
+    only its last slab partial."""
+    from spark_bagging_tpu.serving.buckets import bucket_ladder
+
+    for lo, hi in ((8, 64), (1, 128), (16, 16)):
+        ladder = set(bucket_ladder(lo, hi))
+        top = max(ladder)
+        for n in range(1, 400):
+            plan = pack_plan(n, lo, hi)
+            assert all(b in ladder for b in plan), (n, plan)
+            assert sum(plan) >= n
+            naive_pad = (-n) % top if n > top else (
+                min(b for b in ladder if b >= n) - n
+            )
+            assert sum(plan) - n <= naive_pad, (n, plan)
+            # fill rule: all slabs except the last are full
+            remaining = n
+            for b in plan[:-1]:
+                assert remaining >= b, (n, plan)
+                remaining -= b
+
+
+def test_ragged_parts_bitwise_parity(clf, executor, data):
+    """forward_parts packs blocks into shared slabs (some spanning slab
+    boundaries); every block's output must equal its own batch
+    predict_proba bitwise."""
+    X, _ = data
+    for sizes in ((1,), (3, 5), (12, 8), (5, 7, 20), (20, 44),
+                  (1, 1, 1, 1, 1), (30, 40, 50)):
+        parts, off = [], 0
+        for s in sizes:
+            parts.append(X[off:off + s])
+            off += s
+        outs = executor.forward_parts(parts)
+        assert len(outs) == len(parts)
+        for p, o in zip(parts, outs):
+            np.testing.assert_array_equal(o, clf.predict_proba(p))
+
+
+def test_ragged_pack_reduces_padding(clf, data):
+    """The waste counter is the point: a 20-row batch must pad 4 rows
+    ([16, 8]), not 12 ([32])."""
+    X, _ = data
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=64)
+    ex.warmup()
+    before = _counter("sbt_serving_padding_rows_total")
+    ex.forward(X[:20])
+    assert _counter("sbt_serving_padding_rows_total") - before == 4
+
+
+def test_forward_parts_empty_and_single(clf, executor, data):
+    X, _ = data
+    assert executor.forward_parts([]) == []
+    (out,) = executor.forward_parts([X[:9]])
+    np.testing.assert_array_equal(out, clf.predict_proba(X[:9]))
+
+
+# -- adaptive direct dispatch ------------------------------------------
+
+def test_direct_dispatch_bitwise_parity(clf, executor, data):
+    """Closed-loop sequential submits earn direct mode; results stay
+    bitwise-equal to batch predict_proba/predict, and the breakdown
+    names the path."""
+    X, _ = data
+    d0 = _counter("sbt_serving_direct_dispatch_total")
+    with MicroBatcher(executor, max_delay_ms=2) as b:
+        futs = []
+        for i in range(16):
+            f = b.submit(X[i:i + 3])
+            np.testing.assert_array_equal(
+                f.result(30), clf.predict_proba(X[i:i + 3])
+            )
+            futs.append(f)
+        np.testing.assert_array_equal(
+            b.predict(X[:5]), clf.predict(X[:5])
+        )
+    assert _counter("sbt_serving_direct_dispatch_total") > d0
+    # once direct mode engaged, breakdowns carry the path + bucket
+    direct_bds = [
+        f.trace.breakdown for f in futs
+        if f.trace is not None
+        and f.trace.breakdown.get("path") == "direct"
+    ]
+    assert direct_bds, "no request took the direct path"
+    for bd in direct_bds:
+        assert bd["batch_size"] == 1
+        assert bd["bucket"] == 8  # 3 rows -> bucket 8
+        assert bd["queue_ms"] >= 0 and bd["total_ms"] > 0
+
+
+def test_direct_mode_is_earned_not_assumed(executor, data):
+    """A fresh batcher must NOT serve inline before the singleton
+    streak proves there is nobody to coalesce with — a single-threaded
+    async dispatcher would be serialized otherwise."""
+    X, _ = data
+    with MicroBatcher(executor, max_delay_ms=2) as b:
+        streak_needed = b.DIRECT_AFTER_SINGLETONS
+        d0 = _counter("sbt_serving_direct_dispatch_total")
+        c0 = _counter("sbt_serving_coalesced_total")
+        for i in range(streak_needed):
+            b.submit(X[i:i + 1]).result(30)
+        # the earn-in window went through the coalescer...
+        assert _counter("sbt_serving_coalesced_total") - c0 == streak_needed
+        assert _counter("sbt_serving_direct_dispatch_total") == d0
+        # ...and the request after it is served inline
+        b.submit(X[:1]).result(30)
+        assert _counter("sbt_serving_direct_dispatch_total") == d0 + 1
+
+
+def test_direct_coalesced_direct_flip_under_contention(executor, data):
+    """The adaptive loop end to end: sequential traffic earns direct,
+    a concurrent burst revokes it (and coalesces), and a quiet period
+    re-earns it."""
+    X, _ = data
+    with MicroBatcher(executor, max_delay_ms=20, max_queue=256) as b:
+        # phase A: earn direct
+        for i in range(b.DIRECT_AFTER_SINGLETONS + 2):
+            b.submit(X[i:i + 1]).result(30)
+        d_a = _counter("sbt_serving_direct_dispatch_total")
+        c_a = _counter("sbt_serving_coalesced_total")
+        assert b._mode_direct
+
+        # phase B: concurrent burst -> contention revokes the mode
+        gate = threading.Barrier(8)
+
+        def client(k):
+            gate.wait()
+            for j in range(6):
+                b.submit(X[(k * 6 + j) % 200:(k * 6 + j) % 200 + 1]) \
+                    .result(30)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        c_b = _counter("sbt_serving_coalesced_total")
+        assert c_b > c_a, "contended burst should coalesce"
+
+        # phase C: quiet sequential traffic re-earns direct mode
+        for i in range(b.DIRECT_AFTER_SINGLETONS + 4):
+            b.submit(X[i:i + 1]).result(30)
+        assert b._mode_direct
+        assert _counter("sbt_serving_direct_dispatch_total") > d_a
+
+
+def test_direct_path_error_delivery(clf, executor, data):
+    """An inline forward failure is delivered via the future (with the
+    error breakdown), counted, and does not poison the next request."""
+    X, _ = data
+
+    class _Flaky:
+        task = "classification"
+        n_features = clf.n_features_in_
+        classes_ = clf.classes_
+        boom = True
+
+        def forward(self, Xb):
+            if self.boom:
+                self.boom = False
+                raise RuntimeError("injected direct fault")
+            return executor.forward(Xb)
+
+    flaky = _Flaky()
+    with MicroBatcher(flaky, max_delay_ms=2) as b:
+        # earn direct mode on the healthy path
+        flaky.boom = False
+        for i in range(b.DIRECT_AFTER_SINGLETONS):
+            b.submit(X[i:i + 1]).result(30)
+        flaky.boom = True
+        e0 = _counter("sbt_serving_batch_errors_total")
+        bad = b.submit(X[:2])
+        with pytest.raises(RuntimeError, match="injected direct"):
+            bad.result(30)
+        assert _counter("sbt_serving_batch_errors_total") == e0 + 1
+        if bad.trace is not None:
+            assert bad.trace.breakdown["path"] == "direct"
+            assert bad.trace.breakdown["error"].startswith("RuntimeError")
+        # the path survives: next submit serves fine
+        good = b.submit(X[:2]).result(30)
+        np.testing.assert_array_equal(good, clf.predict_proba(X[:2]))
+
+
+def test_stepped_mode_rejects_direct_dispatch(executor):
+    with pytest.raises(ValueError, match="direct_dispatch"):
+        MicroBatcher(executor, threaded=False, direct_dispatch=True)
+
+
+def test_worker_batch_holds_occupancy_slot():
+    """A worker batch in flight occupies the dispatch gate: a submit
+    landing mid-forward on an (empty-again) queue must never be served
+    inline alongside the worker's forward — the occupancy slot is what
+    lets contention revoke direct mode at concurrency 2."""
+
+    class _Stalling:
+        task = "classification"
+        n_features = 10
+        classes_ = np.array([0, 1])
+
+        def __init__(self):
+            self.release = threading.Event()
+            self.entered = threading.Event()
+
+        def forward(self, Xb):
+            self.entered.set()
+            assert self.release.wait(30)
+            return np.zeros((Xb.shape[0], 2), np.float32)
+
+    ex = _Stalling()
+    b = MicroBatcher(ex, max_delay_ms=0, max_queue=8)
+    try:
+        fut = b.submit(np.zeros((1, 10), np.float32))
+        assert ex.entered.wait(10)  # worker is mid-forward, queue empty
+        with b._occ_lock:
+            assert b._occupancy == 1, (
+                "a worker batch must hold an occupancy slot"
+            )
+    finally:
+        ex.release.set()
+        b.close()
+    assert fut.result(10).shape == (1, 2)
+
+
+# -- AOT executable persistence ----------------------------------------
+
+def test_executable_persistence_roundtrip_zero_compiles(
+    clf, data, tmp_path
+):
+    """The instant-warm contract: save a warmed entry, load it into a
+    fresh registry, and serve the whole ladder with ZERO compiles and
+    no lowering (asserted by making _build explode)."""
+    X, _ = data
+    ckpt = str(tmp_path / "warm")
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    reg.register("m", clf, warmup=True)
+    reg.save("m", ckpt)
+    assert os.path.isdir(os.path.join(ckpt, "serving_aot"))
+
+    r0 = _counter("sbt_serving_aot_restored_total")
+    c0 = _counter("sbt_serving_compiles_total")
+    fresh = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    ex = fresh.load("m", ckpt, warm=True)
+    assert _counter("sbt_serving_compiles_total") == c0, (
+        "a warm start from a persisted cache must not compile"
+    )
+    assert _counter("sbt_serving_aot_restored_total") - r0 == 4
+    assert ex.compiled_buckets == (8, 16, 32, 64)
+
+    # no silent lowering either: any _build call from here is a bug
+    def _no_build(bucket):
+        raise AssertionError(f"_build({bucket}) called on a warm start")
+
+    ex._build = _no_build
+    for n in (1, 8, 9, 33, 64, 100):
+        np.testing.assert_array_equal(
+            ex.predict_proba(X[:n]), clf.predict_proba(X[:n])
+        )
+    assert _counter("sbt_serving_compiles_total") == c0
+
+
+def test_executable_cache_key_mismatch_falls_back(clf, data, tmp_path):
+    """A cache built under a different key (here: different bucket
+    ladder) must be IGNORED — the executor lowers as if no cache
+    existed, with a warning and a miss counted."""
+    ckpt = str(tmp_path / "warm2")
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    reg.register("m", clf, warmup=True)
+    reg.save("m", ckpt)
+
+    m0 = _counter("sbt_serving_aot_misses_total")
+    c0 = _counter("sbt_serving_compiles_total")
+    other = ModelRegistry(min_bucket_rows=8, max_batch_rows=128)
+    with pytest.warns(UserWarning, match="different key"):
+        ex = other.load("m", ckpt, warm=True)
+    assert _counter("sbt_serving_aot_misses_total") > m0
+    # fell back to lowering the (8..128) ladder
+    assert _counter("sbt_serving_compiles_total") - c0 == 5
+    X, _ = data
+    np.testing.assert_array_equal(
+        ex.predict_proba(X[:9]), clf.predict_proba(X[:9])
+    )
+
+
+def test_corrupt_aot_manifest_is_a_miss_not_a_crash(clf, data, tmp_path):
+    """Every failure mode of the executable cache is a counted MISS:
+    a mangled manifest (non-dict key, malformed buckets section) must
+    fall back to lowering, never crash a serving process at startup."""
+    ckpt = str(tmp_path / "mangled")
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    reg.register("m", clf, warmup=True)
+    reg.save("m", ckpt)
+    manifest_path = os.path.join(ckpt, "serving_aot", "aot_manifest.json")
+    for payload in (
+        {"key": None, "buckets": {}},
+        {"key": json.loads(open(manifest_path).read())["key"],
+         "buckets": ["bucket_8.bin"]},
+        {"key": json.loads(open(manifest_path).read())["key"],
+         "buckets": {"not-a-number": "bucket_8.bin"}},
+    ):
+        with open(manifest_path, "w") as f:
+            json.dump(payload, f)
+        m0 = _counter("sbt_serving_aot_misses_total")
+        fresh = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+        with pytest.warns(UserWarning):
+            ex = fresh.load(f"m{m0}", ckpt, warm=True)
+        assert _counter("sbt_serving_aot_misses_total") > m0
+        X, _ = data
+        np.testing.assert_array_equal(
+            ex.predict_proba(X[:5]), clf.predict_proba(X[:5])
+        )
+
+
+def test_save_requires_compiled_buckets(clf, tmp_path):
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=64)
+    with pytest.raises(ValueError, match="no compiled buckets"):
+        ex.save_executables(str(tmp_path / "empty"))
+
+
+def test_registry_save_without_executables(clf, tmp_path):
+    """executables=False keeps the checkpoint weights-only; load still
+    works (it just warms up by lowering)."""
+    ckpt = str(tmp_path / "bare")
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    reg.register("m", clf, warmup=True)
+    reg.save("m", ckpt, executables=False)
+    assert not os.path.isdir(os.path.join(ckpt, "serving_aot"))
+    fresh = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    c0 = _counter("sbt_serving_compiles_total")
+    fresh.load("m", ckpt, warm=True)
+    assert _counter("sbt_serving_compiles_total") - c0 == 4
+
+
+# -- the replay padding gate vs the committed baseline -----------------
+
+def test_replay_gate_padding_drops_vs_committed_baseline(tmp_path):
+    """ISSUE 7 acceptance, both halves in one CLI run: the PR-6 replay
+    gate passes against the committed PRE-change baseline (bitwise
+    output digest, compile/latency/rps bands), and the padding-FLOPs
+    waste ratio is STRICTLY below the baseline's (ragged packing at
+    work). Budget: one subprocess, same scale as the test_replay CLI
+    smoke."""
+    out = str(tmp_path / "replay_report.json")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "benchmarks.replay",
+            "--synthetic", "poisson", "--rate", "150",
+            "--duration", "1.0", "--rows", "20", "--seed", "0",
+            "--check", "--baseline", BASELINE, "--out", out,
+        ],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        # the baseline was generated by the plain CLI: single-device
+        # CPU. conftest's 8-virtual-device XLA_FLAGS would compile a
+        # different program and (correctly) fail the bitwise gate, so
+        # the subprocess gets the baseline's device world back.
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+             "SBT_TELEMETRY_DIR": str(tmp_path)},
+    )
+    # exit 0 = every gate check passed. exit 2 is tolerated ONLY when
+    # the failed checks are the host-performance bands (rps/latency vs
+    # a baseline authored on a different, differently-loaded host) —
+    # those bands are the CLI gate's job on a stable perf host, not
+    # this tier-1 test's. The change-relevant invariants (bitwise
+    # output digest, zero compiles, strict padding drop) are
+    # host-independent and asserted hard below.
+    assert proc.returncode in (0, 2), (
+        f"replay gate crashed:\n{proc.stdout[-3000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )
+    report = json.loads(open(out).read())
+    baseline = json.loads(open(BASELINE).read())
+    host_bands = {"rps_vs_baseline", "latency_p50_vs_baseline",
+                  "latency_p95_vs_baseline", "latency_p99_vs_baseline"}
+    hard_failures = [
+        c for c in report["slo"]["checks"]
+        if not c["ok"] and c["name"] not in host_bands
+    ]
+    assert not hard_failures, (
+        f"non-host-band gate checks failed: {hard_failures}\n"
+        f"{proc.stdout[-2000:]}"
+    )
+    # the virtual-mode contract: identical schedule+seed+knobs ->
+    # bitwise-identical outputs, before and after ragged packing
+    assert report["output_digest"] == baseline["output_digest"]
+    assert report["post_warmup_compiles"] == 0
+    got = report["padding"]["waste_flops_frac"]
+    ref = baseline["padding"]["waste_flops_frac"]
+    assert got is not None and ref is not None
+    assert got < ref, (
+        f"padding waste must drop strictly below the pre-change "
+        f"baseline ({ref}), got {got}"
+    )

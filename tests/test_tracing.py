@@ -124,7 +124,10 @@ def test_span_log_links_enqueue_batch_forward_scatter(registry, data):
     links), with forward parented under batch."""
     X, _ = data
     with telemetry.capture() as run:
-        with registry.batcher("m", max_delay_ms=5) as b:
+        # the coalesced pipeline is the subject: pin the adaptive
+        # direct path off (a lone submit would be served inline)
+        with registry.batcher("m", max_delay_ms=5,
+                              direct_dispatch=False) as b:
             fut = b.submit(X[:3])
             fut.result(30)
     tid = fut.trace.trace_id
@@ -159,7 +162,8 @@ def test_concurrent_clients_unique_ids_and_linkage(registry, data):
 
     with telemetry.capture() as run:
         with registry.batcher(
-            "m", max_delay_ms=20, max_queue=256
+            "m", max_delay_ms=20, max_queue=256,
+            direct_dispatch=False,  # batch-linkage contract under test
         ) as b:
             def client(i):
                 rng = np.random.default_rng(i)
@@ -279,10 +283,14 @@ def test_latency_histogram_carries_exemplar_trace(registry, data):
     with registry.batcher("m", max_delay_ms=1) as b:
         fut = b.submit(X[:2])
         fut.result(30)
-    snap = {
-        e["name"]: e for e in telemetry.registry().snapshot()
-    }
-    exemplars = snap["sbt_serving_latency_seconds"].get("exemplars")
+    # the un-labeled series is the overall histogram (the path-labeled
+    # twins added by direct dispatch carry no exemplars)
+    (entry,) = [
+        e for e in telemetry.registry().snapshot()
+        if e["name"] == "sbt_serving_latency_seconds"
+        and not e["labels"]
+    ]
+    exemplars = entry.get("exemplars")
     assert exemplars, "latency histogram should carry exemplars"
     assert any(
         ex["trace_id"] == fut.trace.trace_id for ex in exemplars
@@ -318,7 +326,10 @@ def test_batch_failure_produces_exactly_one_dump(
     rec.arm()
     try:
         flaky = _Flaky(registry.executor("m"))
-        with MicroBatcher(flaky, max_delay_ms=1, max_queue=16) as b:
+        # worker-path incident flow under test; the direct path's
+        # error delivery is covered in test_serving_fastpath.py
+        with MicroBatcher(flaky, max_delay_ms=1, max_queue=16,
+                          direct_dispatch=False) as b:
             bad = b.submit(X[:2])
             with pytest.raises(RuntimeError, match="injected"):
                 bad.result(30)
