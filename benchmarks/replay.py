@@ -36,7 +36,16 @@ backpressure drill — sheds are counted, never fatal), and
 replay (swap-under-fire drill; the swapped-in model is the same
 fitted estimator, so outputs stay bitwise-identical while the full
 swap machinery — validation, bucket pre-compile, version bump —
-exercises under live traffic).
+exercises under live traffic). ``--drift`` is the model-quality
+plane's scripted incident: payloads for arrivals after ``--drift-at``
+come from a covariate-shifted twin of the seeded pool, a quality
+monitor (``telemetry/quality.py``) sketches the stream against the
+model's fit-time reference, and a burn-rate alert rule over
+``sbt_quality_psi_max`` is evaluated on the virtual clock — so the
+same capture + the same seed yield byte-identical drift scores,
+exactly one ``alert_fired`` (every later breach suppressed by the
+active state + cooldown), and exactly one flight-recorder dump for
+the incident, all asserted across repeats and gated by ``--check``.
 
 The gate::
 
@@ -164,22 +173,31 @@ def workload_digest(workload) -> str:
     return h.hexdigest()
 
 
-def _payloads(workload, n_features: int, seed: int):
+def _payloads(workload, n_features: int, seed: int, *,
+              drift_shift: float = 0.0, drift_scale: float = 1.0):
     """Deterministic per-request feature blocks: one seeded pool, each
     request slicing at an index-keyed offset. The workload file records
     the SCHEDULE, not the bytes — payload content comes from the seed,
     which is why the determinism contract is 'same capture + same
-    seed'."""
+    seed'.
+
+    The drift scenario derives a covariate-shifted twin pool
+    (``pool * drift_scale + drift_shift`` — same seeded base bytes, so
+    a drifted replay is exactly as deterministic as a plain one);
+    ``payload(idx, rows, shifted=True)`` slices the twin."""
     import numpy as np
 
     rows_max = max((r.rows for r in workload.requests), default=1)
     pool_n = max(1024, 2 * rows_max)
     rng = np.random.default_rng(seed)
     pool = rng.normal(size=(pool_n, n_features)).astype(np.float32)
+    shifted_pool = (pool * np.float32(drift_scale)
+                    + np.float32(drift_shift))
 
-    def payload(idx: int, rows: int):
+    def payload(idx: int, rows: int, shifted: bool = False):
         start = (idx * 131) % (pool_n - rows_max + 1)
-        return pool[start:start + rows]
+        src = shifted_pool if shifted else pool
+        return src[start:start + rows]
 
     return payload
 
@@ -222,6 +240,12 @@ def replay(
     burst_at: float = 0.5,
     burst_rows: int = 1,
     swaps: int = 0,
+    drift: bool = False,
+    drift_at: float = 0.5,
+    drift_shift: float = 4.0,
+    drift_scale: float = 1.0,
+    psi_threshold: float = 0.5,
+    disagreement_every: int = 8,
     max_delay_ms: float = 2.0,
     idle_flush_ms: float = 1.0,
     max_batch_rows: int = 256,
@@ -261,13 +285,65 @@ def replay(
     if not requests:
         raise ValueError("empty workload")
 
+    if drift and swaps > 0:
+        raise ValueError(
+            "--drift monitors one executor's sketches for the whole "
+            "replay; combine with --swaps is undefined (a swap is a "
+            "new model and a new reference)"
+        )
     target = (registry.executor(model_name) if registry is not None
               else executor)
     ex_provider = ((lambda: registry.executor(model_name))
                    if registry is not None else executor)
-    payload = _payloads(workload, target.n_features, seed)
+    payload = _payloads(workload, target.n_features, seed,
+                        drift_shift=drift_shift if drift else 0.0,
+                        drift_scale=drift_scale if drift else 1.0)
     if warmup and hasattr(target, "warmup"):
         target.warmup()
+
+    # -- drift scenario: monitor + alert engine + flight recorder ------
+    drift_t = workload.duration_s * drift_at if drift else None
+    drifted: set[int] = set()
+    monitor = None
+    alert_engine = None
+    flight = None
+    if drift:
+        from spark_bagging_tpu.telemetry import alerts, quality
+        from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+
+        drifted = {i for i, r in enumerate(requests) if r.t >= drift_t}
+        profile = getattr(getattr(target, "model", None),
+                          "quality_profile_", None)
+        if profile is None:
+            raise ValueError(
+                "--drift needs a model with a fit-time "
+                "quality_profile_ (refit with this build, or serve a "
+                "checkpoint saved by it)"
+            )
+        # refresh_every=1: the psi gauges are exact after every
+        # observe, so the virtual-clock alert engine sees the same
+        # sequence run after run — the determinism contract extends to
+        # the alert transcript
+        monitor = quality.attach(
+            target, refresh_every=1,
+            disagreement_every=disagreement_every,
+        )
+        dur = workload.duration_s or 1.0
+        alert_engine = alerts.AlertEngine([alerts.AlertRule(
+            "replay-feature-drift", "sbt_quality_psi_max",
+            labels=monitor.labels,
+            threshold=psi_threshold, kind="value", op=">",
+            fast_window_s=dur * 0.05, slow_window_s=dur * 0.2,
+            # cooldown spans the rest of the replay: were the alert to
+            # flap, the re-fire would be SUPPRESSED (and counted) —
+            # the exactly-one-alert gate proves the cooldown works
+            cooldown_s=dur * 10,
+        )])
+        # a dedicated recorder (not the process default): its dump
+        # count is this run's incident count, uncontaminated by other
+        # recorders' cooldown state, and disarmed in finally
+        flight = FlightRecorder(cooldown_s=dur * 10)
+        flight.arm()
 
     reg_counters = telemetry.registry()
 
@@ -331,11 +407,17 @@ def replay(
                 for idx in window:
                     try:
                         futs[idx] = batcher.submit(
-                            payload(idx, requests[idx].rows)
+                            payload(idx, requests[idx].rows,
+                                    idx in drifted)
                         )
                     except Overloaded:
                         overloads += 1
                 batcher.run_pending()
+                if alert_engine is not None:
+                    # tick on the VIRTUAL clock (the window's open
+                    # time): alert transitions become a pure function
+                    # of the workload + seed, asserted across repeats
+                    alert_engine.evaluate(now=requests[window[0]].t)
         else:
             swap_at = (
                 {int((k + 1) * n / (swaps + 1)) for k in range(swaps)}
@@ -348,9 +430,13 @@ def replay(
                 if delay > 0:
                     time.sleep(delay)
                 try:
-                    futs[idx] = batcher.submit(payload(idx, r.rows))
+                    futs[idx] = batcher.submit(
+                        payload(idx, r.rows, idx in drifted)
+                    )
                 except Overloaded:
                     overloads += 1
+                if alert_engine is not None:
+                    alert_engine.evaluate(now=r.t)
             for f in futs.values():
                 try:
                     f.exception(timeout_s)  # wait without re-raising
@@ -359,6 +445,10 @@ def replay(
         wall = time.perf_counter() - t_wall0
     finally:
         batcher.close()
+        if flight is not None:
+            flight.disarm()
+        if monitor is not None and hasattr(target, "detach_quality"):
+            target.detach_quality()
 
     # -- collect what the tracing plane observed -----------------------
     out_h = hashlib.sha256()
@@ -423,6 +513,28 @@ def replay(
                              if flops_d else None),
     }
 
+    drift_report = None
+    if drift:
+        scores = monitor.drift()
+        (rule_state,) = alert_engine.state()["rules"]
+        drift_report = {
+            "onset_s": round(drift_t, 6),
+            "shift": drift_shift,
+            "scale": drift_scale,
+            "psi_threshold": psi_threshold,
+            "scores": scores,
+            # the byte-identity handle: same capture + same seed must
+            # reproduce these floats exactly, run after run
+            "digest": hashlib.sha256(
+                json.dumps(scores, sort_keys=True).encode()
+            ).hexdigest(),
+            "alerts_fired": rule_state["fired"],
+            "alerts_resolved": rule_state["resolved"],
+            "alerts_suppressed": rule_state["suppressed"],
+            "alert_active": rule_state["active"],
+            "flight_dumps": len(flight.dumps),
+        }
+
     import jax
 
     live = (registry.executor(model_name) if registry is not None
@@ -476,6 +588,7 @@ def replay(
         },
         "composition_digest": comp_h.hexdigest(),
         "output_digest": out_h.hexdigest(),
+        "drift": drift_report,
     }
 
 
@@ -506,6 +619,19 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                         f"determinism violation across repeats: {key} "
                         f"changed ({head[key]!r} -> {r[key]!r})"
                     )
+            if head.get("drift") is not None:
+                # drift scores are float-for-float reproducible and
+                # the alert transcript is part of the contract
+                for key in ("digest", "alerts_fired",
+                            "alerts_resolved", "alerts_suppressed",
+                            "flight_dumps"):
+                    if r["drift"][key] != head["drift"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"drift.{key} changed "
+                            f"({head['drift'][key]!r} -> "
+                            f"{r['drift'][key]!r})"
+                        )
     merged = dict(head)
     merged["repeats"] = repeats
     merged["rps_runs"] = sorted(r["rps"] for r in runs)
@@ -524,18 +650,51 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     return merged
 
 
+def _drift_checks(report: dict) -> list[dict]:
+    """The drift-scenario gate: exactly one alert for the one scripted
+    incident (the burn-rate windows absorbed the onset, the
+    active-state + cooldown machinery suppressed every re-fire), one
+    flight dump recorded for it, and the drift signal actually crossed
+    the rule threshold."""
+    d = report.get("drift") or {}
+
+    def eq(name: str, actual, want) -> dict:
+        return {"name": name, "actual": actual, "limit": want,
+                "op": "==", "ok": actual == want}
+
+    fired = d.get("alerts_fired")
+    return [
+        eq("drift_alerts_fired", fired, 1),
+        eq("drift_flight_dumps", d.get("flight_dumps"), 1),
+        {
+            "name": "drift_psi_max",
+            "actual": (d.get("scores") or {}).get("psi_max"),
+            "limit": d.get("psi_threshold"), "op": ">",
+            "ok": bool(
+                (d.get("scores") or {}).get("psi_max") is not None
+                and d["scores"]["psi_max"] > (d.get("psi_threshold")
+                                              or 0.0)
+            ),
+        },
+    ]
+
+
 def check_report(report: dict, *, spec=None, baseline: dict | None = None,
                  rps_tolerance: float | None = None,
                  latency_tolerance: float | None = None):
     """Gate a replay report: absolute SLO spec plus (optionally) the
-    baseline regression bands. Returns one combined
-    :class:`telemetry.slo.SLOResult`."""
+    baseline regression bands, plus — when the report carries a drift
+    scenario — the exactly-one-alert drift checks. Returns one
+    combined :class:`telemetry.slo.SLOResult`."""
     from spark_bagging_tpu.telemetry import slo
 
     if spec is None:
         spec = slo.SLOSpec()
     checks = list(slo.evaluate(spec, report).checks)
     kind = "absolute"
+    if report.get("drift") is not None:
+        checks += _drift_checks(report)
+        kind = "absolute+drift"
     if baseline is not None:
         kw = {}
         if rps_tolerance is not None:
@@ -543,7 +702,7 @@ def check_report(report: dict, *, spec=None, baseline: dict | None = None,
         if latency_tolerance is not None:
             kw["latency_tolerance"] = latency_tolerance
         checks += slo.compare_to_baseline(report, baseline, **kw).checks
-        kind = "absolute+baseline"
+        kind += "+baseline"
     return slo.SLOResult(checks, kind=kind)
 
 
@@ -598,6 +757,23 @@ def main(argv: list[str] | None = None) -> int:
     drv.add_argument("--burst-at", type=float, default=0.5)
     drv.add_argument("--swaps", type=int, default=0,
                      help="hot-swap the model N times mid-replay")
+    drv.add_argument("--drift", action="store_true",
+                     help="splice a seeded covariate-shifted payload "
+                          "segment in at --drift-at; attaches a "
+                          "quality monitor + burn-rate alert rule and "
+                          "gates on exactly one alert_fired (the "
+                          "model-quality plane's scripted incident)")
+    drv.add_argument("--drift-at", type=float, default=0.5,
+                     help="drift onset as a fraction of the workload "
+                          "duration")
+    drv.add_argument("--drift-shift", type=float, default=4.0,
+                     help="additive covariate shift of the drifted "
+                          "segment's payload pool")
+    drv.add_argument("--drift-scale", type=float, default=1.0,
+                     help="multiplicative scale of the drifted "
+                          "segment's payload pool")
+    drv.add_argument("--psi-threshold", type=float, default=0.5,
+                     help="PSI threshold of the drift alert rule")
     drv.add_argument("--max-delay-ms", type=float, default=2.0)
     drv.add_argument("--idle-flush-ms", type=float, default=1.0)
     drv.add_argument("--max-batch-rows", type=int, default=256)
@@ -673,6 +849,10 @@ def main(argv: list[str] | None = None) -> int:
         if args.swaps:
             ap.error("--throttle-ms wraps a bare executor; it cannot "
                      "combine with --swaps (a registry operation)")
+        if args.drift:
+            ap.error("--throttle-ms wraps a bare executor with no "
+                     "model attached; it cannot combine with --drift "
+                     "(which needs the model's quality profile)")
         target = {"executor": ThrottledExecutor(
             reg.executor("replay"), delay_s=args.throttle_ms / 1e3,
         )}
@@ -681,6 +861,9 @@ def main(argv: list[str] | None = None) -> int:
         wl, repeats=args.repeats, **target,
         mode=args.mode, speed=args.speed,
         burst=args.burst, burst_at=args.burst_at, swaps=args.swaps,
+        drift=args.drift, drift_at=args.drift_at,
+        drift_shift=args.drift_shift, drift_scale=args.drift_scale,
+        psi_threshold=args.psi_threshold,
         max_delay_ms=args.max_delay_ms,
         idle_flush_ms=args.idle_flush_ms,
         max_batch_rows=args.max_batch_rows,
@@ -705,12 +888,22 @@ def main(argv: list[str] | None = None) -> int:
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
-    print(json.dumps({
+    summary = {
         k: report[k] for k in (
             "mode", "n_requests", "served", "overloads", "batches",
             "post_warmup_compiles", "rps", "latency_ms", "swaps",
         )
-    }))
+    }
+    if report.get("drift") is not None:
+        d = report["drift"]
+        summary["drift"] = {
+            "psi_max": round(d["scores"]["psi_max"], 4),
+            "alerts_fired": d["alerts_fired"],
+            "alerts_suppressed": d["alerts_suppressed"],
+            "flight_dumps": d["flight_dumps"],
+            "digest": d["digest"][:16],
+        }
+    print(json.dumps(summary))
     print(f"report: {out}")
     if result is not None:
         print(result.render())

@@ -15,6 +15,8 @@ With the live observability plane (scrape it while it runs):
     curl :9100/healthz        # batcher liveness + live model version
     curl :9100/metrics        # Prometheus text, sbt_serving_* series
     curl :9100/varz           # JSON snapshot incl. latency quantiles
+    curl :9100/debug/drift    # live drift scores vs the fit reference
+    curl :9100/alerts         # burn-rate alert rule states
 
 The traffic is also CAPTURED as a replayable workload file — the
 record half of record→replay→report; replay it afterwards with:
@@ -48,6 +50,15 @@ registry = ModelRegistry(min_bucket_rows=8, max_batch_rows=128)
 registry.register("cancer", clf_v1, warmup=True)
 executor = registry.executor("cancer")
 print(f"warmed buckets  : {executor.compiled_buckets}")
+
+# -- model-quality plane: drift sketches + ensemble disagreement ------
+# sticky per entry: the swap below re-attaches a fresh monitor against
+# the new model's own fit-time reference profile
+registry.enable_quality("cancer", refresh_every=64,
+                        disagreement_every=8)
+# rules sample the monitor's per-model gauges: labels must match
+telemetry.alerts.install(telemetry.alerts.default_drift_rules(
+    labels={"model": "cancer"}))
 if (addr := telemetry.server_address()) is not None:
     host, port = addr
     print(f"metrics server  : http://{host}:{port}  "
@@ -103,6 +114,20 @@ print(f"batches         : {int(reg.counter('sbt_serving_batches_total').value)}"
       " requests/forward)")
 print(f"compiles        : {int(reg.counter('sbt_serving_compiles_total').value)}"
       " (all during warmup/swap — zero per-request)")
+
+# -- the model-quality plane's own /debug/drift summary ---------------
+# (the same dict the scrape server serves at /debug/drift)
+drift_view = telemetry.quality.debug_summary()
+for mon in drift_view["monitors"]:
+    drift = mon["drift"] or {}
+    print("drift           : "
+          f"rows={mon['rows_observed']}  "
+          f"psi_max={drift.get('psi_max', 0.0):.3f}  "
+          f"confidence_psi={drift.get('confidence_psi', 0.0):.3f}  "
+          f"disagreement={drift.get('disagreement_mean', 0.0):.3f}  "
+          f"(warmed={drift.get('warmed')})")
+telemetry.alerts.get().evaluate()
+print(f"alerts          : active={telemetry.alerts.get().active()}")
 
 # -- the captured workload: this traffic is now a regression test -----
 captured = telemetry.workload.stop()

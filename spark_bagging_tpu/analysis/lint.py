@@ -384,7 +384,7 @@ def lint_paths(
 # -- config ------------------------------------------------------------
 
 DEFAULT_CONFIG = {
-    "paths": ["spark_bagging_tpu", "benchmarks"],
+    "paths": ["spark_bagging_tpu", "benchmarks", "examples"],
     "exclude": [],
     "disable": [],
 }

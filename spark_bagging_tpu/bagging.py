@@ -40,9 +40,11 @@ from jax.sharding import PartitionSpec as P
 
 from spark_bagging_tpu.ensemble import (
     classifier_forward,
+    classifier_replica_forward,
     fit_ensemble,
     oob_predict_scores,
     regressor_forward,
+    regressor_replica_forward,
 )
 from spark_bagging_tpu.models.base import BaseLearner
 from spark_bagging_tpu.models.linear import LinearRegression
@@ -465,7 +467,34 @@ class _BaseBagging(ParamsMixin):
             )
         return self._forward_closure(), self.ensemble_, self.subspaces_
 
+    def replica_forward(self):
+        """The fitted ensemble's PER-REPLICA forward as a jit-able
+        handle — :meth:`aggregated_forward` with the vote/mean
+        aggregation seam removed.
+
+        Returns ``(fn, params, subspaces)`` where ``fn(params,
+        subspaces, X)`` yields ``(R, n, C)`` per-replica probabilities
+        for classifiers and ``(R, n)`` per-replica predictions for
+        regressors. The replica axis is bagging's free uncertainty
+        signal (bagged posteriors, arXiv 2007.14845): the quality
+        plane's ensemble-disagreement tap samples batches through this
+        handle, and the served-uncertainty work (ROADMAP item 4) hangs
+        interval/variance heads off it. Same single-device contract as
+        :meth:`aggregated_forward`.
+        """
+        self._check_fitted()
+        if self.mesh is not None:
+            raise ValueError(
+                "replica_forward is the single-device serving handle; "
+                "save() the mesh-fitted ensemble and load() it without "
+                "a mesh to serve it"
+            )
+        return self._replica_closure(), self.ensemble_, self.subspaces_
+
     def _forward_closure(self):
+        raise NotImplementedError  # per-task subclasses build it
+
+    def _replica_closure(self):
         raise NotImplementedError  # per-task subclasses build it
 
     @property
@@ -877,6 +906,46 @@ class _BaseBagging(ParamsMixin):
         self.fit_report_["chunk_size_resolved"] = chunk_size
         if id_start > 0:
             self.fit_report_["warm_started_from"] = id_start
+        # Fit-time quality reference (telemetry/quality.py): the drift
+        # comparand the serving monitors score live traffic against.
+        # Fixed-size (per-feature decile histograms over a strided row
+        # subsample + the label distribution), checkpointed with the
+        # weights, and best-effort — a profiling failure must never
+        # fail the fit it describes.
+        self.quality_profile_ = None
+        try:
+            from spark_bagging_tpu.telemetry.quality import (
+                ReferenceProfile,
+            )
+
+            with telemetry.span("quality_profile"):
+                # one plain d2h pull (np.asarray — zero-copy on the
+                # CPU backend; a jnp strided slice here would
+                # XLA-compile per novel shape, hundreds of tiny
+                # compiles across a test suite's fits); from_training
+                # owns the row striding, so profile.n_rows records the
+                # TRUE training size and the max_rows knob lives in
+                # exactly one place
+                # sbt-lint: disable=host-sync-in-span — the span times the profile pass; the d2h pull IS the measured work
+                Xh = np.asarray(X)
+                # sbt-lint: disable=host-sync-in-span — same measured d2h pull as X above
+                yh = np.asarray(y)
+                self.quality_profile_ = ReferenceProfile.from_training(
+                    Xh, yh,
+                    task=self.task,
+                    n_classes=(n_outputs
+                               if self.task == "classification"
+                               else None),
+                )
+        except Exception as e:  # noqa: BLE001 — monitoring is optional
+            import warnings
+
+            warnings.warn(
+                f"quality reference profile not computed: {e!r} "
+                "(drift monitoring unavailable for this model)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def _fit_stream_engine(
         self, source, n_outputs: int, *, n_epochs: int,
@@ -908,6 +977,10 @@ class _BaseBagging(ParamsMixin):
 
         if self.n_estimators < 1:
             raise ValueError("n_estimators must be >= 1")
+        # a stream fit computes no quality reference (the data never
+        # sits in memory to profile); a stale profile from a previous
+        # in-memory fit must not describe THIS model's training data
+        self.quality_profile_ = None
         ratio = self._sample_ratio(int(source.n_rows))
         if self.oob_score and not self.bootstrap and ratio >= 1.0:
             raise ValueError(
@@ -1309,6 +1382,14 @@ class BaggingClassifier(_BaseBagging):
             has_vote[:, None], counts / np.maximum(votes, 1)[:, None],
             np.nan,
         )
+        # OOB rows are the honest confidence reference for the quality
+        # plane: held-out per-row max probability, free at fit time
+        prof = getattr(self, "quality_profile_", None)
+        if prof is not None and has_vote.any():
+            prof.set_confidence_reference(
+                self.oob_decision_function_[has_vote].max(axis=1),
+                source="oob",
+            )
 
     def fit(self, X, y, sample_weight=None) -> "BaggingClassifier":
         """Fit the ensemble. ``sample_weight`` (the reference's
@@ -1447,6 +1528,17 @@ class BaggingClassifier(_BaseBagging):
         the ``predict_proba`` jit (same ``classifier_forward``)."""
         return classifier_forward(
             self._fitted_learner, self.n_classes_, self.n_estimators_,
+            voting=self.voting, chunk_size=self._eff_chunk(),
+            identity_subspace=self._identity_subspace,
+        )
+
+    def _replica_closure(self):
+        """Per-replica ``(R, n, C)`` — the aggregation-free twin of
+        :meth:`_forward_closure`, honoring ``voting``: its mean over
+        axis 0 is the served probability (soft) / vote-frequency
+        vector (hard)."""
+        return classifier_replica_forward(
+            self._fitted_learner, self.n_classes_,
             voting=self.voting, chunk_size=self._eff_chunk(),
             identity_subspace=self._identity_subspace,
         )
@@ -1665,6 +1757,15 @@ class BaggingRegressor(_BaseBagging):
         return regressor_forward(
             self._fitted_learner, self.n_estimators_,
             chunk_size=self._eff_chunk(),
+            identity_subspace=self._identity_subspace,
+        )
+
+    def _replica_closure(self):
+        """Per-replica predictions ``(R, n)`` — the aggregation-free
+        twin of :meth:`_forward_closure` (its mean over axis 0 is the
+        served prediction)."""
+        return regressor_replica_forward(
+            self._fitted_learner, chunk_size=self._eff_chunk(),
             identity_subspace=self._identity_subspace,
         )
 

@@ -292,6 +292,65 @@ def regressor_forward(
     return forward
 
 
+def classifier_replica_forward(
+    learner: BaseLearner,
+    n_classes: int,
+    *,
+    voting: str = "soft",
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+):
+    """The PER-REPLICA classifier forward as a pure jit-able closure
+    ``forward(stacked_params, subspaces, X) -> (R, n, C)`` —
+    :func:`classifier_forward` with the aggregation seam removed.
+
+    This is the uncertainty seam: per replica it emits exactly what
+    the aggregate averages — softmax probabilities for ``soft``
+    voting, a one-hot of the replica's argmax for ``hard`` voting —
+    so ``mean(axis=0)`` of its output IS the served probability /
+    vote-frequency vector, while the replica axis it preserves
+    carries the bagged-posterior spread the quality plane's
+    disagreement tap (and ROADMAP item 4's interval heads) consume.
+    (Were hard voting to reuse the softmax variant, the tap would
+    score replicas against a soft-vote argmax the model never serves.)
+    """
+    if voting not in ("soft", "hard"):
+        raise ValueError(f"unknown voting {voting!r}")
+
+    def forward(stacked_params, subspaces, X):
+        scores = predict_scores_ensemble(
+            learner, stacked_params, subspaces, X,
+            chunk_size=chunk_size, identity_subspace=identity_subspace,
+        )
+        if voting == "hard":
+            return jax.nn.one_hot(
+                jnp.argmax(scores, axis=-1), n_classes,
+                dtype=jnp.float32,
+            )
+        return jax.nn.softmax(scores, axis=-1)
+
+    return forward
+
+
+def regressor_replica_forward(
+    learner: BaseLearner,
+    *,
+    chunk_size: int | None = None,
+    identity_subspace: bool = False,
+):
+    """The PER-REPLICA regressor forward as a pure jit-able closure
+    ``forward(stacked_params, subspaces, X) -> (R, n) predictions`` —
+    see :func:`classifier_replica_forward`."""
+
+    def forward(stacked_params, subspaces, X):
+        return predict_scores_ensemble(
+            learner, stacked_params, subspaces, X,
+            chunk_size=chunk_size, identity_subspace=identity_subspace,
+        )
+
+    return forward
+
+
 def oob_predict_scores(
     learner: BaseLearner,
     stacked_params: Any,

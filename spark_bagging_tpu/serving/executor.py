@@ -111,6 +111,19 @@ class EnsembleExecutor:
         # rows into FLOPs
         self.bucket_costs: dict[int, dict[str, float | None]] = {}
         self._build_lock = make_lock("serving.executor.build")
+        # model-quality tap (telemetry/quality.py): None until a
+        # monitor is attached — the hot-path gate is ONE attribute
+        # read, the zero-overhead-when-disabled contract
+        self._quality = None
+        self._quality_warned = False
+        # per-replica forward for the disagreement tap: resolved and
+        # compiled lazily per bucket on first sampled batch; its
+        # compiles count in sbt_quality_disagreement_compiles_total,
+        # NOT the serving compile counter — the zero-post-warmup-
+        # compile gate is about the serving path, and the tap is not it
+        self._replica_fn = None
+        self._replica_compiled: dict[int, Any] = {}
+        self._replica_unavailable = False
         # stamped by ModelRegistry on register/swap; standalone
         # executors serve as anonymous version None
         self.model_name: str | None = None
@@ -215,6 +228,129 @@ class EnsembleExecutor:
 
         return restore_executables(self, path)
 
+    # -- model-quality tap ---------------------------------------------
+
+    def attach_quality(self, monitor) -> None:
+        """Install a quality monitor (see ``telemetry.quality.attach``,
+        which also registers it for ``/debug/drift``). The forward
+        feeds it per packed batch; ``None`` detaches."""
+        # sbt-lint: disable=shared-state-unlocked — single-reference last-write-wins swap; the hot path reads it exactly once per batch
+        self._quality = monitor
+        # a FRESH monitor deserves a fresh failure warning: without
+        # the reset, monitor B dying after monitor A already warned
+        # would detach silently and the model would serve unmonitored
+        # with zero operator signal
+        # sbt-lint: disable=shared-state-unlocked — same benign last-write-wins as the monitor reference above
+        self._quality_warned = False
+
+    def detach_quality(self) -> None:
+        # sbt-lint: disable=shared-state-unlocked — see attach_quality
+        self._quality = None
+
+    @property
+    def quality(self):
+        """The attached quality monitor, or None."""
+        return self._quality
+
+    def warmup_replica(self, buckets=None) -> tuple[int, ...]:
+        """Compile the per-replica (disagreement-tap) forward ahead of
+        traffic — default: every bucket the SERVING forward already
+        has compiled. ``telemetry.quality.attach`` calls this when
+        disagreement sampling is on (so sticky swap re-attaches do
+        too): without it, the first sampled batch at each rung would
+        absorb a full XLA compile stall on the live serving thread.
+        Returns the buckets built (empty when the model exposes no
+        per-replica seam)."""
+        if buckets is None:
+            buckets = self.compiled_buckets
+        built = []
+        for b in buckets:
+            b = bucket_for(int(b), self.min_bucket_rows,
+                           self.max_batch_rows)
+            if b not in self._replica_compiled:
+                if self._build_replica(b) is None:
+                    break  # seam unavailable: nothing else will build
+                built.append(b)
+        return tuple(built)
+
+    def _build_replica(self, bucket: int):
+        """Compile the per-replica (aggregation-free) forward for one
+        bucket — the disagreement tap's executable. Same double-checked
+        build lock as :meth:`_build`; no donation (the tap re-reads the
+        slab the serving forward already consumed). Returns None when
+        the model exposes no per-replica seam."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._replica_unavailable:
+            return None
+        with self._build_lock:
+            fn = self._replica_compiled.get(bucket)
+            if fn is not None:
+                return fn
+            if self._replica_fn is None:
+                try:
+                    self._replica_fn, _, _ = self.model.replica_forward()
+                except (AttributeError, NotImplementedError) as e:
+                    # sbt-lint: disable=shared-state-unlocked — under self._build_lock
+                    self._replica_unavailable = True
+                    import warnings
+
+                    warnings.warn(
+                        "ensemble-disagreement tap disabled: the model "
+                        f"exposes no replica_forward() ({e!r})",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    return None
+            with telemetry.span("quality_replica_compile",
+                                bucket=bucket):
+                jitted = jax.jit(self._replica_fn)
+                Xz = jnp.zeros((bucket, self.n_features), jnp.float32)
+                compiled = jitted.lower(
+                    self._params, self._subspaces, Xz
+                ).compile()
+            telemetry.inc("sbt_quality_disagreement_compiles_total")
+            self._replica_compiled[bucket] = compiled
+            return compiled
+
+    def _replica_piece(self, Xp: np.ndarray, fill: int):
+        """Per-replica output for one slab's real rows — ``(R, fill,
+        C)`` / ``(R, fill)`` — or None when the seam is unavailable."""
+        bucket = Xp.shape[0]
+        compiled = self._replica_compiled.get(bucket)
+        if compiled is None:
+            compiled = self._build_replica(bucket)
+            if compiled is None:
+                return None
+        out = np.asarray(compiled(self._params, self._subspaces, Xp))
+        return out[:, :fill]
+
+    def _feed_quality(self, mon, parts, outs, first_slab) -> None:
+        """Deliver one packed batch to the attached monitor (sketches
+        + sampled disagreement). Monitoring faults must never fail the
+        serving it observes: first failure warns and detaches."""
+        try:
+            mon.observe_parts(parts, outs)
+            if first_slab is not None and mon.wants_disagreement():
+                rep = self._replica_piece(*first_slab)
+                if rep is not None:
+                    mon.observe_disagreement(rep, task=self.task)
+        except Exception as e:  # noqa: BLE001 — the tap is optional
+            # sbt-lint: disable=shared-state-unlocked — last-write-wins detach on failure; racing feeders at worst both detach
+            self._quality = None
+            if not self._quality_warned:
+                # sbt-lint: disable=shared-state-unlocked — worst case under a race is a second warning, never a lost detach
+                self._quality_warned = True
+                import warnings
+
+                warnings.warn(
+                    f"quality monitor detached after a tap failure: "
+                    f"{e!r}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+
     # -- the forward ---------------------------------------------------
 
     def _validate(self, X) -> np.ndarray:
@@ -270,6 +406,7 @@ class EnsembleExecutor:
         # gather: walk the blocks once, filling each slab in order;
         # only the last slab is partial (pack_plan's fill rule)
         slab_outs: list[np.ndarray] = []
+        first_slab: tuple[np.ndarray, int] | None = None
         part_i = 0
         part_off = 0
         remaining = n
@@ -301,6 +438,10 @@ class EnsembleExecutor:
                     if part_off == part.shape[0]:
                         part_i += 1
                         part_off = 0
+            if first_slab is None:
+                # kept for the (sampled) disagreement tap: one slab per
+                # packed batch is the tap's unit of work
+                first_slab = (Xp, fill)
             slab_outs.append(self._forward_piece(Xp, fill))
         # scatter back: slice each block's rows out of the slab outputs
         # (views when a block sat inside one slab; boundary-spanning
@@ -322,6 +463,15 @@ class EnsembleExecutor:
                     slab_off = 0
             outs.append(pieces[0] if len(pieces) == 1
                         else np.concatenate(pieces))
+        # model-quality tap: one attribute read when no monitor is
+        # attached (the zero-overhead contract). This seam sits under
+        # BOTH dispatch paths — the coalescing worker's forward_parts
+        # and the direct-dispatch inline serve — and feeds real rows
+        # only (padding never reaches the sketches). Outputs are
+        # already finalized above: the tap cannot change what is served.
+        mon = self._quality
+        if mon is not None:
+            self._feed_quality(mon, parts, outs, first_slab)
         return outs
 
     # sbt-lint: hot-path

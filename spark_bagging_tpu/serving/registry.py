@@ -38,7 +38,7 @@ from spark_bagging_tpu.serving.executor import EnsembleExecutor
 
 
 class _Entry:
-    __slots__ = ("name", "version", "executor", "opts")
+    __slots__ = ("name", "version", "executor", "opts", "quality_opts")
 
     def __init__(self, name: str, version: int,
                  executor: EnsembleExecutor, opts: dict):
@@ -46,6 +46,9 @@ class _Entry:
         self.version = version
         self.executor = executor
         self.opts = opts
+        # sticky quality-monitoring options (enable_quality); None
+        # means the entry is not drift-monitored
+        self.quality_opts: dict | None = None
 
 
 # sbt-lint: shared-state
@@ -219,7 +222,82 @@ class ModelRegistry:
         telemetry.inc("sbt_serving_swaps_total")
         telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})
+        if entry.quality_opts is not None:
+            # drift monitoring is sticky per entry: re-attach to the
+            # NEW executor with FRESH sketches against the new model's
+            # own reference — a new model is a new "normal", and the
+            # old monitor's accumulated counts describe traffic scored
+            # against a profile that no longer serves. Best-effort:
+            # the swap is already COMMITTED (executor live, version
+            # bumped), so a monitoring failure here — typically a
+            # replacement model with no quality_profile_ (stream fit,
+            # older checkpoint) — must warn, not masquerade as a
+            # rejected swap the caller would retry or roll back
+            try:
+                self._attach_quality(new, entry.quality_opts)
+            except Exception as e:  # noqa: BLE001 — monitoring is optional
+                import warnings
+
+                warnings.warn(
+                    f"swap of {name!r} succeeded but drift monitoring "
+                    f"could not re-attach: {e} (version {version} "
+                    "serves UNMONITORED; fit the replacement with this "
+                    "build or disable_quality first)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return new
+
+    def enable_quality(self, name: str,
+                       **monitor_opts: Any):
+        """Attach a drift monitor (``telemetry.quality``) to ``name``'s
+        live executor and make it sticky: every future :meth:`swap` /
+        :meth:`load` re-attaches a fresh monitor to the replacement
+        executor (new model ⇒ new reference ⇒ fresh sketches).
+        ``monitor_opts`` are ``QualityMonitor`` options
+        (``refresh_every``, ``disagreement_every``, ...) plus an
+        optional ``profile=`` override — which applies to the CURRENT
+        executor only and is never sticky: a swapped-in model is
+        scored against its own fit-time ``quality_profile_``, not a
+        reference authored for its predecessor. Returns the monitor.
+        """
+        entry = self._entry(name)
+        with self._lock:
+            # sticky flag FIRST, executor snapshot under the same
+            # lock: a swap() interleaving after this block either saw
+            # the flag (and re-attaches to its new executor) or
+            # committed before our read (and we attach to the new
+            # executor) — either way the LIVE model ends up monitored.
+            # 'profile' and 'monitor' are per-attach, never sticky: a
+            # swapped-in model must be scored against its OWN
+            # reference with FRESH sketches, and replaying a caller's
+            # monitor= instance would re-install the predecessor's
+            # profile and accumulated counts verbatim.
+            entry.quality_opts = {
+                k: v for k, v in monitor_opts.items()
+                if k not in ("profile", "monitor")
+            }
+            ex = entry.executor
+        return self._attach_quality(ex, monitor_opts)
+
+    def disable_quality(self, name: str) -> None:
+        """Detach ``name``'s drift monitor and clear the sticky flag."""
+        entry = self._entry(name)
+        with self._lock:
+            # clear-then-snapshot under the lock (mirror of
+            # enable_quality): a racing swap either sees the cleared
+            # flag (no re-attach) or committed first (we detach its
+            # new executor) — a model can never stay monitored after
+            # disable_quality returns
+            entry.quality_opts = None
+            ex = entry.executor
+        ex.detach_quality()
+
+    @staticmethod
+    def _attach_quality(executor: EnsembleExecutor, opts: dict):
+        from spark_bagging_tpu.telemetry import quality
+
+        return quality.attach(executor, **opts)
 
     #: subdirectory of a checkpoint dir where :meth:`save` persists the
     #: bucket executables and :meth:`load` looks for them

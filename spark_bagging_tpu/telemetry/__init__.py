@@ -30,6 +30,12 @@ is the TPU-native equivalent, one subsystem with three layers:
    ``future.trace`` with a queue/batch/forward timing breakdown), and
    a ring-buffer flight recorder that dumps ``flight_<ts>.json`` on
    serving faults.
+5. **Model-quality plane** (``quality.py`` / ``alerts.py``) —
+   streaming drift detection against a fit-time reference profile
+   (``sbt_quality_*`` PSI/KS gauges, ensemble-disagreement sampling)
+   plus a declarative burn-rate alert engine over live registry
+   series (``sbt_alerts_*``; ``alert_fired`` events trigger the
+   flight recorder). Served at ``/debug/drift`` and ``/alerts``.
 
 Cost contract: **zero overhead when disabled** — every instrumentation
 site in the engines guards on :func:`enabled` (one attribute read) or
@@ -70,7 +76,14 @@ from spark_bagging_tpu.telemetry.sinks import (
 )
 from spark_bagging_tpu.telemetry.spans import phase, span
 from spark_bagging_tpu.telemetry.state import STATE as _state
-from spark_bagging_tpu.telemetry import recorder, slo, tracing, workload
+from spark_bagging_tpu.telemetry import (
+    alerts,
+    quality,
+    recorder,
+    slo,
+    tracing,
+    workload,
+)
 
 # the exposition server's names resolve lazily (module __getattr__
 # below): its http.server import chain costs ~100ms of stdlib, which
@@ -86,6 +99,7 @@ __all__ = [
     "read_events", "last_metrics_snapshot", "runs",
     "record_fit_report", "Registry", "reset", "telemetry_dir",
     "default_log_path", "tracing", "recorder", "workload", "slo",
+    "quality", "alerts",
     "sinks_active", "arrival_events_wanted", "start_server",
     "stop_server", "server_address",
 ]

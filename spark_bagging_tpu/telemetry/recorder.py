@@ -20,6 +20,8 @@ Triggers (event ``kind``):
 
 - ``serving_batch_error`` — an executor forward failed a micro-batch;
 - ``swap_rejected`` — a hot-swap failed contract validation;
+- ``alert_fired`` — the quality plane's alert engine tripped a rule
+  (drift, burn rate — see ``telemetry/alerts.py``);
 - ``serving_overloaded`` — only as a BURST: ``burst_threshold``
   rejections inside ``burst_window_s`` (a single shed request is
   backpressure working as designed; a burst is an incident).
@@ -44,8 +46,10 @@ from spark_bagging_tpu.analysis.locks import make_lock
 
 DUMP_SCHEMA_VERSION = 1
 
-# event kinds that dump immediately (one incident = one event)
-TRIGGER_KINDS = ("serving_batch_error", "swap_rejected")
+# event kinds that dump immediately (one incident = one event);
+# alert_fired is the quality plane's contribution — an alert arrives
+# with the black box of the traffic that tripped it
+TRIGGER_KINDS = ("serving_batch_error", "swap_rejected", "alert_fired")
 # event kind that dumps only as a burst
 BURST_KIND = "serving_overloaded"
 

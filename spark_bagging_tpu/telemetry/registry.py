@@ -83,6 +83,25 @@ SERIES_HELP: dict[str, str] = {
     "sbt_serving_bucket_cost_bytes": "Compiled bytes accessed per forward at this bucket (gauge, label bucket)",
     "sbt_serving_flops_total": "FLOPs dispatched by serving forwards (cost-analysis attributed)",
     "sbt_serving_padding_flops_total": "FLOPs spent on padding rows (waste, cost-analysis attributed)",
+    "sbt_quality_rows_total": "Rows folded into the quality plane's live sketches",
+    "sbt_quality_psi_max": "Max per-feature PSI of live traffic vs the training reference (gauge)",
+    "sbt_quality_psi_mean": "Mean per-feature PSI vs the training reference (gauge)",
+    "sbt_quality_ks_max": "Max per-feature binned KS statistic vs the training reference (gauge)",
+    "sbt_quality_feature_psi": "Per-feature PSI vs the training reference (gauge, label feature)",
+    "sbt_quality_feature_ks": "Per-feature binned KS vs the training reference (gauge, label feature)",
+    "sbt_quality_prediction_psi": "PSI of served prediction distribution vs the training reference (gauge)",
+    "sbt_quality_confidence_psi": "PSI of served confidence vs the OOB reference (gauge)",
+    "sbt_quality_confidence_p50": "P2-sketched median served confidence (gauge)",
+    "sbt_quality_refresh_total": "Drift recomputations + gauge exports by quality monitors",
+    "sbt_quality_disagreement": "Ensemble disagreement per sampled batch (histogram)",
+    "sbt_quality_disagreement_mean": "Running mean ensemble disagreement across sampled batches (gauge)",
+    "sbt_quality_disagreement_samples_total": "Batches sampled through the per-replica disagreement tap",
+    "sbt_quality_disagreement_compiles_total": "Per-replica tap forwards compiled (separate from serving compiles)",
+    "sbt_alerts_fired_total": "Alert rule activations (label rule)",
+    "sbt_alerts_resolved_total": "Alert rule resolutions (label rule)",
+    "sbt_alerts_suppressed_total": "Alert re-fires suppressed by per-rule cooldown (label rule)",
+    "sbt_alerts_evaluations_total": "Alert engine evaluation passes",
+    "sbt_alerts_active": "Alert rules currently active (gauge)",
     "sbt_flight_dumps_total": "Flight-recorder dumps written",
     "sbt_flight_dumps_suppressed_total": "Flight-recorder dumps suppressed by cooldown",
     "sbt_process_uptime_seconds": "Seconds since the exposition server started (gauge)",
@@ -249,6 +268,15 @@ class Registry:
                 f"metric {name!r} already registered as {m.kind}"
             )
         return m
+
+    def peek(self, name: str, labels: dict | None = None):
+        """The live metric object for ``(name, labels)``, or None —
+        a read that never CREATES the series. The alert engine samples
+        series it does not own; materializing them at 0.0 would make
+        "absent" and "zero" indistinguishable (an ``op "<"`` rule
+        would page on data that was never written)."""
+        with self._lock:
+            return self._metrics.get((name, _label_key(labels)))
 
     def counter(self, name: str, labels: dict | None = None) -> Counter:
         with self._lock:

@@ -21,7 +21,14 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
   this process opened);
 - ``GET /debug/workload`` — the active workload recorder's capture
   summary (request count, duration, rps, epochs) while recording is
-  on — the live view of the record half of record→replay→report.
+  on — the live view of the record half of record→replay→report;
+- ``GET /alerts`` — the process-default alert engine's rule states
+  (active alerts, fire/resolve/suppress counts); each scrape runs one
+  evaluation pass, so a Prometheus-less deployment still gets alert
+  transitions just by polling;
+- ``GET /debug/drift`` — every attached quality monitor's drift
+  summary (per-feature PSI/KS vs the training reference, live
+  medians, disagreement stats).
 
 Opt-in, two ways: ``telemetry.start_server(port)`` from code, or the
 ``SBT_METRICS_PORT`` environment variable (checked at package import;
@@ -202,6 +209,28 @@ def _debug_workload() -> dict[str, Any]:
     return rec.summary()
 
 
+def _debug_drift() -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import quality
+
+    return quality.debug_summary()
+
+
+def _alerts() -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import alerts
+
+    eng = alerts.get()
+    if eng is None:
+        return {
+            "rules": [], "active": [],
+            "note": "no alert engine installed; install rules with "
+                    "telemetry.alerts.install([...])",
+        }
+    # scrape-driven evaluation: polling /alerts IS the tick loop for
+    # deployments that run no evaluator of their own
+    eng.evaluate()
+    return eng.state()
+
+
 def _debug_runs() -> dict[str, Any]:
     from spark_bagging_tpu.telemetry import sinks
 
@@ -248,12 +277,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _debug_runs())
             elif url.path == "/debug/workload":
                 self._send_json(200, _debug_workload())
+            elif url.path == "/alerts":
+                self._send_json(200, _alerts())
+            elif url.path == "/debug/drift":
+                self._send_json(200, _debug_drift())
             elif url.path == "/":
                 self._send_json(200, {
                     "endpoints": [
-                        "/metrics", "/healthz", "/varz",
+                        "/metrics", "/healthz", "/varz", "/alerts",
                         "/debug/spans", "/debug/runs",
-                        "/debug/workload",
+                        "/debug/workload", "/debug/drift",
                     ],
                 })
             else:

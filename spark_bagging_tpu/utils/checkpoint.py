@@ -208,6 +208,11 @@ def _save_model_impl(
         fitted["n_classes_"] = model.n_classes_
     if hasattr(model, "oob_score_"):
         fitted["oob_score_"] = float(model.oob_score_)
+    # the quality plane's fit-time reference (telemetry/quality.py):
+    # JSON-friendly by construction, rides the manifest so a loaded
+    # model (ModelRegistry.load included) can be drift-monitored
+    if getattr(model, "quality_profile_", None) is not None:
+        fitted["quality_profile_"] = model.quality_profile_.to_dict()
     manifest = {
         "format_version": _FORMAT_VERSION,
         "estimator": _class_path(model),
@@ -376,6 +381,25 @@ def _load_model_impl(path: str, *, mesh=None) -> Any:
         model.n_classes_ = fitted["n_classes_"]
     if "oob_score_" in fitted:
         model.oob_score_ = fitted["oob_score_"]
+    if fitted.get("quality_profile_") is not None:
+        from spark_bagging_tpu.telemetry.quality import ReferenceProfile
+
+        try:
+            model.quality_profile_ = ReferenceProfile.from_dict(
+                fitted["quality_profile_"]
+            )
+        except Exception as e:  # noqa: BLE001 — unknown schema, but
+            # also truncated/hand-edited dicts (KeyError/TypeError):
+            # none of them may brick the weights they ride with
+            # a newer profile schema must not brick the weights it
+            # rides with — the model loads, monitoring degrades
+            import warnings
+
+            warnings.warn(
+                f"quality profile in checkpoint not restored: {e} "
+                "(drift monitoring unavailable for the loaded model)",
+                stacklevel=2,
+            )
     if "oob_decision_function" in tree:
         model.oob_decision_function_ = np.asarray(
             tree["oob_decision_function"]

@@ -1,0 +1,305 @@
+"""Alert engine [ISSUE 9]: rule grammar, multi-window burn-rate
+fire/resolve lifecycle, per-rule cooldown, flight-recorder triggering
+on alert_fired, the sbt_alerts_* series, and the /alerts +
+/debug/drift scrape endpoints.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.telemetry import alerts
+from spark_bagging_tpu.telemetry.alerts import AlertEngine, AlertRule
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    alerts.uninstall()
+    yield
+    telemetry.reset()
+    telemetry.enable()
+    alerts.uninstall()
+
+
+def gauge_rule(**kw):
+    base = dict(threshold=1.0, kind="value", op=">",
+                fast_window_s=2.0, slow_window_s=5.0, cooldown_s=10.0)
+    base.update(kw)
+    return AlertRule("g", "sbt_test_gauge", **base)
+
+
+def set_gauge(v):
+    telemetry.set_gauge("sbt_test_gauge", v)
+
+
+class TestRuleGrammar:
+    def test_round_trip_and_validation(self):
+        r = gauge_rule(description="d", severity="ticket")
+        assert AlertRule.from_dict(r.to_dict()).to_dict() == r.to_dict()
+        with pytest.raises(ValueError, match="unknown alert rule"):
+            AlertRule.from_dict({**r.to_dict(), "bogus": 1})
+        with pytest.raises(ValueError, match="at least"):
+            AlertRule.from_dict({"name": "x"})
+        with pytest.raises(ValueError, match="kind"):
+            gauge_rule(kind="magic")
+        with pytest.raises(ValueError, match="op"):
+            gauge_rule(op=">=")
+        with pytest.raises(ValueError, match="fast_window_s"):
+            gauge_rule(fast_window_s=10.0, slow_window_s=1.0)
+
+    def test_duplicate_rule_name_rejected(self):
+        eng = AlertEngine([gauge_rule()])
+        with pytest.raises(ValueError, match="already installed"):
+            eng.add_rule(gauge_rule())
+
+    def test_default_drift_rules_cover_the_quality_gauges(self):
+        names = {r.series for r in alerts.default_drift_rules()}
+        assert "sbt_quality_psi_max" in names
+        assert "sbt_quality_confidence_psi" in names
+
+
+class TestLifecycle:
+    def test_fire_requires_both_windows_and_coverage(self):
+        """One breaching sample must not page: the fast AND slow
+        windows must be fully covered by breaching samples."""
+        eng = AlertEngine([gauge_rule()])
+        set_gauge(5.0)
+        assert eng.evaluate(now=0.0) == []   # no slow-window coverage
+        assert eng.evaluate(now=2.0) == []
+        assert eng.evaluate(now=4.0) == []
+        evs = eng.evaluate(now=5.5)          # watched > slow_window now
+        assert [e["kind"] for e in evs] == ["alert_fired"]
+        assert eng.active() == ("g",)
+        # active: further breaches emit nothing (one incident, one alert)
+        assert eng.evaluate(now=6.0) == []
+
+    def test_transient_blip_does_not_fire(self):
+        eng = AlertEngine([gauge_rule()])
+        set_gauge(0.0)
+        for t in range(6):
+            assert eng.evaluate(now=float(t)) == []
+        set_gauge(5.0)                        # blip
+        assert eng.evaluate(now=6.0) == []    # slow window not all-breach
+        set_gauge(0.0)
+        assert eng.evaluate(now=7.0) == []
+        assert eng.active() == ()
+
+    def test_resolve_and_cooldown_suppression(self):
+        eng = AlertEngine([gauge_rule(cooldown_s=100.0)])
+        set_gauge(5.0)
+        for t in (0.0, 2.0, 4.0, 5.5):
+            evs = eng.evaluate(now=t)
+        assert [e["kind"] for e in evs] == ["alert_fired"]
+        set_gauge(0.5)
+        (resolved,) = eng.evaluate(now=6.0)
+        assert resolved["kind"] == "alert_resolved"
+        # re-breach inside the cooldown: suppressed, counted, no event
+        set_gauge(5.0)
+        for t in (7.0, 9.0, 12.0, 13.0):
+            assert eng.evaluate(now=t) == []
+        st = eng.state()["rules"][0]
+        assert st["fired"] == 1 and st["resolved"] == 1
+        assert st["suppressed"] >= 1
+        reg = telemetry.registry()
+        assert reg.counter("sbt_alerts_suppressed_total",
+                           {"rule": "g"}).value >= 1
+        # past the cooldown the same sustained breach fires again
+        evs = [e for t in (104.0, 106.0, 110.0)
+               for e in eng.evaluate(now=t)]
+        assert [e["kind"] for e in evs] == ["alert_fired"]
+        assert reg.counter("sbt_alerts_fired_total",
+                           {"rule": "g"}).value == 2
+
+    def test_rate_rule_on_counter(self):
+        """kind=rate: windowed per-second rate of a counter."""
+        eng = AlertEngine([AlertRule(
+            "errs", "sbt_test_errors_total", threshold=2.0,
+            kind="rate", op=">", fast_window_s=2.0, slow_window_s=4.0,
+        )])
+        reg = telemetry.registry()
+        for t in range(5):   # 1/s — under threshold
+            reg.inc("sbt_test_errors_total", 1.0)
+            assert eng.evaluate(now=float(t)) == []
+        for t in range(5, 11):  # 10/s — burn
+            reg.inc("sbt_test_errors_total", 10.0)
+            evs = eng.evaluate(now=float(t))
+            if evs:
+                break
+        assert [e["kind"] for e in evs] == ["alert_fired"]
+        # errors stop entirely: the WINDOWED rate falls back under the
+        # threshold and the alert must resolve — comparing the raw
+        # cumulative counter (still 65 > 2.0) would pin it active
+        # forever and swallow every later genuine burst
+        resolved = [e for t in range(11, 20)
+                    for e in eng.evaluate(now=float(t))]
+        assert [e["kind"] for e in resolved] == ["alert_resolved"]
+        assert eng.active() == ()
+
+    def test_kind_mismatched_series_skips_not_poisons(self):
+        """A value rule aimed at a histogram (metric-kind collision)
+        must not take down the evaluation pass for every OTHER rule."""
+        telemetry.observe("sbt_test_hist_seconds", 0.1)
+        eng = AlertEngine([
+            AlertRule("bad", "sbt_test_hist_seconds", threshold=1.0,
+                      fast_window_s=2.0, slow_window_s=5.0),
+            gauge_rule(),
+        ])
+        set_gauge(5.0)
+        evs = [e for t in (0.0, 2.0, 4.0, 5.5)
+               for e in eng.evaluate(now=t)]
+        assert [e["rule"] for e in evs] == ["g"]  # good rule still fires
+        bad = next(r for r in eng.state()["rules"]
+                   if r["name"] == "bad")
+        assert bad["last_value"] is None and bad["active"] is False
+
+    def test_absent_series_is_no_evidence_even_for_lt_rules(self):
+        """A series nobody wrote must not be sampled at all: an
+        op "<" rule (e.g. 'confidence median below 0.4') would
+        otherwise fire on an auto-created 0.0 from a service that
+        served zero traffic."""
+        eng = AlertEngine([AlertRule(
+            "low-conf", "sbt_never_written", threshold=0.4, op="<",
+            fast_window_s=1.0, slow_window_s=2.0,
+        )])
+        for t in range(6):
+            assert eng.evaluate(now=float(t)) == []
+        st = eng.state()["rules"][0]
+        assert st["last_value"] is None and st["fired"] == 0
+        # the series was NOT materialized by the sampling
+        assert telemetry.registry().peek("sbt_never_written") is None
+        # once real data arrives and genuinely breaches, it can fire
+        telemetry.set_gauge("sbt_never_written", 0.1)
+        evs = [e for t in (10.0, 11.0, 12.0, 13.0)
+               for e in eng.evaluate(now=t)]
+        assert [e["kind"] for e in evs] == ["alert_fired"]
+
+    def test_metrics_and_state_shape(self):
+        eng = AlertEngine([gauge_rule()])
+        set_gauge(5.0)
+        for t in (0.0, 2.0, 4.0, 5.5):
+            eng.evaluate(now=t)
+        reg = telemetry.registry()
+        assert reg.counter("sbt_alerts_evaluations_total").value == 4
+        assert reg.gauge("sbt_alerts_active").value == 1.0
+        st = eng.state()
+        assert st["active"] == ["g"]
+        (rule,) = st["rules"]
+        assert rule["last_value"] == 5.0
+        json.dumps(st)  # /alerts serves this verbatim
+
+
+class TestEventPlumbing:
+    def _fire(self, eng):
+        set_gauge(5.0)
+        for t in (0.0, 2.0, 4.0, 5.5):
+            evs = eng.evaluate(now=t)
+        return evs
+
+    def test_alert_fired_reaches_open_capture(self):
+        eng = AlertEngine([gauge_rule()])
+        with telemetry.capture() as run:
+            self._fire(eng)
+        evs = [e for e in run.events if e["kind"] == "alert_fired"]
+        assert len(evs) == 1
+        assert evs[0]["rule"] == "g" and "ts" in evs[0]
+
+    def test_alert_fired_triggers_flight_recorder(self, tmp_path):
+        """The quality plane's incident contract: an alert arrives
+        with the black box. alert_fired is a TRIGGER kind; per-kind
+        cooldown still guarantees one dump per incident."""
+        from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+
+        rec = FlightRecorder(dir=str(tmp_path), cooldown_s=3600)
+        rec.arm()
+        try:
+            eng = AlertEngine([gauge_rule(cooldown_s=0.0)])
+            self._fire(eng)
+            assert len(rec.dumps) == 1
+            dump = json.loads(open(rec.dumps[0]).read())
+            assert dump["trigger"]["kind"] == "alert_fired"
+            assert dump["trigger"]["rule"] == "g"
+            # flap: resolve + immediate re-fire (cooldown_s=0 on the
+            # RULE) — the recorder's own cooldown suppresses dump #2
+            set_gauge(0.5)
+            eng.evaluate(now=6.0)
+            set_gauge(5.0)
+            for t in (6.5, 8.0, 10.0, 12.0):
+                eng.evaluate(now=t)
+            assert len(rec.dumps) == 1
+        finally:
+            rec.disarm()
+
+
+class TestEndpoints:
+    def test_alerts_and_drift_routes(self):
+        from spark_bagging_tpu.telemetry import server as tserver
+
+        port = tserver.start_server(0)
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                    return r.status, json.loads(r.read())
+
+            # no engine installed -> note, not error
+            status, body = get("/alerts")
+            assert status == 200 and "note" in body
+            # install + breach -> scrapes drive the evaluation ticks
+            alerts.install([AlertRule(
+                "scrape", "sbt_test_gauge", threshold=1.0,
+                fast_window_s=0.001, slow_window_s=0.001,
+            )])
+            set_gauge(5.0)
+            get("/alerts")
+            import time
+
+            time.sleep(0.02)
+            status, body = get("/alerts")
+            assert status == 200
+            (rule,) = body["rules"]
+            assert rule["last_value"] == 5.0
+            assert body["active"] == ["scrape"]
+            # /debug/drift with no monitor: the discoverable note
+            status, body = get("/debug/drift")
+            assert status == 200 and "note" in body
+            # the route index advertises both
+            status, body = get("/")
+            assert "/alerts" in body["endpoints"]
+            assert "/debug/drift" in body["endpoints"]
+        finally:
+            tserver.stop_server()
+            from spark_bagging_tpu.telemetry import recorder
+
+            recorder.disarm()  # start_server armed the default
+
+    def test_debug_drift_serves_live_monitor(self):
+        from spark_bagging_tpu import BaggingClassifier
+        from spark_bagging_tpu.telemetry import quality
+        from spark_bagging_tpu.telemetry import server as tserver
+        from spark_bagging_tpu.serving import EnsembleExecutor
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(80, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        clf = BaggingClassifier(n_estimators=2, seed=0).fit(X, y)
+        ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=32)
+        quality.attach(ex, refresh_every=1)
+        ex.forward(X[:8])
+        port = tserver.start_server(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/drift",
+                    timeout=5) as r:
+                body = json.loads(r.read())
+            assert any(m["rows_observed"] == 8
+                       for m in body["monitors"])
+        finally:
+            tserver.stop_server()
+            from spark_bagging_tpu.telemetry import recorder
+
+            recorder.disarm()

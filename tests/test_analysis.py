@@ -519,16 +519,17 @@ def test_config_section_roundtrip(tmp_path, monkeypatch):
 
 def test_config_defaults_without_file(tmp_path):
     cfg = load_config(str(tmp_path))
-    assert cfg["paths"] == ["spark_bagging_tpu", "benchmarks"]
+    assert cfg["paths"] == ["spark_bagging_tpu", "benchmarks",
+                            "examples"]
 
 
 # -- the self-hosting gate ---------------------------------------------
 
 def test_repo_tree_is_lint_clean():
-    """THE tier-1 gate: the package and benchmarks stay lint-clean
-    (zero unsuppressed findings) — the acceptance bar for the whole
-    subsystem. If this fails, either fix the finding or add a
-    justified `# sbt-lint: disable=<rule>` with a reason."""
+    """THE tier-1 gate: the package, benchmarks, and examples stay
+    lint-clean (zero unsuppressed findings) — the acceptance bar for
+    the whole subsystem. If this fails, either fix the finding or add
+    a justified `# sbt-lint: disable=<rule>` with a reason."""
     import time
 
     cfg = load_config(REPO)

@@ -1,0 +1,595 @@
+"""Model-quality plane [ISSUE 9]: sketch math, the fit-time reference
+profile and its checkpoint round-trip, the executor tap on BOTH
+dispatch paths, ensemble-disagreement parity (served outputs stay
+bitwise-identical with the tap enabled), concurrent sketch updates,
+and the zero-overhead-when-disabled contract.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.telemetry import quality
+from spark_bagging_tpu.telemetry.quality import (
+    MomentSketch,
+    P2Quantile,
+    QualityMonitor,
+    ReferenceProfile,
+    bin_counts,
+    disagreement_stats,
+    ks_stat,
+    psi,
+)
+from spark_bagging_tpu.serving import EnsembleExecutor, ModelRegistry
+from spark_bagging_tpu.serving.batcher import MicroBatcher
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.reset()
+    telemetry.enable()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.2 * rng.normal(size=300) > 0).astype(np.int32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def clf(data):
+    X, y = data
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=8, seed=0, oob_score=True,
+    ).fit(X, y)
+
+
+def fresh_executor(model):
+    ex = EnsembleExecutor(model, min_bucket_rows=8, max_batch_rows=32)
+    ex.warmup()
+    return ex
+
+
+@pytest.fixture(scope="module")
+def shared_ex(clf):
+    """One warmed executor shared by tests that only attach/detach
+    monitors (tier-1 wall-clock: each warmup is 3 bucket compiles on a
+    1-CPU host). Tests asserting compile COUNTS build their own."""
+    return fresh_executor(clf)
+
+
+# -- sketch primitives --------------------------------------------------
+
+class TestSketches:
+    def test_p2_tracks_quantiles(self):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=4000)
+        for q in (0.5, 0.95):
+            sk = P2Quantile(q)
+            for v in vals:
+                sk.update(v)
+            true = np.quantile(vals, q)
+            assert abs(sk.value() - true) < 0.1, (q, sk.value(), true)
+
+    def test_p2_exact_small_samples_and_empty(self):
+        sk = P2Quantile(0.5)
+        assert math.isnan(sk.value())
+        for v in (5.0, 1.0, 3.0):
+            sk.update(v)
+        assert sk.value() == 3.0  # exact nearest-rank below 5 samples
+        with pytest.raises(ValueError, match="q must be"):
+            P2Quantile(1.5)
+
+    def test_moment_sketch_matches_numpy(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(500, 3)) * [1.0, 2.0, 0.5] + [0, 1, -1]
+        ms = MomentSketch(3)
+        for chunk in np.array_split(X, 7):
+            ms.update(chunk)
+        assert ms.count == 500
+        np.testing.assert_allclose(ms.mean(), X.mean(axis=0),
+                                   rtol=1e-9)
+        np.testing.assert_allclose(ms.std(), X.std(axis=0), rtol=1e-6)
+
+    def test_bin_counts_total_and_edges(self):
+        edges = np.array([0.0, 1.0, 2.0])
+        counts = bin_counts(np.array([-5, 0.5, 1.5, 3.0, 0.5]), edges)
+        assert counts.sum() == 5
+        assert list(counts) == [1, 2, 1, 1]
+
+    def test_psi_zero_on_match_large_on_shift(self):
+        rng = np.random.default_rng(5)
+        ref_sample = rng.normal(size=4000)
+        edges = np.quantile(ref_sample, np.arange(1, 10) / 10)
+        ref = bin_counts(ref_sample, edges) / 4000
+        same = bin_counts(rng.normal(size=4000), edges)
+        shifted = bin_counts(rng.normal(size=4000) + 3.0, edges)
+        assert psi(ref, same) < 0.05
+        assert psi(ref, shifted) > 1.0
+        assert ks_stat(ref, same) < 0.05
+        assert ks_stat(ref, shifted) > 0.5
+
+    def test_psi_small_sample_noise_is_bounded(self):
+        """The Laplace-smoothing property: 20 in-distribution rows
+        against 10 reference bins must NOT scream drift (a raw epsilon
+        floor scored ~2.0 here purely from empty bins)."""
+        rng = np.random.default_rng(6)
+        ref_sample = rng.normal(size=4000)
+        edges = np.quantile(ref_sample, np.arange(1, 10) / 10)
+        ref = bin_counts(ref_sample, edges) / 4000
+        small = bin_counts(rng.normal(size=20), edges)
+        assert psi(ref, small) < 0.8
+
+    def test_psi_empty_stream_is_zero(self):
+        assert psi([0.5, 0.5], [0, 0]) == 0.0
+        assert ks_stat([0.5, 0.5], [0, 0]) == 0.0
+
+
+# -- the reference profile ----------------------------------------------
+
+class TestReferenceProfile:
+    def test_fit_computes_profile_with_oob_confidence(self, clf):
+        prof = clf.quality_profile_
+        assert prof is not None
+        assert prof.task == "classification"
+        assert prof.n_features == 6
+        assert len(prof.feature_edges) == 6
+        assert all(len(fr) == 10 for fr in prof.feature_fractions)
+        assert prof.class_fractions is not None
+        assert abs(sum(prof.class_fractions) - 1.0) < 1e-9
+        # oob_score=True filled the held-out confidence reference
+        assert prof.confidence_source == "oob"
+        assert abs(sum(prof.confidence_fractions) - 1.0) < 1e-9
+
+    def test_regressor_profile_has_prediction_reference(self, data):
+        X, _ = data
+        y = (X[:, 0] * 2.0 + 0.1).astype(np.float32)
+        reg = BaggingRegressor(n_estimators=4, seed=0).fit(X, y)
+        prof = reg.quality_profile_
+        assert prof.task == "regression"
+        assert prof.prediction_fractions is not None
+        assert prof.class_fractions is None
+
+    def test_dict_round_trip(self, clf):
+        d = clf.quality_profile_.to_dict()
+        import json
+
+        json.dumps(d)  # JSON-friendly by construction
+        assert ReferenceProfile.from_dict(d).to_dict() == d
+        with pytest.raises(ValueError, match="schema"):
+            ReferenceProfile.from_dict({**d, "schema": 999})
+
+    def test_checkpoint_round_trips_profile(self, clf, tmp_path):
+        path = str(tmp_path / "ckpt")
+        clf.save(path)
+        loaded = BaggingClassifier.load(path)
+        assert loaded.quality_profile_.to_dict() \
+            == clf.quality_profile_.to_dict()
+
+    def test_malformed_profile_degrades_load_not_bricks_it(
+            self, clf, tmp_path):
+        """A truncated/hand-edited profile dict in a checkpoint must
+        warn and load the WEIGHTS — monitoring degrades, the model
+        does not brick."""
+        import json
+        import os
+
+        path = str(tmp_path / "ckpt")
+        clf.save(path)
+        mpath = os.path.join(path, "manifest.json")
+        manifest = json.load(open(mpath))
+        manifest["fitted"]["quality_profile_"] = {"schema": 1}  # torn
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        with pytest.warns(UserWarning, match="not restored"):
+            loaded = BaggingClassifier.load(path)
+        assert not hasattr(loaded, "quality_profile_") \
+            or loaded.quality_profile_ is None
+        assert loaded.n_estimators_ == clf.n_estimators_
+
+    def test_profile_determinism(self, data):
+        X, y = data
+        a = ReferenceProfile.from_training(
+            X, y, task="classification", n_classes=2)
+        b = ReferenceProfile.from_training(
+            X, y, task="classification", n_classes=2)
+        assert a.to_dict() == b.to_dict()
+
+
+# -- the live monitor ---------------------------------------------------
+
+class TestMonitor:
+    def _profile(self, X, y):
+        return ReferenceProfile.from_training(
+            X, y, task="classification", n_classes=2)
+
+    def test_drift_scores_rise_on_shift(self, data):
+        X, y = data
+        mon = QualityMonitor(self._profile(X, y), refresh_every=1)
+        rng = np.random.default_rng(1)
+        mon.observe(rng.normal(size=(200, 6)).astype(np.float32))
+        clean = mon.drift()
+        assert clean["warmed"] is True
+        assert clean["psi_max"] < 0.5
+        mon.observe(
+            (rng.normal(size=(200, 6)) + 4.0).astype(np.float32))
+        assert mon.drift()["psi_max"] > 1.0
+
+    def test_min_rows_gates_gauge_export_not_scores(self, data):
+        X, y = data
+        mon = QualityMonitor(self._profile(X, y), refresh_every=1,
+                             min_rows=100)
+        mon.observe((X[:10] + 9.0).astype(np.float32))
+        d = mon.drift()
+        assert d["warmed"] is False and d["psi_max"] > 0  # raw score
+        reg = telemetry.registry()
+        assert reg.gauge("sbt_quality_psi_max").value == 0.0  # gated
+        mon.observe((np.tile(X[:10], (10, 1)) + 9.0).astype(np.float32))
+        assert reg.gauge("sbt_quality_psi_max").value > 0.5
+
+    def test_concurrent_sketch_updates_lose_nothing(self, data):
+        """Satellite: quality taps fed simultaneously from the batcher
+        worker and a direct-dispatch caller thread must never lose
+        updates or deadlock. 8 threads x 50 observes, every row
+        accounted for in rows AND bin counts."""
+        X, y = data
+        mon = QualityMonitor(self._profile(X, y), refresh_every=64,
+                             disagreement_every=3)
+        n_threads, n_iter, rows = 8, 50, 7
+        block = X[:rows]
+        out = np.full((rows, 2), 0.5, np.float32)
+
+        def feeder():
+            for _ in range(n_iter):
+                mon.observe(block, out)
+                mon.wants_disagreement()
+
+        threads = [threading.Thread(target=feeder)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "deadlocked"
+        total = n_threads * n_iter * rows
+        summ = mon.summary()
+        assert summ["rows_observed"] == total
+        assert summ["batches"] == n_threads * n_iter
+        assert mon._feat_counts[0].sum() == total
+        assert mon._conf_counts.sum() == total
+
+    def test_lock_order_clean_under_debug_locks(self, data):
+        """The PR-4 lock-order detector sees the quality/alert locks
+        (make_lock): monitor refresh (quality -> registry) and alert
+        evaluation (alerts -> registry) from concurrent threads must
+        record zero inversions."""
+        from spark_bagging_tpu.analysis import locks
+        from spark_bagging_tpu.telemetry import alerts as alerts_mod
+
+        X, y = data
+        locks.enable(True)
+        try:
+            mon = QualityMonitor(self._profile(X, y), refresh_every=1)
+            eng = alerts_mod.AlertEngine([alerts_mod.AlertRule(
+                "t", "sbt_quality_psi_max", threshold=0.5,
+                fast_window_s=1, slow_window_s=2,
+            )])
+
+            def a():
+                for _ in range(50):
+                    mon.observe(X[:4])
+
+            def b():
+                for i in range(50):
+                    eng.evaluate(now=float(i))
+
+            ts = [threading.Thread(target=a),
+                  threading.Thread(target=b)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert locks.violations() == []
+        finally:
+            locks.enable(False)
+
+
+# -- the executor tap ---------------------------------------------------
+
+class TestExecutorTap:
+    def test_attach_feeds_and_bitwise_parity(self, clf, shared_ex, data):
+        """The acceptance bitwise bar: with the monitor attached AND
+        the disagreement tap sampling every batch, served outputs are
+        byte-identical to the untapped executor's."""
+        X, _ = data
+        ex = shared_ex
+        ex.detach_quality()
+        base = ex.predict_proba(X[:50])
+        mon = quality.attach(ex, refresh_every=1,
+                             disagreement_every=1)
+        tapped = ex.predict_proba(X[:50])
+        np.testing.assert_array_equal(base, tapped)
+        assert mon.summary()["rows_observed"] == 50
+        assert mon.summary()["disagreement_samples"] == 1
+        # and the batch API is untouched too
+        np.testing.assert_array_equal(
+            tapped, np.asarray(clf.predict_proba(X[:50]))
+        )
+
+    def test_tap_compiles_count_separately(self, clf, data):
+        """Replica-tap compiles must NOT appear in the serving compile
+        counter — the zero-post-warmup-compile gate is about the
+        serving path."""
+        X, _ = data
+        ex = fresh_executor(clf)
+        reg = telemetry.registry()
+        before = reg.counter("sbt_serving_compiles_total").value
+        quality.attach(ex, refresh_every=1, disagreement_every=1)
+        ex.forward(X[:20])
+        assert reg.counter("sbt_serving_compiles_total").value == before
+        assert reg.counter(
+            "sbt_quality_disagreement_compiles_total").value >= 1
+        assert reg.counter(
+            "sbt_quality_disagreement_samples_total").value >= 1
+
+    def test_replica_forward_mean_is_the_served_output(self, clf, data):
+        X, _ = data
+        fn, params, subs = clf.replica_forward()
+        rep = np.asarray(fn(params, subs, X[:16].astype(np.float32)))
+        assert rep.shape == (8, 16, 2)
+        agg = np.asarray(clf.predict_proba(X[:16]))
+        np.testing.assert_allclose(rep.mean(axis=0), agg, rtol=1e-5)
+
+    def test_hard_voting_replica_forward_matches_served_output(
+            self, data):
+        """voting='hard' models serve vote FREQUENCIES; the replica
+        tap must emit per-replica one-hot votes (mean == served), not
+        softmax probabilities whose argmax can differ from the served
+        plurality."""
+        X, y = data
+        hard = BaggingClassifier(n_estimators=5, seed=0,
+                                 voting="hard").fit(X, y)
+        fn, params, subs = hard.replica_forward()
+        rep = np.asarray(fn(params, subs, X[:16].astype(np.float32)))
+        assert rep.shape == (5, 16, 2)
+        assert set(np.unique(rep)) <= {0.0, 1.0}  # one-hot votes
+        agg = np.asarray(hard.predict_proba(X[:16]))
+        np.testing.assert_allclose(rep.mean(axis=0), agg, rtol=1e-6)
+
+    def test_disagreement_stats_shapes(self):
+        rep = np.stack([
+            np.array([[0.9, 0.1], [0.2, 0.8]]),
+            np.array([[0.8, 0.2], [0.9, 0.1]]),  # disagrees on row 1
+        ])
+        s = disagreement_stats(rep, "classification")
+        assert s["rows"] == 2
+        assert 0.0 < s["disagreement"] <= 0.5
+        r = disagreement_stats(np.array([[1.0, 2.0], [3.0, 2.0]]),
+                               "regression")
+        assert r["disagreement"] == pytest.approx(
+            np.array([[1.0, 2.0], [3.0, 2.0]]).std(axis=0).mean())
+
+    def test_both_dispatch_paths_feed_the_monitor(self, shared_ex, data):
+        """The tap seam sits under the coalescing worker AND direct
+        dispatch: earn direct mode with a singleton streak, confirm
+        feeds; then a pinned-coalesced batcher feeds too."""
+        X, _ = data
+        ex = shared_ex
+        mon = quality.attach(ex, refresh_every=1)
+        with MicroBatcher(ex, max_delay_ms=1.0) as b:
+            for _ in range(MicroBatcher.DIRECT_AFTER_SINGLETONS + 4):
+                b.predict_proba(X[:1], timeout=30)
+            direct = telemetry.registry().counter(
+                "sbt_serving_direct_dispatch_total").value
+            assert direct > 0, "direct mode never earned"
+        rows_after_direct = mon.summary()["rows_observed"]
+        assert rows_after_direct \
+            == MicroBatcher.DIRECT_AFTER_SINGLETONS + 4
+        with MicroBatcher(ex, max_delay_ms=1.0,
+                          direct_dispatch=False) as b:
+            b.predict_proba(X[:5], timeout=30)
+        assert mon.summary()["rows_observed"] == rows_after_direct + 5
+
+    def test_monitor_failure_detaches_not_fails_serving(
+            self, shared_ex, data):
+        X, _ = data
+        ex = shared_ex
+
+        class Broken:
+            def observe_parts(self, parts, outs):
+                raise RuntimeError("sketch exploded")
+
+            def wants_disagreement(self):
+                return False
+
+        ex.attach_quality(Broken())
+        with pytest.warns(RuntimeWarning, match="detached"):
+            out = ex.predict_proba(X[:4])
+        assert out.shape == (4, 2)
+        assert ex.quality is None  # detached, serving unharmed
+
+    def test_attach_requires_a_profile(self, clf, shared_ex):
+        # a model without quality_profile_ (e.g. an old checkpoint)
+        saved = clf.quality_profile_
+        clf.quality_profile_ = None
+        try:
+            with pytest.raises(ValueError, match="quality_profile_"):
+                quality.attach(shared_ex)
+        finally:
+            clf.quality_profile_ = saved
+
+    def test_swap_survives_profileless_replacement(self, clf, data):
+        """Monitoring re-attach is best-effort: a swap to a model
+        without a quality profile COMMITS (new version serves) and
+        warns, instead of masquerading as a rejected swap."""
+        X, y = data
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+        reg.register("m", clf, warmup=True)
+        reg.enable_quality("m", refresh_every=1)
+        clf2 = BaggingClassifier(n_estimators=2, seed=1).fit(X, y)
+        clf2.quality_profile_ = None  # stream fit / old checkpoint
+        with pytest.warns(RuntimeWarning, match="UNMONITORED"):
+            reg.swap("m", clf2)
+        assert reg.version("m") == 2          # the swap committed
+        assert reg.executor("m").quality is None
+
+    def test_profile_override_is_not_sticky_across_swap(
+            self, clf, data):
+        """An explicit profile= in enable_quality applies to the
+        current executor only: the swapped-in model is scored against
+        its OWN fit-time reference, never its predecessor's."""
+        X, y = data
+        custom = quality.ReferenceProfile.from_training(
+            X + 100.0, y, task="classification", n_classes=2)
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+        reg.register("m", clf, warmup=True)
+        mon1 = reg.enable_quality("m", profile=custom, refresh_every=1)
+        assert mon1.profile is custom
+        reg.swap("m", clf)
+        mon2 = reg.executor("m").quality
+        assert mon2.profile is clf.quality_profile_
+
+    def test_fresh_monitor_resets_conditional_gauges(self, clf, data):
+        """A re-attached monitor that cannot produce a signal (no
+        confidence reference) must export 0.0 for it — a frozen stale
+        breaching value would keep an alert alive forever."""
+        X, y = data
+        reg_t = telemetry.registry()
+        ex = fresh_executor(clf)
+        mon = quality.attach(ex, refresh_every=1, min_rows=0)
+        mon.observe(np.asarray(X[:60] + 9.0),
+                    np.full((60, 2), 0.5, np.float32))
+        assert reg_t.gauge("sbt_quality_confidence_psi").value > 0.0
+        # new model, no OOB confidence reference
+        noconf = quality.ReferenceProfile.from_training(
+            X, y, task="classification", n_classes=2)
+        assert noconf.confidence_fractions is None
+        quality.attach(ex, profile=noconf, refresh_every=1)
+        assert reg_t.gauge("sbt_quality_confidence_psi").value == 0.0
+
+    def test_profile_n_rows_is_true_training_size(self, clf, data):
+        assert clf.quality_profile_.n_rows == len(data[0])
+
+    def test_two_monitored_models_export_separate_series(
+            self, clf, data):
+        """Registry monitors are per-model labeled: a healthy model's
+        refreshes must not clobber (and thereby mask) a drifting
+        one's gauges under the alert rules."""
+        X, _ = data
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+        reg.register("a", clf, warmup=True)
+        reg.register("b", clf, warmup=True)
+        mon_a = reg.enable_quality("a", refresh_every=1, min_rows=0)
+        mon_b = reg.enable_quality("b", refresh_every=1, min_rows=0)
+        assert mon_a.labels == {"model": "a"}
+        reg.executor("a").forward(np.asarray(X[:60] + 9.0))  # drifts
+        reg.executor("b").forward(np.asarray(X[:60]))        # healthy
+        reg_t = telemetry.registry()
+        psi_a = reg_t.gauge("sbt_quality_psi_max",
+                            {"model": "a"}).value
+        psi_b = reg_t.gauge("sbt_quality_psi_max",
+                            {"model": "b"}).value
+        assert psi_a > 1.0 and psi_b < 0.5
+
+    def test_caller_monitor_is_not_sticky_across_swap(self, clf):
+        """A monitor= passthrough installs for the current executor
+        only: replaying the instance on swap would re-install the
+        predecessor's reference profile AND its accumulated sketch
+        counts verbatim."""
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+        reg.register("m", clf, warmup=True)
+        mine = QualityMonitor(clf.quality_profile_, refresh_every=1)
+        assert reg.enable_quality("m", monitor=mine) is mine
+        assert reg.executor("m").quality is mine
+        reg.swap("m", clf)
+        fresh = reg.executor("m").quality
+        assert fresh is not None and fresh is not mine
+
+    def test_attach_prewarms_replica_tap_for_compiled_buckets(
+            self, clf, data):
+        """The disagreement tap must never absorb an XLA compile stall
+        on the serving thread: attach pre-builds the per-replica
+        executables for every already-compiled serving bucket."""
+        X, _ = data
+        ex = fresh_executor(clf)  # serving ladder 8/16/32 compiled
+        reg_t = telemetry.registry()
+        quality.attach(ex, refresh_every=1, disagreement_every=1)
+        prewarmed = reg_t.counter(
+            "sbt_quality_disagreement_compiles_total").value
+        assert prewarmed == len(ex.compiled_buckets)
+        ex.forward(X[:20])  # sampled batch: executable already live
+        assert reg_t.counter(
+            "sbt_quality_disagreement_compiles_total").value == prewarmed
+
+    def test_registry_enable_quality_sticky_across_swap(
+            self, clf, data, tmp_path):
+        X, _ = data
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+        reg.register("m", clf, warmup=True)
+        mon1 = reg.enable_quality("m", refresh_every=1)
+        reg.executor("m").forward(X[:8])
+        assert mon1.summary()["rows_observed"] == 8
+        reg.swap("m", clf)
+        mon2 = reg.executor("m").quality
+        assert mon2 is not None and mon2 is not mon1
+        assert mon2.summary()["rows_observed"] == 0  # fresh sketches
+        reg.disable_quality("m")
+        reg.swap("m", clf)
+        assert reg.executor("m").quality is None
+
+
+# -- /debug/drift and the zero-overhead contract -----------------------
+
+class TestPlaneContracts:
+    def test_debug_summary_lists_live_monitors(self, shared_ex, data):
+        X, _ = data
+        ex = shared_ex
+        ex.detach_quality()
+        mon = quality.attach(ex, refresh_every=1)
+        ex.forward(X[:8])
+        summ = quality.debug_summary()
+        assert any(m["rows_observed"] == 8 for m in summ["monitors"])
+        assert mon in quality.monitors()
+
+    def test_no_monitor_no_quality_series(self, shared_ex, data):
+        """Serving without an attached monitor must register NO
+        sbt_quality series — the plane is genuinely off, not idling."""
+        X, _ = data
+        ex = shared_ex
+        ex.detach_quality()
+        telemetry.reset()
+        ex.forward(X[:20])
+        names = {e["name"] for e in telemetry.registry().snapshot()}
+        assert not any(n.startswith("sbt_quality") for n in names)
+
+    def test_disabled_tap_overhead_micro_benchmark(self, shared_ex):
+        """The acceptance micro-benchmark (PR-1 style): the detached
+        tap's hot-path gate is one attribute read — 200k iterations of
+        the exact pattern `_forward_packed` runs must stay far under a
+        microsecond each."""
+        ex = shared_ex
+        ex.detach_quality()
+        assert ex._quality is None
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            mon = ex._quality
+            if mon is not None:  # pragma: no cover — detached
+                raise AssertionError
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 2e-6, f"{per_call * 1e9:.0f}ns per gate"

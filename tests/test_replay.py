@@ -226,6 +226,68 @@ def test_absolute_spec_gate(executor, wl):
     assert not bad.ok
 
 
+# -- the drift scenario (the model-quality plane's scripted incident) --
+
+def test_drift_scenario_fires_exactly_one_alert(clf, wl):
+    """The ISSUE 9 acceptance core, in-process: a covariate-shifted
+    payload segment spliced at --drift-at yields byte-identical drift
+    scores across repeats (replay_median raises otherwise), exactly
+    one alert_fired with the alert left active (no flapping, re-fires
+    absorbed), and exactly one flight dump for the incident."""
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    r = R.replay_median(
+        wl, repeats=2, registry=reg, model_name="m",
+        drift=True, drift_shift=4.0, seed=3,
+    )
+    d = r["drift"]
+    assert d["alerts_fired"] == 1
+    assert d["alerts_resolved"] == 0
+    assert d["alert_active"] is True
+    assert d["flight_dumps"] == 1
+    assert d["scores"]["psi_max"] > 0.5
+    assert d["scores"]["warmed"] is True
+    # disagreement sampled through the per-replica tap, and the
+    # serving compile gate is untouched by its compiles
+    assert d["scores"].get("disagreement_samples", 0) > 0
+    assert r["post_warmup_compiles"] == 0
+    result = R.check_report(r)
+    assert result.ok, result.render()
+
+
+def test_drift_digest_changes_with_seed(clf, wl):
+    """Different payload seed ⇒ different sketched bytes ⇒ different
+    drift digest — the digest really covers the scores."""
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    a = R.replay(wl, registry=reg, model_name="m", drift=True, seed=3)
+    b = R.replay(wl, registry=reg, model_name="m", drift=True, seed=4)
+    assert a["drift"]["digest"] != b["drift"]["digest"]
+
+
+def test_drift_rejects_swaps_and_requires_profile(clf, wl):
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    with pytest.raises(ValueError, match="swaps"):
+        R.replay(wl, registry=reg, model_name="m", drift=True, swaps=1)
+    bare = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=32)
+    saved = clf.quality_profile_
+    clf.quality_profile_ = None
+    try:
+        with pytest.raises(ValueError, match="quality_profile_"):
+            R.replay(wl, executor=bare, drift=True)
+    finally:
+        clf.quality_profile_ = saved  # restore the shared fixture
+
+
+def test_plain_replay_carries_no_drift_section(executor, wl):
+    r = R.replay(wl, executor=executor, seed=1)
+    assert r["drift"] is None
+    # and the gate adds no drift checks for it
+    names = {c["name"] for c in R.check_report(r).checks}
+    assert not any(n.startswith("drift_") for n in names)
+
+
 # -- tier-1 CLI smoke (budgeted like the lint gate) --------------------
 
 def test_cli_smoke_replay_check_under_budget(tmp_path):
@@ -269,6 +331,54 @@ def test_cli_smoke_replay_check_under_budget(tmp_path):
     failed = {c["name"] for c in throttled["slo"]["checks"]
               if not c["ok"]}
     assert "latency_p50_vs_baseline" in failed
+
+
+def test_cli_drift_gate_under_budget(tmp_path):
+    """The ISSUE 9 acceptance command, in-process and budgeted:
+    `python -m benchmarks.replay --drift --check` exits 0 with the
+    drift checks green — exactly one alert_fired, one flight dump,
+    byte-identical scores across repeats (replay_median asserts) —
+    inside the satellite's 15 s tier-1 allowance."""
+    import json
+
+    t0 = time.monotonic()
+    out = str(tmp_path / "drift_report.json")
+    rc = R.main([
+        "--synthetic", "poisson", "--rate", "150",
+        "--duration", "0.6", "--width", "8",
+        "--n-estimators", "4", "--bucket-max-rows", "32",
+        "--repeats", "2", "--drift", "--check", "--out", out,
+    ])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 15.0, f"drift gate took {elapsed:.1f}s"
+    report = json.loads(open(out).read())
+    assert report["slo"]["ok"] is True
+    checks = {c["name"]: c for c in report["slo"]["checks"]}
+    assert checks["drift_alerts_fired"]["actual"] == 1
+    assert checks["drift_flight_dumps"]["actual"] == 1
+    assert report["drift"]["scores"]["psi_max"] > 0.5
+
+
+@pytest.mark.slow
+def test_drift_soak_timed_mode(clf):
+    """Open-loop drift soak: the scripted incident replayed on the
+    REAL threaded batcher with wall-clock pacing — the monitor's
+    locks under genuine concurrency, alert evaluation on the arrival
+    schedule. Timed mode is documented non-deterministic, so only the
+    incident shape is asserted, not byte identity."""
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    soak = workload.synthetic_workload(
+        "poisson", rate_rps=300, duration_s=2.0, seed=13, width=8,
+    )
+    r = R.replay(soak, registry=reg, model_name="m", mode="timed",
+                 drift=True, drift_shift=4.0, seed=5)
+    assert r["errors"] == 0
+    d = r["drift"]
+    assert d["scores"]["psi_max"] > 0.5
+    assert d["alerts_fired"] >= 1
+    assert d["flight_dumps"] == d["alerts_fired"]
 
 
 @pytest.mark.slow

@@ -276,6 +276,45 @@ class TestQuantiles:
         assert by_bucket[100.0] == "tr-slow"
 
 
+class TestSeriesHelpCompleteness:
+    def test_every_series_in_the_tree_has_help(self):
+        """THE completeness gate: every ``sbt_*`` series name the
+        package, benchmarks, or bench.py registers must carry a
+        ``SERIES_HELP`` entry (or ride the ``sbt_fit_*`` dynamic
+        prefix) — a scraper's UI shows these next to the graph, and a
+        help-less series is an undocumented instrument. Walks string
+        literals, so a new `telemetry.inc("sbt_new_total")` anywhere
+        fails here until its entry lands."""
+        import os
+        import re
+
+        from spark_bagging_tpu.telemetry.registry import SERIES_HELP
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sources = []
+        for root in ("spark_bagging_tpu", "benchmarks"):
+            for dirpath, _, files in os.walk(os.path.join(repo, root)):
+                if "__pycache__" in dirpath:
+                    continue
+                sources += [os.path.join(dirpath, f) for f in files
+                            if f.endswith(".py")]
+        sources.append(os.path.join(repo, "bench.py"))
+        pat = re.compile(r'["\'](sbt_[a-z0-9_]+)["\']')
+        missing: dict[str, str] = {}
+        for path in sources:
+            with open(path) as f:
+                src = f.read()
+            for name in pat.findall(src):
+                if name.endswith("_"):
+                    continue  # a prefix fragment, not a series name
+                if name not in SERIES_HELP \
+                        and not name.startswith("sbt_fit_"):
+                    missing[name] = os.path.relpath(path, repo)
+        assert not missing, (
+            f"sbt_* series without a SERIES_HELP entry: {missing}"
+        )
+
+
 class TestHelpAndEscaping:
     def test_help_lines_from_series_table(self):
         from spark_bagging_tpu.telemetry.registry import SERIES_HELP
