@@ -784,6 +784,14 @@ def main(argv: list[str] | None = None) -> int:
                           "repeats)")
 
     tgt = ap.add_argument_group("target model")
+    tgt.add_argument("--devices", type=int, default=0,
+                     help="serve through a replica-sharded executor "
+                          "on a (1, N) mesh (forced host CPU devices "
+                          "when jax is not yet initialized) — the "
+                          "deterministic replay gate over the sharded "
+                          "serving path; outputs must stay "
+                          "bitwise-identical to the single-device "
+                          "replay of the same workload+seed")
     tgt.add_argument("--model-checkpoint", default=None,
                      help="serve this checkpoint dir instead of the "
                           "built-in synthetic bag")
@@ -809,6 +817,27 @@ def main(argv: list[str] | None = None) -> int:
                            "against")
     args = ap.parse_args(argv)
 
+    if args.devices:
+        # CLI invocations get the forced-host-device CPU environment
+        # for free; in-process callers (tests under the 8-device
+        # conftest) already have the devices — only a jax initialized
+        # with FEWER devices than requested is an error
+        if "jax" not in sys.modules:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count"
+                f"={args.devices}"
+            ).strip()
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        if jax.device_count() < args.devices:
+            ap.error(
+                f"--devices {args.devices}: jax sees only "
+                f"{jax.device_count()} devices (initialized before "
+                "XLA_FLAGS could take effect?)"
+            )
+
     from spark_bagging_tpu import telemetry
     from spark_bagging_tpu.telemetry import slo as slo_mod
     from spark_bagging_tpu.telemetry import workload as workload_mod
@@ -831,10 +860,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.save_workload:
         wl.save(args.save_workload)
 
-    reg = ModelRegistry(
+    reg_opts: dict = dict(
         min_bucket_rows=args.min_bucket_rows,
         max_batch_rows=args.bucket_max_rows,
     )
+    if args.devices:
+        from spark_bagging_tpu.parallel import make_mesh
+
+        reg_opts["mesh"] = make_mesh(data=1, replica=args.devices)
+    reg = ModelRegistry(**reg_opts)
     if args.model_checkpoint:
         reg.load("replay", args.model_checkpoint, warm=True)
     else:

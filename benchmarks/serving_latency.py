@@ -36,11 +36,25 @@ Writes ``BENCH_serving.json`` + ``telemetry.jsonl`` (the latter into
 
     python benchmarks/serving_latency.py            # full grid
     python benchmarks/serving_latency.py --smoke    # CI-sized, CPU
+    python benchmarks/serving_latency.py --devices 8 --smoke
+                                                    # mesh-sharded mode
 
 The smoke variant is wired into tier-1 (tests/test_serving_bench.py):
 it must show micro-batched serving >= 3x naive throughput at
 concurrency 16 AND served >= naive at concurrency 1 (adaptive direct
 dispatch), with zero post-warmup recompiles.
+
+``--devices N`` switches to the MESH-SHARDED comparison (forced-host
+CPU devices via XLA_FLAGS, so it runs anywhere): an oversized bag —
+sized so the per-replica forward makes ONE device the bottleneck — is
+served by a single-device executor vs a replica-sharded executor on a
+``(1, N)`` mesh, measuring batch-forward throughput median-of-repeats.
+Gates: outputs bitwise-identical (exit 2 on violation), zero
+post-warmup compiles (exit 2), sharded >= 1.5x single-device
+throughput (exit 3 — a separate code because on core-starved CI hosts
+N virtual devices share one physical core and the band is
+unreachable by construction; the tier-1 smoke asserts the invariants
+hard and treats the band per host, PR-7 precedent).
 """
 
 from __future__ import annotations
@@ -171,10 +185,122 @@ def _measure(repeats, run_once):
     }
 
 
+def _sharded_main(args) -> int:
+    """``--devices N`` mode: single-device vs replica-sharded executor
+    throughput on an oversized bag. See the module docstring for the
+    gate/exit-code contract."""
+    import jax
+    import numpy as np
+
+    from spark_bagging_tpu import (
+        BaggingClassifier, LogisticRegression, telemetry,
+    )
+    from spark_bagging_tpu.parallel import make_mesh
+    from spark_bagging_tpu.serving import EnsembleExecutor
+
+    # the bag is the bottleneck knob: enough replicas that ONE device's
+    # per-replica forward dominates the request wall-clock, so sharding
+    # the replica axis across the slice is the win the mode measures
+    n_estimators = args.n_estimators or (256 if args.smoke else 1024)
+    n_rows, n_features = (1024, 32) if args.smoke else (4096, 64)
+    bucket = 256
+    batches = 4 if args.smoke else 16
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    w = rng.normal(size=n_features)
+    y = (X @ w + 0.3 * rng.normal(size=n_rows) > 0).astype(np.int32)
+    clf = BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=n_estimators, seed=0,
+    ).fit(X, y)
+    Xb = X[:bucket]
+
+    mesh = make_mesh(data=1, replica=args.devices)
+    single = EnsembleExecutor(clf, min_bucket_rows=bucket,
+                              max_batch_rows=bucket)
+    sharded = EnsembleExecutor(clf, min_bucket_rows=bucket,
+                               max_batch_rows=bucket, mesh=mesh)
+    single.warmup()
+    sharded.warmup()
+    reg = telemetry.registry()
+    compiles_warm = reg.counter("sbt_serving_compiles_total").value
+
+    out_single = single.forward(Xb)
+    out_sharded = sharded.forward(Xb)
+    parity = bool(np.array_equal(out_single, out_sharded)) and bool(
+        np.array_equal(out_sharded, clf.predict_proba(Xb))
+    )
+
+    def _rows_per_s(ex):
+        def run_once():
+            t0 = time.perf_counter()
+            for _ in range(batches):
+                ex.forward(Xb)
+            return [], batches * bucket / (time.perf_counter() - t0)
+
+        m = _measure(args.repeats, run_once)
+        return m["rps"]
+
+    single_rps = _rows_per_s(single)
+    sharded_rps = _rows_per_s(sharded)
+    compiles_post = int(
+        reg.counter("sbt_serving_compiles_total").value - compiles_warm
+    )
+    speedup = round(sharded_rps / single_rps, 2) if single_rps else 0.0
+
+    result = {
+        "metric": "serving_sharded",
+        "backend": jax.default_backend(),
+        "smoke": bool(args.smoke),
+        "devices": args.devices,
+        "cpu_count": os.cpu_count(),
+        "n_estimators": n_estimators,
+        "n_features": n_features,
+        "bucket": bucket,
+        "batches_per_run": batches,
+        "repeats": args.repeats,
+        "single_rows_per_s": single_rps,
+        "sharded_rows_per_s": sharded_rps,
+        "speedup": speedup,
+        "gate_speedup_min": 1.5,
+        "speedup_ok": speedup >= 1.5,
+        "parity_bitwise": parity,
+        "compiles_post_warmup": compiles_post,
+        "shard_forwards": reg.counter(
+            "sbt_serving_shard_forwards_total"
+        ).value,
+    }
+    if args.out is None:
+        args.out = os.path.join(REPO, "BENCH_serving_sharded.json")
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+    print(
+        f"sharded-vs-single: {speedup}x on {args.devices} devices "
+        f"({os.cpu_count()} host cpus); parity={parity} "
+        f"compiles_post_warmup={compiles_post}"
+    )
+    if not parity or compiles_post:
+        print("GATE FAIL: bitwise parity / zero-compile invariant")
+        return 2
+    if speedup < 1.5:
+        print("GATE BAND FAIL: sharded < 1.5x single-device "
+              "(unreachable by construction when N virtual devices "
+              "share too few physical cores)")
+        return 3
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized run on the CPU backend")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="mesh-sharded mode: force N host-platform "
+                         "devices and compare single-device vs "
+                         "replica-sharded executors")
     ap.add_argument("--concurrency", default=None,
                     help="comma list of client counts (default 1,4,16)")
     ap.add_argument("--requests", type=int, default=None,
@@ -184,18 +310,43 @@ def main() -> int:
     ap.add_argument("--n-estimators", type=int, default=None)
     ap.add_argument("--max-delay-ms", type=float, default=0.5)
     ap.add_argument("--idle-flush-ms", type=float, default=0.0)
-    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_serving.json"))
+    ap.add_argument("--out", default=None,
+                    help="report path (default BENCH_serving.json; "
+                         "BENCH_serving_sharded.json in --devices mode)")
     ap.add_argument("--telemetry", default=None,
                     help="JSONL path (default: telemetry.jsonl inside "
                          "$SBT_TELEMETRY_DIR, else ./telemetry/)")
     args = ap.parse_args()
 
+    if args.devices:
+        # must land before the first jax import/backend init: the CPU
+        # client reads XLA_FLAGS exactly once
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+
     import jax
 
-    if args.smoke:
+    if args.smoke or args.devices:
         # the smoke contract is a CPU-backend measurement (CI has no
-        # chip); config-level force, before any backend init
+        # chip); config-level force, before any backend init. The
+        # --devices mode forces CPU too — forced host-platform devices
+        # ARE the CPU backend
         jax.config.update("jax_platforms", "cpu")
+
+    if args.devices:
+        if jax.device_count() < args.devices:
+            print(
+                f"requested --devices {args.devices} but jax sees "
+                f"{jax.device_count()} (jax was initialized before "
+                "XLA_FLAGS could take effect?)",
+                file=sys.stderr,
+            )
+            return 2
+        return _sharded_main(args)
+    if args.out is None:
+        args.out = os.path.join(REPO, "BENCH_serving.json")
 
     import numpy as np
 

@@ -315,6 +315,34 @@ class _BaseBagging(ParamsMixin):
             return self.chunk_size
         return getattr(self, "_chunk_resolved", None)
 
+    def _cached_batch_forward(self, jitfn, X):
+        """Run the single-device batch forward through the unified
+        compiled-program cache (``serving/program_cache.py``): the
+        batch-predict jit, the serving executor's bucket compiles, and
+        AOT restores all share one table, so a ``predict_proba`` at a
+        row count serving already compiled reuses that executable —
+        and a batch compile warms serving. A cache miss lowers through
+        the SAME jit closure as before, so outputs are unchanged bit
+        for bit."""
+        from spark_bagging_tpu.serving import program_cache as _pc
+
+        n = int(X.shape[0])
+        if n == 0:
+            # zero-row calls keep the jit-dispatch path: an AOT compile
+            # of an empty program is pointless table churn
+            return jitfn(self.ensemble_, self.subspaces_, X)
+        key = _pc.ProgramKey(
+            _pc.fingerprint_model(self), _pc.forward_variant(self), n,
+            None, False, *_pc.toolchain_id(),
+        )
+        compiled, _ = _pc.cache().get_or_build(
+            key,
+            lambda: jitfn.lower(
+                self.ensemble_, self.subspaces_, X
+            ).compile(),
+        )
+        return compiled(self.ensemble_, self.subspaces_, X)
+
     # -- sklearn ecosystem interop -------------------------------------
 
     def __sklearn_tags__(self):
@@ -1556,10 +1584,14 @@ class BaggingClassifier(_BaseBagging):
                 self._identity_subspace,
             )(self.ensemble_, self.subspaces_, X)
             return to_host(proba)[:n]
-        proba = _jitted_predict_clf(
-            self._fitted_learner, self.n_classes_, self.n_estimators_,
-            self.voting, self._eff_chunk(), self._identity_subspace,
-        )(self.ensemble_, self.subspaces_, X)
+        proba = self._cached_batch_forward(
+            _jitted_predict_clf(
+                self._fitted_learner, self.n_classes_,
+                self.n_estimators_, self.voting, self._eff_chunk(),
+                self._identity_subspace,
+            ),
+            X,
+        )
         return np.asarray(proba)
 
     def predict(self, X) -> np.ndarray:
@@ -1792,10 +1824,13 @@ class BaggingRegressor(_BaseBagging):
                 self._eff_chunk(), self._identity_subspace,
             )(self.ensemble_, self.subspaces_, X)
             return to_host(pred)[:n]
-        pred = _jitted_predict_reg(
-            self._fitted_learner, self.n_estimators_, self._eff_chunk(),
-            self._identity_subspace,
-        )(self.ensemble_, self.subspaces_, X)
+        pred = self._cached_batch_forward(
+            _jitted_predict_reg(
+                self._fitted_learner, self.n_estimators_,
+                self._eff_chunk(), self._identity_subspace,
+            ),
+            X,
+        )
         return np.asarray(pred)
 
     def predict_quantiles(self, X, probs=(0.1, 0.5, 0.9)) -> np.ndarray:

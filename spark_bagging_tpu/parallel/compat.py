@@ -58,6 +58,42 @@ _impl, SHARD_MAP_SOURCE = _resolve()
 HAS_SHARD_MAP: bool = _impl is not None
 
 
+def _version_tuple(v: str) -> tuple[int, ...]:
+    """Leading numeric components of a version string (dev/rc suffixes
+    ignored — only the release ordering matters here)."""
+    parts: list[int] = []
+    for piece in v.split("."):
+        digits = ""
+        for ch in piece:
+            if not ch.isdigit():
+                break
+            digits += ch
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
+# Capability sentinel (same pattern as HAS_SHARD_MAP): multi-PROCESS
+# computations on the CPU backend. jax 0.4.x's CPU client rejects a
+# cross-process device_put with a NamedSharding — the guard inside
+# _device_put_sharding_impl runs a jitted psum across processes and
+# XLA answers "Multiprocess computations aren't implemented on the CPU
+# backend". The 0.5 line implements cross-process CPU collectives, so
+# the same code path works there. Multihost suites (which stand in a
+# CPU Gloo pod for a TPU pod) gate on this so an incapable build
+# reports SKIPPED-with-reason instead of a wall of worker errors;
+# production callers can probe it before initializing a CPU pod.
+HAS_MULTIPROCESS_CPU: bool = _version_tuple(jax.__version__) >= (0, 5)
+
+MULTIPROCESS_CPU_REASON: str = (
+    "jax {v}'s CPU backend cannot run multi-process computations "
+    "(cross-process device_put raises XlaRuntimeError; implemented in "
+    "the 0.5 line) — multihost CPU-pod execution is unavailable on "
+    "this build"
+).format(v=jax.__version__)
+
+
 def shard_map(f: Callable, *, mesh: Any, in_specs: Any, out_specs: Any,
               check_vma: bool = True) -> Callable:
     """``jax.shard_map`` with the new keyword surface, wherever this

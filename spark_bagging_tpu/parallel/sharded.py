@@ -98,6 +98,89 @@ def pad_rows(X, y, multiple: int):
     return Xp, yp, maskp
 
 
+def replica_sharded_serving(model: Any, mesh: Mesh):
+    """Build the mesh-sharded SERVING forwards for a fitted estimator —
+    the inference twin of :func:`sharded_fit`'s layout: the stacked
+    params' replica axis is sharded over the mesh's ``replica`` axis
+    (each device holds — and forwards — ``R / n_shards`` replicas), the
+    request ``X`` is replicated (serving shards by ENSEMBLE MEMBERS,
+    not by rows of one request), and the served aggregate comes back
+    replicated on every device.
+
+    Bitwise-parity construction: the per-shard partial results are
+    ``all_gather``'d back to the full ``(R, n, ...)`` per-replica array
+    and the vote/mean reduction runs over that SAME-SHAPED array the
+    single-device program reduces. A ``psum`` of per-shard partial sums
+    would regroup the float accumulation ``((r0..r3)+(r4..r7))`` vs the
+    single-device ``(r0..r7)`` and drift in the last ulp — measured on
+    the CPU backend, and exactly the drift the serving parity tests
+    forbid. The gather moves only per-replica OUTPUTS (small next to
+    the per-replica forward it parallelizes), and the final reduce is
+    replicated work per device — cheap, and the price of serving the
+    identical bits the batch API produces.
+
+    Returns ``(fwd, replica_fwd, params, subspaces, x_sharding,
+    n_shards)``: ``fwd(params, subspaces, X)`` is the aggregated
+    serving forward, ``replica_fwd`` its aggregation-free twin (the
+    disagreement tap / uncertainty seam), both closing over the mesh;
+    ``params``/``subspaces`` are already ``device_put`` with the
+    replica sharding; ``x_sharding`` is the replicated NamedSharding
+    request buffers must use.
+    """
+    from jax.sharding import NamedSharding
+
+    data, replica = _axis_sizes(mesh)
+    if data != 1:
+        raise ValueError(
+            f"serving shards the replica axis only; need a mesh with "
+            f"data-axis size 1, got {data}x{replica} (serving shards "
+            "by ensemble members — rows of one request stay together)"
+        )
+    rep_fn, params, subspaces = model.replica_forward()
+    n_replicas = int(subspaces.shape[0])
+    n_total = int(getattr(model, "n_estimators_", 0) or n_replicas)
+    if n_replicas % replica != 0:
+        raise ValueError(
+            f"n_estimators={n_replicas} not divisible by replica-axis "
+            f"size {replica}; choose a mesh whose replica axis divides "
+            "the ensemble"
+        )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def fwd(p, s, Xs):
+        local = rep_fn(p, s, Xs)          # (R/n_shards, n, ...) this shard
+        full = jax.lax.all_gather(local, REPLICA_AXIS, axis=0,
+                                  tiled=True)
+        return jnp.sum(full, axis=0) / n_total
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def replica_fwd(p, s, Xs):
+        local = rep_fn(p, s, Xs)
+        return jax.lax.all_gather(local, REPLICA_AXIS, axis=0,
+                                  tiled=True)
+
+    def _put_replica(a):
+        spec = P(REPLICA_AXIS, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    params = jax.tree_util.tree_map(_put_replica, params)
+    subspaces = _put_replica(subspaces)
+    x_sharding = NamedSharding(mesh, P())
+    return fwd, replica_fwd, params, subspaces, x_sharding, replica
+
+
 def sharded_fit(
     learner: BaseLearner,
     mesh: Mesh,

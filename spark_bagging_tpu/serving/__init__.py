@@ -24,9 +24,18 @@ serving path on top of the fitted estimators:
   load-from-checkpoint; swaps pre-compile the incoming executor on the
   live bucket set so traffic never sees a compile stall.
   ``registry.save()`` persists compiled bucket executables next to the
-  weights (``aot_cache.py``) and ``registry.load()`` hydrates them, so
-  a fresh serving process is warm at startup — zero compiles, no
-  tracing.
+  weights (``aot_cache.py``) plus a ``serve_config.json`` manifest,
+  and ``registry.load()`` hydrates both — a fresh serving process (or
+  M peers behind a load balancer) comes up warm in the saver's exact
+  version + executor config: zero compiles, no tracing,
+  version-consistent rolling swaps.
+- ``program_cache.py`` — the unified compiled-program cache every
+  producer (batch predict, executor builds, AOT restores) shares: a
+  program compiled anywhere is reused everywhere in the process.
+- Mesh-sharded serving: ``EnsembleExecutor(model, mesh=...)`` shards
+  the ensemble's replica axis across a ``(1, N)`` device mesh and
+  serves outputs bitwise-identical to the single-device path (see
+  ARCHITECTURE.md → Distributed serving).
 
 Telemetry rides the PR-1 registry end to end: ``sbt_serving_*``
 counters/gauges/histograms (requests, rows, batches, queue depth,

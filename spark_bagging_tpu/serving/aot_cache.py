@@ -18,11 +18,18 @@ The manifest records — and :func:`restore_executables` requires equal —
 - ``model_fingerprint``: sha256 over the fitted params pytree (leaf
   bytes + shapes + dtypes + treedef), the subspace matrix, estimator
   class, task, feature width, and class set — two models that would
-  compile different programs fingerprint differently;
+  compile different programs fingerprint differently (shared with the
+  in-process unified cache: ``program_cache.fingerprint_params``);
 - ``ladder``: the executor's ``(min_bucket_rows, max_batch_rows)``
   bounds — the compile-shape universe;
-- ``jax_version`` / ``backend`` / ``n_devices`` — XLA serialization is
-  only stable within one toolchain + hardware shape;
+- ``mesh``: the serving mesh's ``(data, replica)`` shape, or None for
+  a single-device executor — a single-device executable restored into
+  a mesh-sharded executor (or vice versa) would be the WRONG program:
+  the mismatch is a counted miss and the executor lowers its own,
+  never a crash and never a silently single-device serving path;
+- ``jax_version`` / ``backend`` / ``n_devices`` / ``device_kind`` —
+  XLA serialization is only stable within one toolchain + hardware
+  shape + chip generation;
 - ``donate``: donation changes the compiled program's aliasing.
 
 Any mismatch (or an absent/corrupt cache) is a MISS, never an error:
@@ -43,7 +50,6 @@ inside the checkpoint dir; ``ModelRegistry.load()`` auto-detects it.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import pickle
@@ -57,32 +63,21 @@ MANIFEST = "aot_manifest.json"
 
 
 def model_fingerprint(executor: Any) -> str:
-    """sha256 identity of the program an executor compiles: the fitted
-    params + subspaces pytree (bytes, shapes, dtypes, structure), the
-    estimator class, task, feature width, and class set."""
-    import jax
-    import numpy as np
+    """sha256 identity of the program an executor compiles — the SAME
+    fingerprint the in-process unified cache keys on
+    (``program_cache.fingerprint_params``), so the disk cache and the
+    process cache agree on what "the same model" means. Executors
+    compute it once at construction; anything else falls back to
+    hashing here."""
+    fp = getattr(executor, "fingerprint", None)
+    if fp is not None:
+        return fp
+    from spark_bagging_tpu.serving.program_cache import fingerprint_params
 
-    h = hashlib.sha256()
-    cls = type(executor.model)
-    h.update(
-        f"{cls.__module__}:{cls.__qualname__}|{executor.task}|"
-        f"{executor.n_features}\n".encode()
+    return fingerprint_params(
+        type(executor.model), executor.task, executor.n_features,
+        executor.classes_, executor._params, executor._subspaces,
     )
-    if executor.classes_ is not None:
-        c = np.asarray(executor.classes_)
-        h.update(str(c.dtype).encode())
-        h.update(c.tobytes())
-    leaves, treedef = jax.tree_util.tree_flatten(
-        (executor._params, executor._subspaces)
-    )
-    h.update(str(treedef).encode())
-    for leaf in leaves:
-        a = np.asarray(leaf)
-        h.update(str(a.shape).encode())
-        h.update(str(a.dtype).encode())
-        h.update(a.tobytes())
-    return h.hexdigest()
 
 
 def cache_key(executor: Any) -> dict[str, Any]:
@@ -90,11 +85,15 @@ def cache_key(executor: Any) -> dict[str, Any]:
     module docstring."""
     import jax
 
+    mesh_shape = getattr(executor, "mesh_shape", None)
+    devices = jax.devices()
     return {
         "format": FORMAT_VERSION,
         "jax_version": jax.__version__,
         "backend": jax.default_backend(),
         "n_devices": jax.device_count(),
+        "device_kind": str(devices[0].device_kind) if devices else "unknown",
+        "mesh": list(mesh_shape) if mesh_shape is not None else None,
         "ladder": [int(executor.min_bucket_rows),
                    int(executor.max_batch_rows)],
         "donate": bool(executor._donate),

@@ -30,6 +30,7 @@ import numpy as np
 from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.telemetry import tracing
+from spark_bagging_tpu.serving import program_cache as _pc
 from spark_bagging_tpu.serving.buckets import (
     DEFAULT_MAX_ROWS,
     DEFAULT_MIN_ROWS,
@@ -74,6 +75,17 @@ class EnsembleExecutor:
     memory for the outputs. The default (``None``) donates on
     accelerator backends only: CPU XLA does not implement donation and
     would warn on every bucket compile.
+
+    ``mesh`` switches the executor to the replica-sharded serving
+    program (``parallel/sharded.replica_sharded_serving``): the
+    ensemble's stacked params are sharded over the mesh's ``replica``
+    axis, each per-bucket compile partitions the per-replica forward
+    across the whole slice, and the aggregate comes back replicated —
+    bitwise-identical to the single-device executor (the parity tests'
+    contract). The mesh must have data-axis size 1 and a replica axis
+    that divides ``n_estimators``. Everything else — the bucket
+    ladder, ragged packing, the batcher seam, the quality tap — is
+    unchanged.
     """
 
     def __init__(
@@ -83,6 +95,7 @@ class EnsembleExecutor:
         min_bucket_rows: int = DEFAULT_MIN_ROWS,
         max_batch_rows: int = DEFAULT_MAX_ROWS,
         donate_input: bool | None = None,
+        mesh: Any = None,
     ):
         import jax
 
@@ -93,7 +106,21 @@ class EnsembleExecutor:
                 f"need 1 <= min_bucket_rows <= max_batch_rows, got "
                 f"{min_bucket_rows}, {max_batch_rows}"
             )
-        fn, params, subspaces = model.aggregated_forward()
+        self.mesh = mesh
+        self.mesh_shape = _pc.mesh_shape(mesh)
+        if mesh is None:
+            fn, params, subspaces = model.aggregated_forward()
+            rep_fn = None
+            self._x_sharding = None
+        else:
+            from spark_bagging_tpu.parallel.sharded import (
+                replica_sharded_serving,
+            )
+
+            (fn, rep_fn, params, subspaces, self._x_sharding,
+             n_shards) = replica_sharded_serving(model, mesh)
+            telemetry.set_gauge("sbt_serving_shard_devices",
+                                float(n_shards))
         self.model = model
         self.task: str = model.task
         self.n_features: int = int(model.n_features_in_)
@@ -104,6 +131,20 @@ class EnsembleExecutor:
         self._params = params
         self._subspaces = subspaces
         self._donate = bool(donate_input)
+        # program identity for the unified compiled-program cache
+        # (program_cache.py) and the AOT disk cache: computed ONCE per
+        # executor (it hashes every parameter byte). The placed params
+        # hash identically to the estimator's own, so executor compiles
+        # and batch-predict compiles of the same model share entries.
+        try:
+            self.fingerprint: str = _pc.fingerprint_model(model)
+        except AttributeError:
+            self.fingerprint = _pc.fingerprint_params(
+                type(model), self.task, self.n_features, self.classes_,
+                params, subspaces,
+            )
+        self._variant = _pc.forward_variant(model)
+        self._replica_variant = _pc.forward_variant(model, "replica")
         self._compiled: dict[int, Any] = {}
         # bucket -> {"flops", "bytes"} from compiled.cost_analysis()
         # at build time (None values when the backend reports none):
@@ -120,8 +161,12 @@ class EnsembleExecutor:
         # compiled lazily per bucket on first sampled batch; its
         # compiles count in sbt_quality_disagreement_compiles_total,
         # NOT the serving compile counter — the zero-post-warmup-
-        # compile gate is about the serving path, and the tap is not it
-        self._replica_fn = None
+        # compile gate is about the serving path, and the tap is not it.
+        # Mesh executors resolve it EAGERLY: the sharded serving
+        # program is built from the replica closure, so its gathered
+        # twin comes from the same construction (and the lazy resolve
+        # would hand back the unsharded single-device closure).
+        self._replica_fn = rep_fn
         self._replica_compiled: dict[int, Any] = {}
         self._replica_unavailable = False
         # stamped by ModelRegistry on register/swap; standalone
@@ -137,9 +182,11 @@ class EnsembleExecutor:
         return tuple(sorted(self._compiled))
 
     def warmup(self, buckets=None) -> tuple[int, ...]:
-        """Compile ahead of traffic. ``buckets=None`` compiles the full
+        """Compile ahead of traffic. ``buckets=None`` covers the full
         ladder — afterwards NO request can trigger a compile. Returns
-        the buckets compiled by this call."""
+        the buckets this call installed (compiled, or adopted from the
+        unified program cache when another consumer of this model's
+        programs already paid the compile)."""
         if buckets is None:
             buckets = bucket_ladder(self.min_bucket_rows,
                                     self.max_batch_rows)
@@ -152,61 +199,96 @@ class EnsembleExecutor:
                 built.append(b)
         return tuple(built)
 
-    def _build(self, bucket: int):
-        """Compile the forward for one bucket (serialized; double-checked
-        so racing threads compile each bucket once)."""
+    def _program_key(self, bucket: int, variant: str | None = None):
+        """Unified-cache identity of this executor's program at one
+        bucket (see :mod:`~spark_bagging_tpu.serving.program_cache`)."""
+        return _pc.ProgramKey(
+            self.fingerprint, variant or self._variant, int(bucket),
+            self.mesh_shape, self._donate, *_pc.toolchain_id(),
+        )
+
+    def _example_x(self, bucket: int):
+        """The example ``X`` argument a bucket compile lowers against —
+        placed with the replicated request sharding on mesh executors
+        (the compiled program's input contract)."""
         import jax
         import jax.numpy as jnp
+
+        Xz = jnp.zeros((bucket, self.n_features), jnp.float32)
+        if self._x_sharding is not None:
+            Xz = jax.device_put(Xz, self._x_sharding)
+        return Xz
+
+    def _install(self, bucket: int, compiled: Any) -> None:
+        """Record one bucket executable + its cost gauges (caller holds
+        the build lock)."""
+        cost = _compiled_cost(compiled)
+        # sbt-lint: disable=shared-state-unlocked — every caller holds self._build_lock (_build/_adopt)
+        self.bucket_costs[bucket] = cost
+        if telemetry.enabled():
+            labels = {"bucket": str(bucket)}
+            if cost["flops"] is not None:
+                telemetry.set_gauge("sbt_serving_bucket_cost_flops",
+                                    cost["flops"], labels=labels)
+            if cost["bytes"] is not None:
+                telemetry.set_gauge("sbt_serving_bucket_cost_bytes",
+                                    cost["bytes"], labels=labels)
+        # sbt-lint: disable=shared-state-unlocked — under self._build_lock (see docstring)
+        self._compiled[bucket] = compiled
+
+    def _build(self, bucket: int):
+        """Install the forward for one bucket: a unified-cache hit
+        adopts the already-compiled program (a compile someone else —
+        another executor for this model, a batch predict, an AOT
+        restore — already paid); only a miss lowers and compiles,
+        counting ``sbt_serving_compiles_total``. Serialized +
+        double-checked so racing threads resolve each bucket once."""
+        import jax
 
         with self._build_lock:
             fn = self._compiled.get(bucket)
             if fn is not None:
                 return fn
+            key = self._program_key(bucket)
+            compiled = _pc.cache().get(key)
+            if compiled is not None:
+                self._install(bucket, compiled)
+                return compiled
             t0 = time.perf_counter()
             with telemetry.span("serving_compile", bucket=bucket):
                 jitted = jax.jit(
                     self._fn,
                     donate_argnums=(2,) if self._donate else (),
                 )
-                Xz = jnp.zeros((bucket, self.n_features), jnp.float32)
                 compiled = jitted.lower(
-                    self._params, self._subspaces, Xz
+                    self._params, self._subspaces, self._example_x(bucket)
                 ).compile()
             telemetry.inc("sbt_serving_compiles_total")
+            if self.mesh is not None:
+                telemetry.inc(
+                    "sbt_shardmap_traces_total",
+                    labels={"kind": "serving",
+                            "mesh": "x".join(map(str, self.mesh_shape))},
+                )
             telemetry.observe("sbt_serving_compile_seconds",
                               time.perf_counter() - t0)
-            cost = _compiled_cost(compiled)
-            self.bucket_costs[bucket] = cost
-            if telemetry.enabled():
-                labels = {"bucket": str(bucket)}
-                if cost["flops"] is not None:
-                    telemetry.set_gauge("sbt_serving_bucket_cost_flops",
-                                        cost["flops"], labels=labels)
-                if cost["bytes"] is not None:
-                    telemetry.set_gauge("sbt_serving_bucket_cost_bytes",
-                                        cost["bytes"], labels=labels)
-            self._compiled[bucket] = compiled
+            compiled = _pc.cache().put(key, compiled)
+            self._install(bucket, compiled)
             return compiled
 
     def _adopt(self, bucket: int, compiled: Any) -> bool:
         """Install a deserialized executable for ``bucket`` (the AOT
         warm-start path — no lowering, no compile, not counted in
-        ``sbt_serving_compiles_total``). First installer wins; returns
-        whether this call installed it."""
+        ``sbt_serving_compiles_total``). The adopted program is also
+        published to the unified cache, so a restore warms every OTHER
+        consumer of this model's programs too. First installer wins;
+        returns whether this call installed it."""
         with self._build_lock:
             if bucket in self._compiled:
                 return False
-            cost = _compiled_cost(compiled)
-            self.bucket_costs[bucket] = cost
-            if telemetry.enabled():
-                labels = {"bucket": str(bucket)}
-                if cost["flops"] is not None:
-                    telemetry.set_gauge("sbt_serving_bucket_cost_flops",
-                                        cost["flops"], labels=labels)
-                if cost["bytes"] is not None:
-                    telemetry.set_gauge("sbt_serving_bucket_cost_bytes",
-                                        cost["bytes"], labels=labels)
-            self._compiled[bucket] = compiled
+            compiled = _pc.cache().put(self._program_key(bucket),
+                                       compiled)
+            self._install(bucket, compiled)
             return True
 
     def save_executables(self, path: str) -> tuple[int, ...]:
@@ -222,8 +304,8 @@ class EnsembleExecutor:
         :meth:`save_executables` — instant warm start. Silently
         restores nothing (and falls back to lowering on demand) when
         the cache is absent or was built under a different key (model
-        fingerprint, bucket ladder, jax version, backend, donation).
-        Returns the buckets restored."""
+        fingerprint, bucket ladder, mesh shape, jax version, backend,
+        device kind, donation). Returns the buckets restored."""
         from spark_bagging_tpu.serving.aot_cache import restore_executables
 
         return restore_executables(self, path)
@@ -280,7 +362,6 @@ class EnsembleExecutor:
         slab the serving forward already consumed). Returns None when
         the model exposes no per-replica seam."""
         import jax
-        import jax.numpy as jnp
 
         if self._replica_unavailable:
             return None
@@ -303,14 +384,18 @@ class EnsembleExecutor:
                         stacklevel=2,
                     )
                     return None
-            with telemetry.span("quality_replica_compile",
-                                bucket=bucket):
-                jitted = jax.jit(self._replica_fn)
-                Xz = jnp.zeros((bucket, self.n_features), jnp.float32)
-                compiled = jitted.lower(
-                    self._params, self._subspaces, Xz
-                ).compile()
-            telemetry.inc("sbt_quality_disagreement_compiles_total")
+            key = self._program_key(bucket, self._replica_variant)
+            compiled = _pc.cache().get(key)
+            if compiled is None:
+                with telemetry.span("quality_replica_compile",
+                                    bucket=bucket):
+                    jitted = jax.jit(self._replica_fn)
+                    compiled = jitted.lower(
+                        self._params, self._subspaces,
+                        self._example_x(bucket)
+                    ).compile()
+                telemetry.inc("sbt_quality_disagreement_compiles_total")
+                compiled = _pc.cache().put(key, compiled)
             self._replica_compiled[bucket] = compiled
             return compiled
 
@@ -488,6 +573,8 @@ class EnsembleExecutor:
                 ("sbt_serving_rows_total", float(fill)),
                 ("sbt_serving_padding_rows_total", float(bucket - fill)),
             ]
+            if self.mesh is not None:
+                counts.append(("sbt_serving_shard_forwards_total", 1.0))
             flops = self.bucket_costs.get(bucket, {}).get("flops")
             if flops:
                 # rows are interchangeable within a bucket's program,

@@ -1,0 +1,231 @@
+"""Unified compiled-program cache — one program, compiled once, reused
+everywhere.
+
+Before this module, three producers each compiled (and cached) the SAME
+forward independently: the batch-predict jits in ``bagging.py`` (jit
+dispatch cache, keyed by input shape), the serving executor's
+per-bucket AOT compiles (``serving/executor.py``, per-instance dict),
+and the persisted executable cache (``serving/aot_cache.py``). Two
+executors for the same fitted model — or a batch ``predict_proba``
+call at a row count the serving ladder already compiled — paid the XLA
+compile again. This module is the one table they all share: a
+process-wide map from a :class:`ProgramKey` to a compiled executable,
+so a program compiled ANYWHERE (executor warmup, a batch predict, an
+AOT restore) is a cache hit everywhere else.
+
+Key contract (why each component is in the key):
+
+- ``fingerprint`` — sha256 of the fitted params/subspaces pytree plus
+  estimator class, task, feature width and class set
+  (:func:`fingerprint_params`): two models that would compile
+  different programs must never share an entry;
+- ``variant`` — which closure over those params this program traces
+  (aggregated vs per-replica forward, voting mode, replica chunking,
+  identity-subspace fast path): same weights, different computation;
+- ``bucket`` — the row count the program was lowered for (XLA compiles
+  per shape);
+- ``mesh`` — the ``(data, replica)`` device grid the program was
+  partitioned over (``None`` = single-device): a single-device
+  executable is the WRONG program for a mesh executor and vice versa;
+- ``donate`` — donation changes the program's buffer aliasing;
+- ``jax_version`` / ``backend`` / ``device_kind`` — an executable is
+  only meaningful on the toolchain + hardware kind that built it.
+
+The cache is bounded (LRU eviction at ``capacity`` entries) and
+thread-safe; lookups/inserts count ``sbt_program_cache_*`` telemetry.
+Entries hold compiled executables only — parameters are passed at call
+time, so a cache entry pins no model weights.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Any, Callable, NamedTuple
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.analysis.locks import make_lock
+
+
+class ProgramKey(NamedTuple):
+    """Identity of one compiled forward — see the module docstring."""
+
+    fingerprint: str
+    variant: str
+    bucket: int
+    mesh: tuple[int, int] | None
+    donate: bool
+    jax_version: str
+    backend: str
+    device_kind: str
+
+
+def toolchain_id() -> tuple[str, str, str]:
+    """``(jax_version, backend, device_kind)`` for this process — the
+    shared tail of every :class:`ProgramKey` and of the AOT disk-cache
+    key (``serving/aot_cache.py``)."""
+    import jax
+
+    devices = jax.devices()
+    kind = devices[0].device_kind if devices else "unknown"
+    return jax.__version__, jax.default_backend(), str(kind)
+
+
+def fingerprint_params(model_cls: type, task: str, n_features: int,
+                       classes, params: Any, subspaces: Any) -> str:
+    """sha256 identity of the program a forward over ``params`` would
+    compile: leaf bytes + shapes + dtypes + tree structure, plus the
+    estimator class, task, feature width, and class set."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    h.update(
+        f"{model_cls.__module__}:{model_cls.__qualname__}|{task}|"
+        f"{n_features}\n".encode()
+    )
+    if classes is not None:
+        c = np.asarray(classes)
+        h.update(str(c.dtype).encode())
+        h.update(c.tobytes())
+    leaves, treedef = jax.tree_util.tree_flatten((params, subspaces))
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def fingerprint_model(model: Any) -> str:
+    """:func:`fingerprint_params` for a fitted estimator (cached on the
+    instance, invalidated when a refit rebinds ``ensemble_`` — the
+    hash walks every parameter byte, which must not be paid per
+    ``predict`` call)."""
+    token = getattr(model, "_fp_token", None)
+    if token is not None and token[0] is model.ensemble_:
+        return token[1]
+    fp = fingerprint_params(
+        type(model), model.task, int(model.n_features_in_),
+        getattr(model, "classes_", None), model.ensemble_,
+        model.subspaces_,
+    )
+    try:
+        model._fp_token = (model.ensemble_, fp)
+    except AttributeError:
+        pass  # slotted/frozen estimators just recompute
+    return fp
+
+
+def forward_variant(model: Any, kind: str = "aggregated") -> str:
+    """The static-closure-config component of a :class:`ProgramKey`:
+    everything besides the weights that changes what the forward
+    traces. ``kind`` distinguishes the aggregated serving program from
+    the per-replica (disagreement-tap / uncertainty) twin."""
+    return (
+        f"{kind}|voting={getattr(model, 'voting', None)}"
+        f"|chunk={model._eff_chunk() if hasattr(model, '_eff_chunk') else None}"
+        f"|ident={getattr(model, '_identity_subspace', None)}"
+    )
+
+
+def mesh_shape(mesh: Any) -> tuple[int, int] | None:
+    """Normalize a Mesh (or None) to the ``(data, replica)`` tuple the
+    key stores — mesh OBJECTS differ per process; their shape is the
+    portable identity."""
+    if mesh is None:
+        return None
+    from spark_bagging_tpu.parallel.mesh import DATA_AXIS, REPLICA_AXIS
+
+    return (int(mesh.shape.get(DATA_AXIS, 1)),
+            int(mesh.shape.get(REPLICA_AXIS, 1)))
+
+
+# sbt-lint: shared-state
+class ProgramCache:
+    """Bounded, thread-safe LRU map ``ProgramKey -> compiled``."""
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = make_lock("serving.program_cache")
+        self._entries: OrderedDict[ProgramKey, Any] = OrderedDict()
+
+    def get(self, key: ProgramKey) -> Any | None:
+        """The cached executable for ``key``, or None (counted as a
+        hit/miss either way)."""
+        with self._lock:
+            compiled = self._entries.get(key)
+            if compiled is not None:
+                self._entries.move_to_end(key)
+        telemetry.inc("sbt_program_cache_hits_total" if compiled is not None
+                      else "sbt_program_cache_misses_total")
+        return compiled
+
+    def put(self, key: ProgramKey, compiled: Any) -> Any:
+        """Insert-if-absent; returns the winning executable (the first
+        insert wins, so racing builders converge on one program)."""
+        evicted = 0
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                self._entries.move_to_end(key)
+                return existing
+            self._entries[key] = compiled
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                evicted += 1
+            size = len(self._entries)
+        if evicted:
+            telemetry.inc("sbt_program_cache_evictions_total",
+                          float(evicted))
+        telemetry.set_gauge("sbt_program_cache_entries", float(size))
+        return compiled
+
+    def get_or_build(self, key: ProgramKey,
+                     build: Callable[[], Any]) -> tuple[Any, bool]:
+        """``(compiled, was_hit)``. The build runs OUTSIDE the cache
+        lock (an XLA compile can take seconds; holding the table lock
+        would serialize unrelated models' compiles); racing same-key
+        builders both compile and the first ``put`` wins."""
+        compiled = self.get(key)
+        if compiled is not None:
+            return compiled, True
+        return self.put(key, build()), False
+
+    def clear(self) -> None:
+        """Drop every entry (tests simulating a fresh process)."""
+        with self._lock:
+            self._entries.clear()
+        telemetry.set_gauge("sbt_program_cache_entries", 0.0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "capacity": self.capacity}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_default: ProgramCache | None = None
+_default_lock = make_lock("serving.program_cache.default")
+
+
+def cache() -> ProgramCache:
+    """The process-wide cache every producer shares."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ProgramCache()
+        return _default
+
+
+def clear() -> None:
+    """Reset the process-wide cache (tests; a no-op if never used)."""
+    with _default_lock:
+        if _default is not None:
+            _default.clear()

@@ -121,6 +121,7 @@ class ModelRegistry:
 
     def register(self, name: str, model: Any, *, warmup: bool = False,
                  executable_cache: str | None = None,
+                 version: int | None = None,
                  **executor_opts: Any) -> EnsembleExecutor:
         """Install a fitted estimator as version 1 of ``name``.
 
@@ -130,9 +131,16 @@ class ModelRegistry:
         (:mod:`~spark_bagging_tpu.serving.aot_cache`) to hydrate
         executables from FIRST — with a full-ladder cache hit, warmup
         compiles nothing and the entry is serve-ready instantly.
-        ``executor_opts`` (bucket bounds, donation) override the
-        registry defaults and stick to the name across swaps.
+        ``executor_opts`` (bucket bounds, donation, serving mesh)
+        override the registry defaults and stick to the name across
+        swaps. ``version`` installs at an explicit version number —
+        the N-process seam (:meth:`load` from a ``serve_config``
+        manifest) uses it so every peer process loading one checkpoint
+        agrees on the version it serves.
         """
+        version = 1 if version is None else int(version)
+        if version < 1:
+            raise ValueError(f"version must be >= 1, got {version}")
         opts = {**self._default_opts, **executor_opts}
         ex = EnsembleExecutor(model, **opts)
         if executable_cache is not None:
@@ -146,16 +154,18 @@ class ModelRegistry:
                     f"{self._entries[name].version}); use swap() to "
                     "replace it"
                 )
-            self._entries[name] = _Entry(name, 1, ex, opts)
+            self._entries[name] = _Entry(name, version, ex, opts)
             ex.model_name = name
-            ex.model_version = 1
+            ex.model_version = version
         telemetry.inc("sbt_serving_models_registered_total")
-        telemetry.set_gauge("sbt_serving_model_version", 1.0,
+        telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})
         return ex
 
     def swap(self, name: str, model: Any, *, warm: bool = True,
              executable_cache: str | None = None,
+             version: int | None = None,
+             _equal_version_ok: bool = False,
              **executor_opts: Any) -> EnsembleExecutor:
         """Atomically replace ``name``'s serving model; returns the new
         executor and bumps the version.
@@ -173,8 +183,28 @@ class ModelRegistry:
         ``executable_cache`` hydrates the replacement from a persisted
         AOT cache before the warm pre-compile pass, so even a
         cold-cache swap stalls only on the rungs the cache missed.
+        ``version`` pins the replacement's version number (the
+        N-process rolling-swap seam): it must be NEWER than the live
+        version — a peer re-loading yesterday's checkpoint over
+        today's model is a rollback that must be explicit, not a race
+        a load balancer can lose — and the swap is rejected (counted,
+        flight-recorded) when it is not. ``_equal_version_ok``
+        (internal, used by :meth:`load`) turns the EQUAL-version case
+        into a benign no-op returning the live executor instead: two
+        peers racing to install the same manifest must converge, not
+        record a spurious swap-rejected incident.
         """
         entry = self._entry(name)
+        if version is not None and int(version) <= entry.version:
+            if _equal_version_ok and int(version) == entry.version:
+                return entry.executor
+            self._reject_swap(
+                name,
+                f"stale swap: requested version {int(version)} is not "
+                f"newer than the live version {entry.version} "
+                "(rollbacks must re-register under a new name or use "
+                "an explicitly newer manifest)",
+            )
         old = entry.executor
         opts = {**entry.opts, **executor_opts}
         new = EnsembleExecutor(model, **opts)
@@ -209,16 +239,36 @@ class ModelRegistry:
                 new._build(bucket_for(
                     b, new.min_bucket_rows, new.max_batch_rows
                 ))
+        stale_live = None
+        live_ex = None
         with self._lock:
             # re-read under the lock: racing swaps must serialize into
-            # a strict version order, last one in place
+            # a strict version order, last one in place — and an
+            # explicit (manifest) version re-checks staleness HERE,
+            # where the ordering is decided, not just at entry
             entry = self._entries[name]
-            entry.executor = new
-            entry.opts = opts
-            entry.version += 1
-            version = entry.version
-            new.model_name = name
-            new.model_version = version
+            if version is not None and int(version) <= entry.version:
+                stale_live = entry.version
+                live_ex = entry.executor
+            else:
+                entry.executor = new
+                entry.opts = opts
+                entry.version = (entry.version + 1 if version is None
+                                 else int(version))
+                version = entry.version
+                new.model_name = name
+                new.model_version = version
+        if stale_live is not None:
+            if _equal_version_ok and int(version) == stale_live:
+                # a racing peer installed the very manifest we carry:
+                # the documented poller convergence, not an incident
+                return live_ex
+            self._reject_swap(
+                name,
+                f"stale swap: requested version {int(version)} is not "
+                f"newer than the live version {stale_live} (a racing "
+                "peer already installed it)",
+            )
         telemetry.inc("sbt_serving_swaps_total")
         telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})
@@ -302,36 +352,148 @@ class ModelRegistry:
     #: subdirectory of a checkpoint dir where :meth:`save` persists the
     #: bucket executables and :meth:`load` looks for them
     AOT_SUBDIR = "serving_aot"
+    #: the serving manifest :meth:`save` writes next to the weights —
+    #: the N-process seam: everything a fresh process needs to serve
+    #: this checkpoint exactly as the saver did (executor config, mesh
+    #: shape, version), without the operator re-specifying any of it
+    SERVE_CONFIG = "serve_config.json"
+
+    def _read_serve_config(self, path: str) -> dict | None:
+        """The ``serve_config.json`` manifest at ``path``, or None
+        (absent or unreadable — a config-less checkpoint is an older
+        saver's, not an error)."""
+        import json
+
+        cfg_path = os.path.join(path, self.SERVE_CONFIG)
+        if not os.path.isfile(cfg_path):
+            return None
+        try:
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+        except (OSError, ValueError) as e:
+            import warnings
+
+            warnings.warn(
+                f"unreadable serve_config at {cfg_path!r} ({e!r}); "
+                "loading with caller/registry executor options only",
+                stacklevel=3,
+            )
+            return None
+        return cfg if isinstance(cfg, dict) else None
+
+    def _opts_from_config(self, cfg: dict,
+                          executor_opts: dict) -> dict:
+        """Merge a serve_config's executor section UNDER the caller's
+        explicit options. The persisted mesh SHAPE is reconstructed
+        into a live mesh when this process has the devices for it;
+        otherwise the process serves single-device with a warning —
+        the persisted mesh executables then restore as counted AOT
+        misses, never as wrong answers."""
+        merged: dict[str, Any] = {}
+        section = cfg.get("executor")
+        if not isinstance(section, dict):
+            return executor_opts
+        for k in ("min_bucket_rows", "max_batch_rows", "donate_input"):
+            if section.get(k) is not None:
+                merged[k] = section[k]
+        shape = section.get("mesh")
+        if (
+            shape
+            and "mesh" not in executor_opts
+            and "mesh" not in self._default_opts
+        ):
+            from spark_bagging_tpu.parallel.mesh import make_mesh
+
+            try:
+                import jax
+
+                data, replica = int(shape[0]), int(shape[1])
+                devices = list(jax.devices())
+                need = data * replica
+                # a host with MORE devices than the manifest's mesh is
+                # the natural rolling-upgrade case: build the recorded
+                # shape over a prefix of the devices rather than
+                # demanding an exact count (make_mesh's default)
+                kwargs = ({"devices": devices[:need]}
+                          if len(devices) >= need else {})
+                merged["mesh"] = make_mesh(data=data, replica=replica,
+                                           **kwargs)
+            except (ValueError, TypeError, IndexError) as e:
+                # IndexError: a truncated/hand-edited "mesh" entry —
+                # corrupt manifests degrade, they never crash a load
+                import warnings
+
+                warnings.warn(
+                    f"serve_config names a {shape} serving mesh this "
+                    f"process cannot build ({e}); serving "
+                    "single-device (persisted mesh executables will "
+                    "restore as counted AOT misses)",
+                    stacklevel=3,
+                )
+        return {**merged, **executor_opts}
 
     def load(self, name: str, path: str, *, warm: bool = True,
              executable_cache: str | None = "auto",
              **executor_opts: Any) -> EnsembleExecutor:
         """Register-or-swap ``name`` from a checkpoint directory saved
-        with ``estimator.save()`` / ``utils/checkpoint.save_model`` —
-        the hand-off seam from a retraining job. ``executor_opts``
-        apply either way: on an existing name they ride the swap
-        (committed to the entry's sticky options only on success).
+        with :meth:`save` (or ``estimator.save()`` /
+        ``utils/checkpoint.save_model``) — the hand-off seam from a
+        retraining job AND between peer serving processes.
+        ``executor_opts`` apply either way: on an existing name they
+        ride the swap (committed to the entry's sticky options only on
+        success).
+
+        When the directory carries a ``serve_config.json`` manifest
+        (:meth:`save` writes one), its executor configuration — bucket
+        bounds, donation, serving-mesh shape — is adopted underneath
+        any caller-explicit options, and its VERSION is adopted
+        outright: M peer processes loading the same checkpoint all
+        serve the same version number, a re-load of the already-live
+        version is an idempotent no-op, and a load of an OLDER
+        manifest than the live version is rejected loudly (a rolling
+        swap must only ever move forward; rollbacks re-register under
+        a new name or ship a newer manifest).
 
         Executables ride alongside weights: ``executable_cache="auto"``
         (default) hydrates from ``<path>/serving_aot`` when
         :meth:`save` left one there — a fresh serving process reaches
         zero-recompile steady state at startup instead of after
-        warmup. A key mismatch (different model, ladder, jax version,
-        backend) silently falls back to lowering. Pass ``None`` to
-        skip, or an explicit directory to use a cache kept elsewhere.
+        warmup. A key mismatch (different model, ladder, mesh shape,
+        jax version, backend) silently falls back to lowering. Pass
+        ``None`` to skip, or an explicit directory to use a cache kept
+        elsewhere.
         """
         from spark_bagging_tpu.utils.checkpoint import load_model
 
+        cfg = self._read_serve_config(path)
+        version: int | None = None
+        if cfg is not None:
+            v = cfg.get("version")
+            if isinstance(v, int) and v >= 1:
+                version = v
+            executor_opts = self._opts_from_config(cfg, executor_opts)
+        with self._lock:
+            entry = self._entries.get(name)
+            live_version = entry.version if entry is not None else None
+            live_executor = entry.executor if entry is not None else None
+        if (
+            version is not None
+            and live_version is not None
+            and version == live_version
+        ):
+            # idempotent convergence: a peer polling the checkpoint
+            # dir re-loads the version it already serves — a no-op,
+            # not an error (and not a spurious version bump)
+            return live_executor
         model = load_model(path)
         if executable_cache == "auto":
             auto = os.path.join(path, self.AOT_SUBDIR)
             executable_cache = auto if os.path.isdir(auto) else None
-        with self._lock:
-            exists = name in self._entries
-        if not exists:
+        if live_version is None:
             try:
                 return self.register(name, model, warmup=warm,
                                      executable_cache=executable_cache,
+                                     version=version,
                                      **executor_opts)
             except ValueError:
                 # register-or-swap must be race-safe: another load()
@@ -340,8 +502,16 @@ class ModelRegistry:
                 with self._lock:
                     if name not in self._entries:
                         raise
+        # _equal_version_ok: two peers racing to install the same
+        # manifest version must CONVERGE (the loser gets the winner's
+        # executor back), not crash with a spurious stale-swap
+        # incident — including the register-race fallthrough above,
+        # where the loser arrives here carrying the same version the
+        # winner just installed
         return self.swap(name, model, warm=warm,
                          executable_cache=executable_cache,
+                         version=version,
+                         _equal_version_ok=version is not None,
                          **executor_opts)
 
     def save(self, name: str, path: str, *, compress: bool | str = "auto",
@@ -352,13 +522,46 @@ class ModelRegistry:
         fresh process warm-starts without a single compile. The
         executable pass is best-effort: an executor with nothing
         compiled yet, or a backend without executable serialization,
-        saves weights only."""
+        saves weights only.
+
+        A ``serve_config.json`` manifest is always written: the
+        version + executor configuration a peer process's :meth:`load`
+        adopts (see there for the rolling-swap rules). Donation is
+        persisted as the entry's CONFIGURED value, not the resolved
+        boolean — a checkpoint saved on CPU must not pin donation off
+        for the TPU peer that loads it."""
+        import json
+
         from spark_bagging_tpu.utils.checkpoint import save_model
 
-        ex = self._entry(name).executor
+        entry = self._entry(name)
+        with self._lock:
+            ex = entry.executor
+            version = entry.version
+            donate_opt = entry.opts.get("donate_input")
         save_model(ex.model, path, compress=compress)
         if executables and ex.compiled_buckets:
             ex.save_executables(os.path.join(path, self.AOT_SUBDIR))
+        cfg = {
+            "format": 1,
+            "name": name,
+            "version": version,
+            "task": ex.task,
+            "n_features": ex.n_features,
+            "executor": {
+                "min_bucket_rows": ex.min_bucket_rows,
+                "max_batch_rows": ex.max_batch_rows,
+                "donate_input": donate_opt,
+                "mesh": (list(ex.mesh_shape)
+                         if ex.mesh_shape is not None else None),
+            },
+            "warm_buckets": [int(b) for b in ex.compiled_buckets],
+            "quality": entry.quality_opts is not None,
+        }
+        tmp = os.path.join(path, f"{self.SERVE_CONFIG}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(cfg, f, indent=2)
+        os.replace(tmp, os.path.join(path, self.SERVE_CONFIG))
 
     def batcher(self, name: str, **batcher_opts: Any):
         """A micro-batcher bound to THIS registry entry by name: each

@@ -70,6 +70,12 @@ SERIES_HELP: dict[str, str] = {
     "sbt_serving_latency_seconds": "Request latency submit-to-result (histogram; optional path label: direct/coalesced)",
     "sbt_serving_direct_dispatch_total": "Requests served inline by adaptive direct dispatch (idle fast path)",
     "sbt_serving_coalesced_total": "Requests served via the coalescing worker path",
+    "sbt_serving_shard_forwards_total": "Slab forwards executed by the replica-sharded (mesh) serving program",
+    "sbt_serving_shard_devices": "Replica-axis size of the serving mesh (gauge, set at sharded-executor construction)",
+    "sbt_program_cache_hits_total": "Unified compiled-program cache hits (a compile someone already paid, reused)",
+    "sbt_program_cache_misses_total": "Unified compiled-program cache lookups that found nothing",
+    "sbt_program_cache_evictions_total": "Programs LRU-evicted from the unified compiled-program cache",
+    "sbt_program_cache_entries": "Programs resident in the unified compiled-program cache (gauge)",
     "sbt_serving_aot_saved_total": "Compiled bucket executables persisted to an AOT cache",
     "sbt_serving_aot_restored_total": "Bucket executables hydrated from a persisted AOT cache (no compile)",
     "sbt_serving_aot_misses_total": "AOT cache lookups that fell back to lowering (absent/key-mismatched/unreadable)",

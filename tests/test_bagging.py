@@ -636,6 +636,7 @@ def test_warm_start_cannot_extend_via_fit_stream(breast_cancer):
         clf.fit_stream(ArrayChunks(X, y, 128))
 
 
+@pytest.mark.slow  # ~9s: extreme-edge ensemble (all-zero draws) fits a big bag
 def test_all_zero_bootstrap_draws_stay_finite(breast_cancer):
     """max_samples small enough that some replicas draw all-zero
     Poisson weights: predictions must stay finite for every learner

@@ -253,6 +253,7 @@ class TestAnalyzeTune:
 
 
 class TestDeviceLock:
+    @pytest.mark.slow  # ~11s: spawns a real holder process + lock deadline
     def test_serializes_across_processes(self, tmp_path, monkeypatch):
         """Two benchmark parents must not drive the chip concurrently:
         acquire fails within its deadline while another process holds

@@ -22,13 +22,27 @@ from sklearn.preprocessing import StandardScaler
 
 from spark_bagging_tpu import BaggingClassifier
 from spark_bagging_tpu.parallel import make_mesh
-from spark_bagging_tpu.parallel.compat import HAS_SHARD_MAP
-
-pytestmark = pytest.mark.skipif(
-    not HAS_SHARD_MAP,
-    reason="this jax build has no shard_map implementation "
-           "(parallel/compat.py)",
+from spark_bagging_tpu.parallel.compat import (
+    HAS_MULTIPROCESS_CPU,
+    HAS_SHARD_MAP,
+    MULTIPROCESS_CPU_REASON,
 )
+
+pytestmark = [
+    pytest.mark.skipif(
+        not HAS_SHARD_MAP,
+        reason="this jax build has no shard_map implementation "
+               "(parallel/compat.py)",
+    ),
+    # the workers below stand a 2-process CPU Gloo pod in for a TPU
+    # pod; on jax builds whose CPU backend cannot run multi-process
+    # computations the capability sentinel turns what used to be 7
+    # fixture-time XlaRuntimeError walls into skips with this reason
+    pytest.mark.skipif(
+        not HAS_MULTIPROCESS_CPU,
+        reason=MULTIPROCESS_CPU_REASON,
+    ),
+]
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multihost_worker.py")

@@ -5,6 +5,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from spark_bagging_tpu.utils.profiling import log_timing, named_scope, trace
 
@@ -29,6 +30,7 @@ def test_named_scope_traces():
     assert "sine" in lowered or "sin" in lowered
 
 
+@pytest.mark.slow  # ~9s: spins the real XLA profiler; artifact-only coverage
 def test_trace_writes_profile(tmp_path):
     d = str(tmp_path / "prof")
     with trace(d):

@@ -29,7 +29,11 @@ from spark_bagging_tpu.telemetry.quality import (
     ks_stat,
     psi,
 )
-from spark_bagging_tpu.serving import EnsembleExecutor, ModelRegistry
+from spark_bagging_tpu.serving import (
+    EnsembleExecutor,
+    ModelRegistry,
+    program_cache,
+)
 from spark_bagging_tpu.serving.batcher import MicroBatcher
 
 
@@ -336,6 +340,10 @@ class TestExecutorTap:
         counter — the zero-post-warmup-compile gate is about the
         serving path."""
         X, _ = data
+        # compile-count test: drop unified-cache entries earlier tests
+        # compiled for this model, so real compiles happen and land in
+        # the right counter
+        program_cache.clear()
         ex = fresh_executor(clf)
         reg = telemetry.registry()
         before = reg.counter("sbt_serving_compiles_total").value
@@ -526,15 +534,20 @@ class TestExecutorTap:
         on the serving thread: attach pre-builds the per-replica
         executables for every already-compiled serving bucket."""
         X, _ = data
+        # compile-count test: see test_tap_compiles_count_separately
+        program_cache.clear()
         ex = fresh_executor(clf)  # serving ladder 8/16/32 compiled
         reg_t = telemetry.registry()
+        c0 = reg_t.counter(
+            "sbt_quality_disagreement_compiles_total").value
         quality.attach(ex, refresh_every=1, disagreement_every=1)
         prewarmed = reg_t.counter(
-            "sbt_quality_disagreement_compiles_total").value
+            "sbt_quality_disagreement_compiles_total").value - c0
         assert prewarmed == len(ex.compiled_buckets)
         ex.forward(X[:20])  # sampled batch: executable already live
         assert reg_t.counter(
-            "sbt_quality_disagreement_compiles_total").value == prewarmed
+            "sbt_quality_disagreement_compiles_total"
+        ).value - c0 == prewarmed
 
     def test_registry_enable_quality_sticky_across_swap(
             self, clf, data, tmp_path):

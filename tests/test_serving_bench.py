@@ -72,3 +72,49 @@ def test_serving_latency_smoke(tmp_path):
     names = {m["name"] for m in snap}
     assert "sbt_serving_requests_total" in names
     assert "sbt_serving_latency_seconds" in names
+
+
+def test_serving_sharded_bench_smoke(tmp_path):
+    """ISSUE 10 acceptance: ``--devices 8`` (forced-host-device CPU)
+    serves the oversized bag through the replica-sharded executor with
+    BITWISE parity and zero post-warmup compiles — asserted HARD. The
+    >= 1.5x throughput band is asserted via the CLI's own gate (exit
+    0) on hosts with the cores to express device parallelism; on
+    core-starved CI hosts N virtual devices share one physical core
+    and the band is unreachable BY CONSTRUCTION — the CLI reports that
+    as the distinct exit 3, tolerated here exactly like the PR 7
+    replay gate tolerates host-performance bands while holding the
+    host-independent invariants."""
+    out = str(tmp_path / "BENCH_serving_sharded.json")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "serving_latency.py"),
+            "--smoke", "--devices", "8", "--repeats", "3",
+            "--out", out,
+        ],
+        capture_output=True, text=True, timeout=420,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    assert proc.returncode in (0, 3), (
+        f"sharded bench invariant failure:\n{proc.stdout[-2000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )
+    result = json.loads(open(out).read())
+    assert result["backend"] == "cpu"
+    assert result["devices"] == 8
+    # host-independent invariants, asserted hard:
+    assert result["parity_bitwise"] is True, (
+        "sharded output must be bitwise-identical to single-device"
+    )
+    assert result["compiles_post_warmup"] == 0
+    assert result["shard_forwards"] > 0  # the mesh path actually ran
+    # the throughput band: only reachable with real core headroom
+    if proc.returncode == 3:
+        assert (os.cpu_count() or 1) < result["devices"], (
+            f"sharded speedup {result['speedup']}x < 1.5x despite "
+            f"{os.cpu_count()} host cores for {result['devices']} "
+            "devices — a real regression, not core starvation"
+        )
+    else:
+        assert result["speedup"] >= 1.5

@@ -31,6 +31,7 @@ from spark_bagging_tpu.serving import (
     ModelRegistry,
     pack_plan,
 )
+from spark_bagging_tpu.serving import program_cache
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -362,9 +363,17 @@ def test_executable_cache_key_mismatch_falls_back(clf, data, tmp_path):
 
     m0 = _counter("sbt_serving_aot_misses_total")
     c0 = _counter("sbt_serving_compiles_total")
-    other = ModelRegistry(min_bucket_rows=8, max_batch_rows=128)
+    # simulate the fresh process the disk cache exists for: the
+    # in-process unified program cache would otherwise hand the
+    # executables over without lowering (its job — tested elsewhere)
+    program_cache.clear()
+    other = ModelRegistry()
+    # the serve_config manifest would hand the peer the saver's ladder
+    # (the zero-config path); an EXPLICIT caller override beats it —
+    # and changes the cache key, so the disk executables must be
+    # ignored with a warning and a counted miss
     with pytest.warns(UserWarning, match="different key"):
-        ex = other.load("m", ckpt, warm=True)
+        ex = other.load("m", ckpt, warm=True, max_batch_rows=128)
     assert _counter("sbt_serving_aot_misses_total") > m0
     # fell back to lowering the (8..128) ladder
     assert _counter("sbt_serving_compiles_total") - c0 == 5
@@ -419,6 +428,8 @@ def test_registry_save_without_executables(clf, tmp_path):
     assert not os.path.isdir(os.path.join(ckpt, "serving_aot"))
     fresh = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
     c0 = _counter("sbt_serving_compiles_total")
+    # a genuinely fresh process has no unified program cache either
+    program_cache.clear()
     fresh.load("m", ckpt, warm=True)
     assert _counter("sbt_serving_compiles_total") - c0 == 4
 

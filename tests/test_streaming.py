@@ -264,6 +264,8 @@ def test_stream_mlp(cancer):
     assert sclf.score(X, y) > 0.9
 
 
+@pytest.mark.slow  # ~12s: single-chunk==in-memory parity; the multi-chunk
+# accuracy + determinism tests keep the stream path covered in tier-1
 def test_stream_tree_single_chunk_matches_inmemory_exactly(cancer):
     """With one chunk covering all rows the streamed tree fit must be
     bit-identical to an in-memory fit on the regenerated chunk weights
@@ -352,6 +354,7 @@ def test_stream_tree_regressor():
     assert stream.score(X, y) == pytest.approx(mem.score(X, y), abs=0.05)
 
 
+@pytest.mark.slow  # ~12s: subspace draw coverage rides the faster tree tests
 def test_stream_tree_with_subspaces(cancer):
     X, y = cancer
     clf = BaggingClassifier(
@@ -830,6 +833,7 @@ def test_tree_stream_replica_mesh_matches_unsharded(cancer):
     )
 
 
+@pytest.mark.slow  # ~9s: mesh stream covered by the replica-mesh parity test
 def test_tree_stream_data_mesh_accuracy(cancer):
     """Data-sharded streamed trees: per-shard draws differ (documented),
     accuracy must match statistically; chunk_rows must divide."""

@@ -316,6 +316,8 @@ def test_feature_importances_find_informative_features():
     assert imp_s[3] + imp_s[7] > 0.6
 
 
+@pytest.mark.slow  # ~9s: stream-fit importances; the in-memory importance
+# tests keep the mapping covered in tier-1
 def test_feature_importances_regressor_and_stream():
     from spark_bagging_tpu import ArrayChunks, BaggingRegressor
     from spark_bagging_tpu.models import DecisionTreeRegressor
