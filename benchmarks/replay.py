@@ -36,7 +36,15 @@ backpressure drill — sheds are counted, never fatal), and
 replay (swap-under-fire drill; the swapped-in model is the same
 fitted estimator, so outputs stay bitwise-identical while the full
 swap machinery — validation, bucket pre-compile, version bump —
-exercises under live traffic). ``--drift`` is the model-quality
+exercises under live traffic). ``--chaos <plan>`` arms a seeded
+:mod:`spark_bagging_tpu.faults` plan (builtin name or JSON path) over
+the drive: transient forward faults retry with the bounded backoff
+policy, poisoned requests bisect down to failing alone, injected
+shard losses degrade a mesh executor to the surviving-replica
+aggregate — and the whole fault/retry/shed/degraded transcript, plus
+the output and composition digests, is asserted IDENTICAL across
+``replay_median`` repeats (a chaos experiment is a pure function of
+``(workload, seed, plan)``). ``--drift`` is the model-quality
 plane's scripted incident: payloads for arrivals after ``--drift-at``
 come from a covariate-shifted twin of the seeded pool, a quality
 monitor (``telemetry/quality.py``) sketches the stream against the
@@ -240,6 +248,9 @@ def replay(
     burst_at: float = 0.5,
     burst_rows: int = 1,
     swaps: int = 0,
+    chaos: dict | None = None,
+    retries: int = 0,
+    retry_backoff_ms: float = 0.0,
     drift: bool = False,
     drift_at: float = 0.5,
     drift_shift: float = 4.0,
@@ -295,6 +306,21 @@ def replay(
               else executor)
     ex_provider = ((lambda: registry.executor(model_name))
                    if registry is not None else executor)
+
+    # -- chaos scenario: a seeded fault plan spliced into the replay --
+    plan = None
+    if chaos is not None:
+        from spark_bagging_tpu import faults as faults_mod
+
+        # a FRESH plan per run: hit counters start at zero, so every
+        # repeat injects the identical schedule (the determinism
+        # contract extends to the fault transcript)
+        spec = chaos if isinstance(chaos, dict) else chaos.to_dict()
+        plan = faults_mod.FaultPlan.from_dict(spec)
+        if hasattr(target, "reset_degraded"):
+            # heal any degradation a previous repeat's shard-loss
+            # faults caused: each run must start from the same state
+            target.reset_degraded()
     payload = _payloads(workload, target.n_features, seed,
                         drift_shift=drift_shift if drift else 0.0,
                         drift_scale=drift_scale if drift else 1.0)
@@ -387,7 +413,36 @@ def replay(
         max_batch_rows=max_batch_rows,
         max_queue=max_queue,
         threaded=(mode == "timed"),
+        retries=retries,
+        retry_backoff_ms=retry_backoff_ms,
     )
+    shed_reasons = ("overload", "deadline", "degraded")
+
+    def shed_counts() -> dict[str, float]:
+        return {
+            r: reg_counters.counter("sbt_serving_shed_total",
+                                    labels={"reason": r}).value
+            for r in shed_reasons
+        }
+
+    chaos_c0 = {
+        name: counter(name)
+        for name in (
+            "sbt_serving_retries_total",
+            "sbt_serving_batch_bisects_total",
+            "sbt_serving_request_failures_total",
+            "sbt_serving_degraded_forwards_total",
+        )
+    }
+    shed0 = shed_counts()
+    if plan is not None:
+        # armed AFTER warmup/batcher setup: compile-time cache inserts
+        # differ between a cold first repeat and warm later ones, and
+        # letting them advance the plan's hit counters would make the
+        # fault schedule depend on cache state instead of the workload
+        from spark_bagging_tpu import faults as faults_mod
+
+        faults_mod.arm(plan)
     t_wall0 = time.perf_counter()
     try:
         if mode == "virtual":
@@ -444,6 +499,10 @@ def replay(
                     pass
         wall = time.perf_counter() - t_wall0
     finally:
+        if plan is not None:
+            from spark_bagging_tpu import faults as faults_mod
+
+            faults_mod.disarm()
         batcher.close()
         if flight is not None:
             flight.disarm()
@@ -539,6 +598,36 @@ def replay(
 
     live = (registry.executor(model_name) if registry is not None
             else executor)
+
+    chaos_report = None
+    if plan is not None:
+        shed1 = shed_counts()
+        chaos_report = {
+            "plan": plan.name,
+            "seed": plan.seed,
+            "plan_digest": plan.digest(),
+            # the deterministic fault transcript: hits and fires per
+            # site, asserted IDENTICAL across replay_median repeats
+            "sites": plan.snapshot(),
+            "retries": int(counter("sbt_serving_retries_total")
+                           - chaos_c0["sbt_serving_retries_total"]),
+            "bisects": int(
+                counter("sbt_serving_batch_bisects_total")
+                - chaos_c0["sbt_serving_batch_bisects_total"]
+            ),
+            "request_failures": int(
+                counter("sbt_serving_request_failures_total")
+                - chaos_c0["sbt_serving_request_failures_total"]
+            ),
+            "degraded_forwards": int(
+                counter("sbt_serving_degraded_forwards_total")
+                - chaos_c0["sbt_serving_degraded_forwards_total"]
+            ),
+            "shed": {r: int(shed1[r] - shed0[r]) for r in shed_reasons},
+            "degraded": bool(getattr(live, "degraded", False)),
+            "surviving_replicas": getattr(live, "surviving_replicas",
+                                          None),
+        }
     return {
         "metric": "workload_replay",
         "schema": REPLAY_SCHEMA_VERSION,
@@ -589,6 +678,7 @@ def replay(
         "composition_digest": comp_h.hexdigest(),
         "output_digest": out_h.hexdigest(),
         "drift": drift_report,
+        "chaos": chaos_report,
     }
 
 
@@ -613,12 +703,27 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
         for r in runs[1:]:
             for key in ("composition_digest", "output_digest",
                         "post_warmup_compiles", "served", "overloads",
-                        "batches"):
+                        "errors", "batches"):
                 if r[key] != head[key]:
                     raise AssertionError(
                         f"determinism violation across repeats: {key} "
                         f"changed ({head[key]!r} -> {r[key]!r})"
                     )
+            if head.get("chaos") is not None:
+                # the fault transcript is part of the determinism
+                # contract: same plan + same workload + same seed must
+                # inject, retry, shed, and degrade IDENTICALLY
+                for key in ("plan_digest", "sites", "retries",
+                            "bisects", "request_failures",
+                            "degraded_forwards", "shed", "degraded",
+                            "surviving_replicas"):
+                    if r["chaos"][key] != head["chaos"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"chaos.{key} changed "
+                            f"({head['chaos'][key]!r} -> "
+                            f"{r['chaos'][key]!r})"
+                        )
             if head.get("drift") is not None:
                 # drift scores are float-for-float reproducible and
                 # the alert transcript is part of the contract
@@ -757,6 +862,21 @@ def main(argv: list[str] | None = None) -> int:
     drv.add_argument("--burst-at", type=float, default=0.5)
     drv.add_argument("--swaps", type=int, default=0,
                      help="hot-swap the model N times mid-replay")
+    drv.add_argument("--chaos", default=None,
+                     help="splice a seeded fault schedule into the "
+                          "replay: a builtin plan name (blips, "
+                          "poison, mixed, shard-loss, worker-crash, "
+                          "crash-loop) or a plan JSON path — "
+                          "fault/retry/shed/degraded counts and "
+                          "output digests are asserted identical "
+                          "across repeats")
+    drv.add_argument("--retries", type=int, default=None,
+                     help="bounded retry budget for transient forward "
+                          "failures (default: 2 with --chaos, else 0)")
+    drv.add_argument("--retry-backoff-ms", type=float, default=0.0,
+                     help="base backoff between retry attempts "
+                          "(0 in replay: the virtual clock must not "
+                          "sleep)")
     drv.add_argument("--drift", action="store_true",
                      help="splice a seeded covariate-shifted payload "
                           "segment in at --drift-at; attaches a "
@@ -843,6 +963,45 @@ def main(argv: list[str] | None = None) -> int:
     from spark_bagging_tpu.telemetry import workload as workload_mod
     from spark_bagging_tpu.serving import ModelRegistry
 
+    chaos_spec = None
+    if args.chaos:
+        if args.drift:
+            ap.error("--chaos and --drift are separate scripted "
+                     "scenarios; run them as two replays")
+        from spark_bagging_tpu import faults as faults_mod
+
+        try:
+            if os.path.isfile(args.chaos):
+                with open(args.chaos) as f:
+                    chaos_spec = json.load(f)
+                # validate the plan grammar up front (unknown sites
+                # and actions must fail the CLI, not mid-replay)
+                faults_mod.FaultPlan.from_dict(chaos_spec)
+            else:
+                chaos_spec = faults_mod.builtin_plan_spec(
+                    args.chaos, seed=args.seed
+                )
+        except ValueError as e:
+            ap.error(str(e))
+        if args.mode == "virtual":
+            sites = {f.get("site") for f in chaos_spec.get("faults", ())}
+            if sites <= {"batcher.worker"}:
+                # virtual mode runs a stepped batcher: no worker
+                # thread exists, so a worker-only plan would arm, fire
+                # nothing, and exit 0 — a chaos suite passing while
+                # testing nothing is exactly what this module rejects
+                # loudly everywhere else
+                ap.error(
+                    f"--chaos {args.chaos!r} only arms batcher.worker,"
+                    " which never fires in --mode virtual (stepped"
+                    " batchers run no worker thread): use --mode timed"
+                    " for worker-crash drills, or a plan that also"
+                    " arms forward/submit sites"
+                )
+    retries = args.retries
+    if retries is None:
+        retries = 2 if chaos_spec is not None else 0
+
     if args.workload:
         wl = workload_mod.load_workload(args.workload)
         width = next(
@@ -895,6 +1054,8 @@ def main(argv: list[str] | None = None) -> int:
         wl, repeats=args.repeats, **target,
         mode=args.mode, speed=args.speed,
         burst=args.burst, burst_at=args.burst_at, swaps=args.swaps,
+        chaos=chaos_spec, retries=retries,
+        retry_backoff_ms=args.retry_backoff_ms,
         drift=args.drift, drift_at=args.drift_at,
         drift_shift=args.drift_shift, drift_scale=args.drift_scale,
         psi_threshold=args.psi_threshold,
@@ -928,6 +1089,18 @@ def main(argv: list[str] | None = None) -> int:
             "post_warmup_compiles", "rps", "latency_ms", "swaps",
         )
     }
+    if report.get("chaos") is not None:
+        c = report["chaos"]
+        summary["chaos"] = {
+            "plan": c["plan"],
+            "injected": c["sites"]["fired_total"],
+            "retries": c["retries"],
+            "bisects": c["bisects"],
+            "request_failures": c["request_failures"],
+            "shed": c["shed"],
+            "degraded": c["degraded"],
+            "errors": report["errors"],
+        }
     if report.get("drift") is not None:
         d = report["drift"]
         summary["drift"] = {

@@ -12,6 +12,7 @@ from spark_bagging_tpu.analysis.rules import (  # noqa: F401
     hotpath,
     prng,
     recompile,
+    resilience,
     threads,
     tracer,
 )

@@ -181,6 +181,56 @@ def replica_sharded_serving(model: Any, mesh: Mesh):
     return fwd, replica_fwd, params, subspaces, x_sharding, replica
 
 
+def replica_subset_serving(model: Any, survivors):
+    """Degraded-quorum serving forward: the aggregate over a SUBSET of
+    replicas, compiled single-device — what a mesh serving executor
+    falls back to when a shard fails.
+
+    Bagging makes this principled rather than lossy: an aggregate over
+    any subset of independently bootstrapped replicas is itself a
+    valid bagged estimate of the same target (*A Scalable Bootstrap
+    for Massive Data*, arxiv 1112.5016; *On the asymptotic properties
+    of a bagging estimator with a massive dataset*, arxiv 2304.06278)
+    — the ensemble structure IS the degradation mechanism, not a
+    retry. The construction mirrors the mesh program's
+    gather-then-reduce exactly: the per-replica forward produces the
+    same-shaped ``(R_surv, n, ...)`` array a fresh subset recompute
+    would, and the ``sum(axis=0) / R_surv`` reduction runs over it in
+    replica order — so the degraded served output is BITWISE-equal to
+    recomputing the surviving-subset aggregate offline (the parity
+    contract tests/test_faults.py asserts).
+
+    Returns ``(fwd, replica_fwd, params, subspaces)``: the aggregated
+    subset forward, its aggregation-free twin (the disagreement-tap
+    seam over survivors), and the params/subspaces already restricted
+    to ``survivors`` (sorted replica indices into the full ensemble).
+    """
+    import numpy as np
+
+    rep_fn, params, subspaces = model.replica_forward()
+    surv = np.asarray(sorted(int(i) for i in survivors), dtype=np.int32)
+    if surv.size == 0:
+        raise ValueError("need at least one surviving replica")
+    if surv.size and (surv[0] < 0 or surv[-1] >= subspaces.shape[0]):
+        raise ValueError(
+            f"survivor indices must be in [0, {subspaces.shape[0]}), "
+            f"got {surv[0]}..{surv[-1]}"
+        )
+    n_surv = int(surv.size)
+    idx = jnp.asarray(surv)
+
+    def _take(a):
+        return jnp.take(jnp.asarray(a), idx, axis=0)
+
+    params = jax.tree_util.tree_map(_take, params)
+    subspaces = _take(subspaces)
+
+    def fwd(p, s, Xs):
+        return jnp.sum(rep_fn(p, s, Xs), axis=0) / n_surv
+
+    return fwd, rep_fn, params, subspaces
+
+
 def sharded_fit(
     learner: BaseLearner,
     mesh: Mesh,

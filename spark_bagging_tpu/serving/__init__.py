@@ -36,6 +36,15 @@ serving path on top of the fitted estimators:
   the ensemble's replica axis across a ``(1, N)`` device mesh and
   serves outputs bitwise-identical to the single-device path (see
   ARCHITECTURE.md → Distributed serving).
+- Fault tolerance end to end (see ARCHITECTURE.md → Fault tolerance):
+  per-request deadlines (:class:`DeadlineExceeded`), bounded
+  retry-with-backoff for transient forward failures, bisect-on-poison
+  batch isolation, a supervised worker with crash-loop degraded
+  reject mode (:class:`Degraded`, ``revive()``), rollback-safe
+  ``swap()`` / torn-write-safe ``save()``, and degraded-quorum mesh
+  serving (a failed shard drops out; the surviving-replica aggregate
+  serves with ``degraded=true``) — all drillable deterministically
+  via ``spark_bagging_tpu.faults`` and ``replay.py --chaos``.
 
 Telemetry rides the PR-1 registry end to end: ``sbt_serving_*``
 counters/gauges/histograms (requests, rows, batches, queue depth,
@@ -58,7 +67,12 @@ Typical use::
     batcher.close()
 """
 
-from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
+from spark_bagging_tpu.serving.batcher import (
+    DeadlineExceeded,
+    Degraded,
+    MicroBatcher,
+    Overloaded,
+)
 from spark_bagging_tpu.serving.buckets import (
     bucket_for,
     bucket_ladder,
@@ -70,6 +84,8 @@ from spark_bagging_tpu.serving.executor import EnsembleExecutor
 from spark_bagging_tpu.serving.registry import ModelRegistry
 
 __all__ = [
+    "DeadlineExceeded",
+    "Degraded",
     "EnsembleExecutor",
     "MicroBatcher",
     "ModelRegistry",

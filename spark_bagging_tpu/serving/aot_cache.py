@@ -86,6 +86,7 @@ def cache_key(executor: Any) -> dict[str, Any]:
     import jax
 
     mesh_shape = getattr(executor, "mesh_shape", None)
+    failed = sorted(getattr(executor, "_failed_shards", ()) or ())
     devices = jax.devices()
     return {
         "format": FORMAT_VERSION,
@@ -94,6 +95,10 @@ def cache_key(executor: Any) -> dict[str, Any]:
         "n_devices": jax.device_count(),
         "device_kind": str(devices[0].device_kind) if devices else "unknown",
         "mesh": list(mesh_shape) if mesh_shape is not None else None,
+        # a degraded (surviving-subset) executor's programs are the
+        # WRONG program for a healthy executor and vice versa — the
+        # failed-shard set is part of the program identity
+        "degraded": [int(s) for s in failed] or None,
         "ladder": [int(executor.min_bucket_rows),
                    int(executor.max_batch_rows)],
         "donate": bool(executor._donate),
@@ -141,6 +146,12 @@ def save_executables(executor: Any, path: str) -> tuple[int, ...]:
     with open(os.path.join(tmp, MANIFEST), "w") as f:
         json.dump({"key": cache_key(executor), "buckets": saved}, f,
                   indent=2)
+    from spark_bagging_tpu import faults
+
+    if faults.ACTIVE is not None:
+        # torn-write drill: a kill HERE leaves only the tmp dir — no
+        # cache is installed, a later restore is a counted miss
+        faults.fire("aot.save")
     if os.path.exists(path):
         shutil.rmtree(path)
     os.replace(tmp, path)

@@ -32,11 +32,34 @@ Contracts that matter under load:
 
 - **Backpressure is explicit.** ``submit`` never blocks: a full queue
   raises :class:`Overloaded` immediately (and counts
-  ``sbt_serving_overloaded_total``) so callers shed load at the edge
-  instead of silently queueing into timeout territory.
-- **Failure is per-batch, not fatal.** An executor exception fails
-  exactly the futures of the batch that hit it; the worker keeps
-  serving.
+  ``sbt_serving_overloaded_total`` plus
+  ``sbt_serving_shed_total{reason="overload"}``) so callers shed load
+  at the edge instead of silently queueing into timeout territory.
+- **Deadlines shed distinctly.** ``submit(X, deadline_ms=...)`` stamps
+  a per-request deadline; a request still queued when its batch is
+  claimed past the deadline fails with :class:`DeadlineExceeded`
+  (``sbt_serving_shed_total{reason="deadline"}``) — "too slow" is a
+  different incident than "too full", and the shed accounting keeps
+  them apart.
+- **Failure is per-request, not fatal.** An executor exception fails
+  at most the requests that caused it: transient failures (anything
+  raised with ``transient=True``, e.g. ``faults.TransientFault``)
+  retry with bounded exponential backoff (``retries=``,
+  ``sbt_serving_retries_total``), and a batch that still fails
+  **bisects** — each half is served independently, recursively, until
+  the one poisoned request fails alone
+  (``sbt_serving_batch_bisects_total``) while its batch-mates are
+  served normally. The worker keeps serving through all of it.
+- **The worker is supervised.** A crash that escapes the per-batch
+  guard (a wedged sink, an injected fault) is caught by the
+  supervisor: the crash is counted + flight-recorded and a fresh
+  worker thread takes over (``sbt_serving_worker_restarts_total``).
+  ``crash_loop_threshold`` crashes inside ``crash_loop_window_s``
+  instead trip **degraded reject mode**: one ``serving_crash_loop``
+  flight dump, ``/healthz`` 503, and every further ``submit()`` shed
+  with :class:`Degraded`
+  (``sbt_serving_shed_total{reason="degraded"}``) until an operator
+  calls :meth:`MicroBatcher.revive`.
 - **Hot-swap-safe.** The executor is resolved from a provider ONCE per
   micro-batch, so a registry ``swap()`` takes effect at the next batch
   boundary while requests already forwarded finish on the executor
@@ -69,13 +92,14 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from queue import Empty, Full, Queue
 from typing import Any, Callable
 
 import numpy as np
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.serving.buckets import bucket_for, pack_plan
 from spark_bagging_tpu.telemetry import tracing
@@ -92,16 +116,48 @@ class Overloaded(RuntimeError):
     """
 
 
+class DeadlineExceeded(RuntimeError):
+    """A request's ``deadline_ms`` expired while it waited in queue —
+    shed as "too slow", distinct from :class:`Overloaded`'s "too full"
+    (separate ``sbt_serving_shed_total{reason=}`` labels and event
+    kinds)."""
+
+
+class Degraded(RuntimeError):
+    """The batcher is in degraded reject mode: its worker crash-looped
+    (``crash_loop_threshold`` crashes inside ``crash_loop_window_s``)
+    and requests are shed at the edge until an operator calls
+    :meth:`MicroBatcher.revive` after remediation."""
+
+
+class _Failed:
+    """Per-request failure sentinel inside a served batch's outputs —
+    how retry/bisect recovery reports 'this one request failed' without
+    failing its batch-mates."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 class _Request:
-    __slots__ = ("X", "n", "mode", "future", "t_submit", "trace")
+    __slots__ = ("X", "n", "mode", "future", "t_submit", "trace",
+                 "deadline_t", "poisoned")
 
     def __init__(self, X: np.ndarray, mode: str,
-                 trace: "tracing.TraceContext | None"):
+                 trace: "tracing.TraceContext | None",
+                 deadline_t: float | None = None):
         self.X = X
         self.n = X.shape[0]
         self.mode = mode
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # absolute deadline on the batcher's clock (None: no deadline)
+        self.deadline_t = deadline_t
+        # set by an armed fault plan (chaos experiments only): this
+        # request's forward fails until bisection isolates it
+        self.poisoned = False
         # per-request trace context (None when telemetry is disabled);
         # mirrored onto the future so callers can read
         # `future.trace.breakdown` after the result resolves
@@ -147,6 +203,20 @@ class MicroBatcher:
     ``threaded=False`` is stepped mode: no worker thread runs, and the
     owner serves queued requests synchronously via :meth:`run_pending`
     (the deterministic-replay seam — see ``benchmarks/replay.py``).
+
+    Robustness knobs: ``retries`` bounds how many times a TRANSIENT
+    forward failure (``transient=True`` on the exception, e.g.
+    ``faults.TransientFault``) is retried, with
+    ``retry_backoff_ms``-based exponential backoff between attempts;
+    ``bisect_on_error`` (default on) splits a persistently failing
+    multi-request batch in half and serves each half independently so
+    one poisoned request fails alone. ``supervise`` (default on, with
+    ``crash_loop_threshold`` / ``crash_loop_window_s``) restarts a
+    crashed worker thread and trips degraded reject mode on a crash
+    loop. ``clock`` overrides the monotonic clock used for DEADLINE
+    math only (the replay harness injects its virtual clock there so
+    deadline sheds are deterministic); latency timing always uses the
+    real clock.
     """
 
     def __init__(
@@ -159,6 +229,13 @@ class MicroBatcher:
         idle_flush_ms: float = 0.25,
         threaded: bool = True,
         direct_dispatch: bool | None = None,
+        retries: int = 0,
+        retry_backoff_ms: float = 5.0,
+        bisect_on_error: bool = True,
+        supervise: bool = True,
+        crash_loop_threshold: int = 3,
+        crash_loop_window_s: float = 30.0,
+        clock: Callable[[], float] | None = None,
     ):
         if max_delay_ms < 0 or idle_flush_ms < 0:
             raise ValueError(
@@ -167,6 +244,16 @@ class MicroBatcher:
             )
         if max_batch_rows < 1 or max_queue < 1:
             raise ValueError("max_batch_rows and max_queue must be >= 1")
+        if retries < 0 or retry_backoff_ms < 0:
+            raise ValueError(
+                f"retries and retry_backoff_ms must be >= 0, got "
+                f"{retries}, {retry_backoff_ms}"
+            )
+        if crash_loop_threshold < 1 or crash_loop_window_s <= 0:
+            raise ValueError(
+                "need crash_loop_threshold >= 1 and "
+                "crash_loop_window_s > 0"
+            )
         if callable(executor) and not hasattr(executor, "forward"):
             self._resolve: Callable[[], Any] = executor
         else:
@@ -214,10 +301,28 @@ class MicroBatcher:
         self.max_delay_s = max_delay_ms / 1e3
         self.idle_flush_s = idle_flush_ms / 1e3
         self.max_batch_rows = int(max_batch_rows)
+        self._retries = int(retries)
+        self._retry_backoff_s = retry_backoff_ms / 1e3
+        self._bisect = bool(bisect_on_error)
+        # deadline clock: injectable so the replay harness can drive
+        # expiry off its virtual clock (determinism); everything else
+        # (latency, stall age) stays on the real monotonic clock
+        self._clock: Callable[[], float] = clock or time.monotonic
         self._q: Queue = Queue(maxsize=int(max_queue))
         self._stop = threading.Event()
         self._closed = False
         self._close_lock = make_lock("serving.batcher.close")
+        # worker supervision state, guarded by its own short lock: the
+        # crash history ring sizes itself to the loop threshold, and
+        # _degraded is the reject-mode flag submit() reads unlocked
+        # (benign: a momentarily stale read sheds or admits one request
+        # at the mode boundary)
+        self._threaded = bool(threaded)
+        self._supervise = bool(supervise) and threaded
+        self._crash_window_s = float(crash_loop_window_s)
+        self._crash_ts: deque[float] = deque(maxlen=int(crash_loop_threshold))
+        self._degraded = False
+        self._sup_lock = make_lock("serving.batcher.supervisor")
         # health facts for /healthz: single-writer fields (the worker
         # thread); readers tolerate a momentarily stale float. Seeded
         # at construction so a cold-start burst (queue pinned while
@@ -227,7 +332,8 @@ class MicroBatcher:
         self._worker: threading.Thread | None = None
         if threaded:
             self._worker = threading.Thread(
-                target=self._loop, daemon=True, name="serving-batcher"
+                target=self._worker_main, daemon=True,
+                name="serving-batcher"
             )
             self._worker.start()
         # deferred import: the health registry lives in the exposition
@@ -244,14 +350,19 @@ class MicroBatcher:
     # -- client side ---------------------------------------------------
 
     # sbt-lint: hot-path
-    def submit(self, X, *, mode: str = "aggregate") -> Future:
+    def submit(self, X, *, mode: str = "aggregate",
+               deadline_ms: float | None = None) -> Future:
         """Enqueue one request; returns a ``concurrent.futures.Future``.
 
         ``mode="aggregate"`` resolves to the executor's raw aggregated
         output (probabilities / predictions); ``mode="predict"``
         resolves to class labels (classification) or predictions
-        (regression). Raises :class:`Overloaded` when the queue is
-        full and ``RuntimeError`` after :meth:`close`.
+        (regression). ``deadline_ms`` bounds how long the request may
+        WAIT: if it is still queued when its batch is claimed past the
+        deadline, its future fails with :class:`DeadlineExceeded`
+        instead of being served late. Raises :class:`Overloaded` when
+        the queue is full, :class:`Degraded` in crash-loop reject
+        mode, and ``RuntimeError`` after :meth:`close`.
 
         With direct dispatch enabled (the threaded-mode default), an
         idle batcher serves the request INLINE before returning — the
@@ -262,6 +373,21 @@ class MicroBatcher:
             raise ValueError(f"unknown mode {mode!r}")
         if self._closed:
             raise RuntimeError("MicroBatcher is closed")
+        if self._degraded:
+            # crash-loop reject mode: shed at the edge, distinctly —
+            # a load balancer reading /healthz routes away; anything
+            # that still lands here must not hang on a dead worker
+            telemetry.inc("sbt_serving_shed_total",
+                          labels={"reason": "degraded"})
+            telemetry.emit_event({
+                "kind": "serving_degraded_reject",
+                "rows": int(np.asarray(X).shape[0]) if hasattr(
+                    X, "shape") else None,
+            })
+            raise Degraded(
+                "serving is in degraded reject mode (worker crash "
+                "loop); call revive() after remediation"
+            )
         X = np.ascontiguousarray(X, dtype=np.float32)
         if X.ndim == 1:
             X = X[None, :]
@@ -271,9 +397,20 @@ class MicroBatcher:
             )
         if X.shape[0] == 0:
             raise ValueError("X has no rows")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}"
+            )
         trace = (tracing.request_context() if telemetry.enabled()
                  else None)
-        req = _Request(X, mode, trace)
+        deadline_t = (self._clock() + deadline_ms / 1e3
+                      if deadline_ms is not None else None)
+        req = _Request(X, mode, trace, deadline_t)
+        if faults.ACTIVE is not None and faults.fire(
+                "batcher.submit", rows=req.n):
+            # an armed chaos plan marked this request poisoned: its
+            # batch's forward fails until bisection isolates it
+            req.poisoned = True
         if self._direct:
             # adaptive path decision: serve inline iff direct mode has
             # been earned AND nothing else is in flight — one short
@@ -296,6 +433,8 @@ class MicroBatcher:
                     self._q.put_nowait(req)
                 except Full:
                     telemetry.inc("sbt_serving_overloaded_total")
+                    telemetry.inc("sbt_serving_shed_total",
+                                  labels={"reason": "overload"})
                     telemetry.emit_event({
                         "kind": "serving_overloaded",
                         "trace_id": trace.trace_id if trace else None,
@@ -313,6 +452,16 @@ class MicroBatcher:
             # cancel() returns False and the request is served anyway);
             # fail fast instead of hanging the caller
             raise RuntimeError("MicroBatcher closed during submit")
+        if self._degraded and req.future.cancel():
+            # raced the crash-loop trip (same pattern as close above):
+            # the degraded drain is one-shot and may already have run,
+            # and no worker will ever claim this request — shed it now
+            # instead of stranding the caller on a dead worker
+            telemetry.inc("sbt_serving_shed_total",
+                          labels={"reason": "degraded"})
+            raise Degraded(
+                "serving entered degraded reject mode during submit"
+            )
         if telemetry.enabled():
             telemetry.inc("sbt_serving_requests_total")
             telemetry.set_gauge("sbt_serving_queue_depth",
@@ -388,35 +537,72 @@ class MicroBatcher:
             ex = None
             t_fwd = 0.0
             try:
+                if faults.ACTIVE is not None and req.poisoned:
+                    # a poisoned direct serve fails alone by
+                    # construction — there is no batch to protect
+                    raise faults.PoisonedRequest(
+                        "poisoned request (direct dispatch)"
+                    )
                 ex = self._resolve()
-                if telemetry.sinks_active():
-                    # someone is consuming events (open capture, armed
-                    # recorder): full span treatment, trace installed
-                    # so serving_direct/serving_forward carry the ids
-                    with tracing.use(req.trace):
-                        with telemetry.span("serving_direct",
-                                            rows=req.n):
+                # the same TRANSIENT-retry contract as the coalesced
+                # path (bisect is vacuous for a lone request): direct
+                # dispatch is the path that serves most low-concurrency
+                # traffic, so `retries=` must apply here too. t_fwd
+                # accumulates across attempts — retries are real
+                # forward latency
+                attempt = 0
+                while True:
+                    try:
+                        if telemetry.sinks_active():
+                            # someone is consuming events (open
+                            # capture, armed recorder): full span
+                            # treatment, trace installed so
+                            # serving_direct/serving_forward carry
+                            # the ids
+                            with tracing.use(req.trace):
+                                with telemetry.span("serving_direct",
+                                                    rows=req.n):
+                                    t0 = time.perf_counter()
+                                    try:
+                                        out = ex.forward(req.X)
+                                    finally:
+                                        t_fwd += (time.perf_counter()
+                                                  - t0)
+                        else:
+                            # lean inline serve: metrics still count
+                            # (inside the executor), spans are skipped
+                            # — span events with no sink are built
+                            # only to be dropped, and that build was a
+                            # measurable slice of the per-request
+                            # budget at concurrency 1
                             t0 = time.perf_counter()
                             try:
-                                out = ex.forward(req.X)
+                                if hasattr(ex, "_forward_packed"):
+                                    # submit() already validated: skip
+                                    # the executor's re-validation pass
+                                    (out,) = ex._forward_packed([req.X])
+                                else:
+                                    out = ex.forward(req.X)
                             finally:
-                                t_fwd = time.perf_counter() - t0
-                else:
-                    # lean inline serve: metrics still count (inside
-                    # the executor), spans are skipped — span events
-                    # with no sink are built only to be dropped, and
-                    # that build was a measurable slice of the
-                    # per-request budget at concurrency 1
-                    t0 = time.perf_counter()
-                    try:
-                        if hasattr(ex, "_forward_packed"):
-                            # submit() already validated: skip the
-                            # executor's re-validation pass
-                            (out,) = ex._forward_packed([req.X])
-                        else:
-                            out = ex.forward(req.X)
-                    finally:
-                        t_fwd = time.perf_counter() - t0
+                                t_fwd += time.perf_counter() - t0
+                        break
+                    except BaseException as e:  # noqa: BLE001 — retry ladder
+                        if getattr(e, "transient", False) \
+                                and attempt < self._retries:
+                            attempt += 1
+                            telemetry.inc("sbt_serving_retries_total")
+                            telemetry.emit_event({
+                                "kind": "serving_retry",
+                                "attempt": attempt,
+                                "requests": 1,
+                                "error": repr(e),
+                            })
+                            if self._retry_backoff_s > 0:
+                                time.sleep(self._retry_backoff_s
+                                           * (2 ** (attempt - 1)))
+                            continue
+                        raise
+                if not telemetry.sinks_active():
                     if req.trace is not None and hasattr(
                             ex, "min_bucket_rows"):
                         # no context was installed, so the executor's
@@ -435,6 +621,7 @@ class MicroBatcher:
                     None, 1, error=repr(e), path="direct",
                 )
                 req.future.set_exception(e)
+                telemetry.inc("sbt_serving_request_failures_total")
                 telemetry.inc("sbt_serving_batch_errors_total")
                 telemetry.emit_event({
                     "kind": "serving_batch_error",
@@ -486,22 +673,29 @@ class MicroBatcher:
 
     def health(self) -> dict:
         """Liveness facts for ``/healthz`` (registered automatically):
-        healthy means SERVING traffic — closed, dead-worker (a sink
-        raised outside the batch guard), and stalled (queue pinned at
-        its bound past :data:`STALL_S` with no batch completing)
-        batchers all report unhealthy so a load balancer stops routing
-        here."""
+        healthy means SERVING traffic — closed, dead-worker (a crash
+        the supervisor could not absorb), degraded (crash-loop reject
+        mode), and stalled (queue pinned at its bound past
+        :data:`STALL_S` with no batch completing) batchers all report
+        unhealthy so a load balancer stops routing here."""
         depth = self._q.qsize()
+        with self._sup_lock:
+            worker = self._worker
+            degraded = self._degraded
+            crashes = len(self._crash_ts)
         # stepped mode has no worker by design: liveness there is just
         # "not closed" (the owner serves on its own thread)
-        alive = (self._worker.is_alive() if self._worker is not None
+        alive = (worker.is_alive() if worker is not None
                  else not self._closed)
         age = time.monotonic() - self._t_last_batch
         stalled = depth >= self._q.maxsize and age > self.STALL_S
         return {
-            "healthy": not self._closed and alive and not stalled,
+            "healthy": (not self._closed and alive and not stalled
+                        and not degraded),
             "closed": self._closed,
             "worker_alive": alive,
+            "degraded": degraded,
+            "crashes_in_window": crashes,
             "stalled": stalled,
             "queue_depth": depth,
             "max_queue": self._q.maxsize,
@@ -523,6 +717,14 @@ class MicroBatcher:
             "overloaded": reg.counter("sbt_serving_overloaded_total").value,
             "batch_errors": reg.counter(
                 "sbt_serving_batch_errors_total").value,
+            "retries": reg.counter("sbt_serving_retries_total").value,
+            "shed": {
+                reason: reg.counter("sbt_serving_shed_total",
+                                    labels={"reason": reason}).value
+                for reason in ("overload", "deadline", "degraded")
+            },
+            "worker_crashes": reg.counter(
+                "sbt_serving_worker_crashes_total").value,
             "latency": reg.histogram(
                 "sbt_serving_latency_seconds").quantiles(),
             "latency_direct": reg.histogram(
@@ -555,8 +757,12 @@ class MicroBatcher:
             self._q.put_nowait(_SHUTDOWN)
         except Full:
             pass
-        if self._worker is not None:
-            self._worker.join(timeout)
+        with self._sup_lock:
+            # the supervisor may have replaced the worker thread since
+            # construction: join the CURRENT one
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout)
         # anything still queued was never forwarded — fail it loudly
         while True:
             try:
@@ -633,6 +839,98 @@ class MicroBatcher:
 
     # -- worker side ---------------------------------------------------
 
+    def _worker_main(self) -> None:
+        """Thread target: the coalescing loop under supervision. A
+        crash that escapes the per-batch guard lands in
+        :meth:`_on_worker_crash` instead of silently killing serving."""
+        try:
+            self._loop()
+        # sbt-lint: disable=swallowed-fault — the fault IS the payload: the supervisor counts, flight-records, and restarts/degrades on it
+        except BaseException as e:  # noqa: BLE001 — the supervision seam
+            self._on_worker_crash(e)
+
+    def _on_worker_crash(self, e: BaseException) -> None:
+        """Supervisor: count + record the crash, then either restart a
+        fresh worker or — on a crash loop — trip degraded reject mode
+        (one flight dump, /healthz 503, queue drained with
+        :class:`Degraded`)."""
+        telemetry.inc("sbt_serving_worker_crashes_total")
+        telemetry.emit_event({
+            "kind": "serving_worker_crash", "error": repr(e),
+        })
+        restart = False
+        with self._sup_lock:
+            now = time.monotonic()
+            self._crash_ts.append(now)
+            looping = (
+                len(self._crash_ts) == self._crash_ts.maxlen
+                and now - self._crash_ts[0] <= self._crash_window_s
+            )
+            if self._closed or not self._supervise:
+                return
+            if looping:
+                self._degraded = True
+            else:
+                restart = True
+        if not restart:
+            telemetry.inc("sbt_serving_crash_loops_total")
+            # serving_crash_loop is a flight-recorder TRIGGER: exactly
+            # one dump for the incident (per-kind cooldown), with the
+            # crash events of the loop in its ring
+            telemetry.emit_event({
+                "kind": "serving_crash_loop",
+                "crashes": len(self._crash_ts),
+                "window_s": self._crash_window_s,
+                "error": repr(e),
+            })
+            self._fail_queued(Degraded(
+                "batcher entered degraded reject mode (worker crash "
+                "loop)"
+            ), reason="degraded")
+            return
+        telemetry.inc("sbt_serving_worker_restarts_total")
+        t = threading.Thread(target=self._worker_main, daemon=True,
+                             name="serving-batcher")
+        with self._sup_lock:
+            self._worker = t
+        t.start()
+
+    def _fail_queued(self, exc: BaseException, reason: str) -> None:
+        """Drain the queue, failing every still-pending request with
+        ``exc`` (counted as shed under ``reason``) — degraded mode
+        must reject, not strand."""
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except Empty:
+                return
+            if req is _SHUTDOWN:
+                continue
+            if req.future.set_running_or_notify_cancel():
+                telemetry.inc("sbt_serving_shed_total",
+                              labels={"reason": reason})
+                req.future.set_exception(exc)
+
+    def revive(self) -> None:
+        """Operator reset out of degraded reject mode: clear the crash
+        history and start a fresh worker. A no-op on a healthy
+        threaded batcher; raises after :meth:`close`."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        t: threading.Thread | None = None
+        with self._sup_lock:
+            self._degraded = False
+            self._crash_ts.clear()
+            alive = self._worker is not None and self._worker.is_alive()
+            if not alive and self._threaded:
+                t = threading.Thread(target=self._worker_main,
+                                     daemon=True,
+                                     name="serving-batcher")
+                self._worker = t
+        if t is not None:
+            telemetry.inc("sbt_serving_worker_restarts_total")
+            t.start()
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -641,6 +939,19 @@ class MicroBatcher:
                 continue
             if first is _SHUTDOWN:
                 return
+            if faults.ACTIVE is not None:
+                # worker-crash drills: the probe sits AFTER a request
+                # is claimed (deterministic per-claim hit counts); its
+                # future is failed before the crash propagates so no
+                # caller hangs on a request the dying worker took
+                try:
+                    faults.fire("batcher.worker")
+                except BaseException:
+                    if first.future.set_running_or_notify_cancel():
+                        first.future.set_exception(RuntimeError(
+                            "serving worker crashed (injected fault)"
+                        ))
+                    raise
             batch = [first]
             rows = first.n
             deadline = time.perf_counter() + self.max_delay_s
@@ -671,6 +982,12 @@ class MicroBatcher:
     DIRECT_AFTER_SINGLETONS = 8
 
     def _run_batch(self, batch: list) -> None:
+        # in-queue deadline expiry happens at claim time, BEFORE the
+        # futures are claimed for serving: an expired request is shed
+        # as DeadlineExceeded (reason="deadline"), never served late
+        # and never billed as Overloaded
+        if any(r.deadline_t is not None for r in batch):
+            batch = self._expire_deadlines(batch)
         # claim the futures; drop requests cancelled while queued
         live = [r for r in batch if r.future.set_running_or_notify_cancel()]
         if not live:
@@ -702,6 +1019,19 @@ class MicroBatcher:
             token = []
         try:
             self._run_batch_held(live, token)
+        except BaseException as e:  # noqa: BLE001 — deliver, then crash
+            # a crash that escaped even _run_batch_held's guards (a
+            # sink dying in the scatter span, an injected fault): the
+            # futures this batch CLAIMED must fail before the crash
+            # reaches the supervisor — a restarted worker never
+            # revisits them, and a stranded claimed future blocks its
+            # caller forever with /healthz reporting healthy
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(RuntimeError(
+                        f"serving worker crashed mid-batch: {e!r}"
+                    ))
+            raise
         finally:
             self._release_slot(token)  # backstop; normally a no-op
 
@@ -717,6 +1047,112 @@ class MicroBatcher:
             token.clear()
             with self._occ_lock:
                 self._occupancy -= 1
+
+    def _expire_deadlines(self, batch: list) -> list:
+        """Shed every claimed request whose deadline already passed on
+        the batcher's clock; returns the survivors."""
+        now = self._clock()
+        kept: list = []
+        for r in batch:
+            if r.deadline_t is None or now <= r.deadline_t:
+                kept.append(r)
+                continue
+            if not r.future.set_running_or_notify_cancel():
+                continue  # cancelled while queued: nothing to shed
+            telemetry.inc("sbt_serving_shed_total",
+                          labels={"reason": "deadline"})
+            telemetry.emit_event({
+                "kind": "serving_deadline_exceeded",
+                "rows": r.n,
+                "late_s": now - r.deadline_t,
+                "trace_id": (r.trace.trace_id if r.trace else None),
+            })
+            if r.trace is not None:
+                r.trace.breakdown.update({
+                    "error": "DeadlineExceeded", "path": "shed",
+                })
+            r.future.set_exception(DeadlineExceeded(
+                "request expired in queue (deadline passed by "
+                f"{(now - r.deadline_t) * 1e3:.1f} ms)"
+            ))
+        return kept
+
+    def _forward_once(self, ex: Any, reqs: list) -> list:
+        """ONE forward attempt over ``reqs``; returns one output per
+        request. The chaos probe and the poison check sit here, so
+        retries and bisection re-drive them deterministically."""
+        if faults.ACTIVE is not None:
+            faults.fire("batcher.batch_forward", requests=len(reqs))
+            if any(r.poisoned for r in reqs):
+                raise faults.PoisonedRequest(
+                    f"poisoned request in batch of {len(reqs)}"
+                )
+        rows = sum(r.n for r in reqs)
+        with telemetry.span("serving_batch", rows=rows,
+                            requests=len(reqs)):
+            if hasattr(ex, "forward_parts"):
+                # ragged packing: request blocks scatter straight into
+                # the pack plan's slabs (one copy per row, minimal
+                # padding) and come back pre-split per request
+                return list(ex.forward_parts([r.X for r in reqs]))
+            # plain-callable executors (no ragged seam): concatenate
+            # and slice, as ever
+            X = (reqs[0].X if len(reqs) == 1
+                 else np.concatenate([r.X for r in reqs]))
+            out = ex.forward(X)
+            outs = []
+            off = 0
+            for r in reqs:
+                outs.append(out[off:off + r.n])
+                off += r.n
+            return outs
+
+    def _serve_requests(self, ex: Any, reqs: list) -> list:
+        """Serve ``reqs`` with the recovery ladder: bounded retry with
+        exponential backoff for TRANSIENT failures, then bisection so
+        a poisoned request fails alone. Returns one output per request
+        — a :class:`_Failed` sentinel where that request's forward
+        ultimately failed (delivered per-future by the scatter)."""
+        attempt = 0
+        while True:
+            try:
+                return self._forward_once(ex, reqs)
+            except BaseException as e:  # noqa: BLE001 — recovery ladder
+                if getattr(e, "transient", False) \
+                        and attempt < self._retries:
+                    attempt += 1
+                    telemetry.inc("sbt_serving_retries_total")
+                    telemetry.emit_event({
+                        "kind": "serving_retry",
+                        "attempt": attempt,
+                        "requests": len(reqs),
+                        "error": repr(e),
+                    })
+                    if self._retry_backoff_s > 0:
+                        time.sleep(
+                            self._retry_backoff_s * (2 ** (attempt - 1))
+                        )
+                    continue
+                if len(reqs) > 1 and self._bisect:
+                    # bisect-on-poison: each half serves (and retries)
+                    # independently; recursion bottoms out at single
+                    # requests, so exactly the bad ones fail
+                    telemetry.inc("sbt_serving_batch_bisects_total")
+                    mid = (len(reqs) + 1) // 2
+                    return (self._serve_requests(ex, reqs[:mid])
+                            + self._serve_requests(ex, reqs[mid:]))
+                telemetry.inc("sbt_serving_request_failures_total",
+                              float(len(reqs)))
+                telemetry.inc("sbt_serving_batch_errors_total")
+                telemetry.emit_event({
+                    "kind": "serving_batch_error",
+                    "error": repr(e),
+                    "requests": len(reqs),
+                    "rows": sum(r.n for r in reqs),
+                    "links": [r.trace.trace_id for r in reqs
+                              if r.trace is not None],
+                })
+                return [_Failed(e)] * len(reqs)
 
     def _run_batch_held(self, live: list, token: list) -> None:
         t_claim = time.perf_counter()
@@ -735,36 +1171,19 @@ class MicroBatcher:
         t_fwd = 0.0
         try:
             ex = self._resolve()
-            rows = sum(r.n for r in live)
-            ragged = hasattr(ex, "forward_parts")
             with tracing.use(bctx):
-                with telemetry.span("serving_batch", rows=rows,
-                                    requests=len(live)):
-                    t0 = time.perf_counter()
-                    try:
-                        if ragged:
-                            # ragged packing: request blocks scatter
-                            # straight into the pack plan's slabs (one
-                            # copy per row, minimal padding) and come
-                            # back pre-split per request
-                            pieces = ex.forward_parts(
-                                [r.X for r in live]
-                            )
-                        else:
-                            # plain-callable executors (no ragged
-                            # seam): concatenate and slice, as ever
-                            X = (live[0].X if len(live) == 1
-                                 else np.concatenate(
-                                     [r.X for r in live]))
-                            out = ex.forward(X)
-                    finally:
-                        # in finally so a forward that dies after 2 s
-                        # of device time still attributes those 2 s to
-                        # forward_ms in the error breakdown
-                        t_fwd = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                try:
+                    # recovery lives INSIDE the timed window: retries
+                    # and bisection are real forward latency the
+                    # breakdown must attribute honestly
+                    outs = self._serve_requests(ex, live)
+                finally:
+                    t_fwd = time.perf_counter() - t0
         except BaseException as e:  # noqa: BLE001 — delivered per-future
-            # release BEFORE delivering: a client waking on the
-            # exception may submit immediately
+            # catastrophic path (executor resolution failed, or
+            # recovery itself died): release BEFORE delivering — a
+            # client waking on the exception may submit immediately
             self._release_slot(token)
             t_fail = time.perf_counter()
             for r in live:
@@ -790,14 +1209,19 @@ class MicroBatcher:
         self._t_last_batch = time.monotonic()
         with tracing.use(bctx):
             with telemetry.span("serving_scatter", requests=len(live)):
-                off = 0
                 t_done = time.perf_counter()
                 for i, r in enumerate(live):
-                    if ragged:
-                        piece = pieces[i]
-                    else:
-                        piece = out[off:off + r.n]
-                        off += r.n
+                    piece = outs[i]
+                    if isinstance(piece, _Failed):
+                        # this request's forward failed after the full
+                        # recovery ladder — it fails ALONE; its
+                        # batch-mates resolve normally below
+                        self._finish_breakdown(
+                            r, ex, t_claim, t_done, t_fwd, bctx,
+                            len(live), error=repr(piece.error),
+                        )
+                        r.future.set_exception(piece.error)
+                        continue
                     try:
                         if (r.mode == "predict"
                                 and ex.task == "classification"):

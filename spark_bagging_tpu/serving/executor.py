@@ -27,7 +27,7 @@ from typing import Any
 
 import numpy as np
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.telemetry import tracing
 from spark_bagging_tpu.serving import program_cache as _pc
@@ -59,6 +59,7 @@ def _compiled_cost(compiled: Any) -> dict[str, float | None]:
                 flops = float(f)
             if b is not None and float(b) > 0:
                 nbytes = float(b)
+    # sbt-lint: disable=swallowed-fault — best-effort cost instrumentation: absence degrades the padding-waste gauges to rows, it must never fail a compile
     except Exception:  # noqa: BLE001 — optional instrumentation only
         pass
     return {"flops": flops, "bytes": nbytes}
@@ -108,6 +109,7 @@ class EnsembleExecutor:
             )
         self.mesh = mesh
         self.mesh_shape = _pc.mesh_shape(mesh)
+        self._n_shards: int | None = None
         if mesh is None:
             fn, params, subspaces = model.aggregated_forward()
             rep_fn = None
@@ -119,8 +121,15 @@ class EnsembleExecutor:
 
             (fn, rep_fn, params, subspaces, self._x_sharding,
              n_shards) = replica_sharded_serving(model, mesh)
+            self._n_shards = int(n_shards)
             telemetry.set_gauge("sbt_serving_shard_devices",
                                 float(n_shards))
+        # degraded-quorum state (mesh executors only): shards marked
+        # failed, and the surviving replica indices the degraded
+        # aggregate averages over (None while healthy). The flag reads
+        # on the hot path are single-reference snapshots — benign
+        self._failed_shards: set[int] = set()
+        self._survivors: tuple[int, ...] | None = None
         self.model = model
         self.task: str = model.task
         self.n_features: int = int(model.n_features_in_)
@@ -263,8 +272,15 @@ class EnsembleExecutor:
                 compiled = jitted.lower(
                     self._params, self._subspaces, self._example_x(bucket)
                 ).compile()
-            telemetry.inc("sbt_serving_compiles_total")
-            if self.mesh is not None:
+            if self._failed_shards:
+                # degraded-program builds are deliberate fault-response
+                # cost, not steady-state serving compiles: the
+                # zero-post-warmup-compile gate stays meaningful under
+                # chaos
+                telemetry.inc("sbt_serving_degraded_compiles_total")
+            else:
+                telemetry.inc("sbt_serving_compiles_total")
+            if self.mesh is not None and not self._failed_shards:
                 telemetry.inc(
                     "sbt_shardmap_traces_total",
                     labels={"kind": "serving",
@@ -309,6 +325,147 @@ class EnsembleExecutor:
         from spark_bagging_tpu.serving.aot_cache import restore_executables
 
         return restore_executables(self, path)
+
+    # -- degraded-quorum serving (mesh executors) ----------------------
+
+    @property
+    def degraded(self) -> bool:
+        """True when this executor serves the surviving-replica
+        aggregate after one or more mesh shards failed."""
+        return bool(self._failed_shards)
+
+    @property
+    def failed_shards(self) -> tuple[int, ...]:
+        return tuple(sorted(self._failed_shards))
+
+    @property
+    def surviving_replicas(self) -> int | None:
+        """How many replicas the (degraded) aggregate averages over —
+        None while healthy (every replica serves)."""
+        return len(self._survivors) if self._survivors is not None else None
+
+    def degrade_shards(self, shards) -> None:
+        """Manually drop mesh shards from the serving quorum (the
+        operator's version of what a :class:`faults.ShardFault` does
+        automatically). Mesh executors only."""
+        if self.mesh is None:
+            raise ValueError(
+                "degrade_shards is mesh-serving only; a single-device "
+                "executor has no shards to lose"
+            )
+        for s in shards:
+            self._degrade_shard(int(s))
+
+    def _degrade_shard(self, shard: int) -> bool:
+        """Drop ``shard`` from the quorum and swap the serving program
+        to the surviving-replica aggregate (single-device, bitwise-
+        equal to an offline recompute of the subset aggregate — see
+        ``parallel/sharded.replica_subset_serving``). Returns whether
+        this call newly degraded (False: shard already failed)."""
+        from spark_bagging_tpu.parallel.sharded import (
+            replica_subset_serving,
+        )
+
+        with self._build_lock:
+            if self._n_shards is None or shard in self._failed_shards:
+                return False
+            if not 0 <= shard < self._n_shards:
+                raise ValueError(
+                    f"shard must be in [0, {self._n_shards}), got "
+                    f"{shard}"
+                )
+            n_rep = int(self._subspaces.shape[0]) \
+                if not self._failed_shards else len(self._all_replicas)
+            if not self._failed_shards:
+                # remember the healthy replica universe once: later
+                # losses subset from IT, not from the already-shrunk
+                # degraded params
+                self._all_replicas = tuple(range(n_rep))
+            per = len(self._all_replicas) // self._n_shards
+            failed = self._failed_shards | {shard}
+            survivors = [
+                i for i in self._all_replicas if (i // per) not in failed
+            ]
+            if not survivors:
+                raise RuntimeError(
+                    "every serving shard has failed; no surviving "
+                    "replicas left to aggregate"
+                )
+            fn, rep_fn, params, subspaces = replica_subset_serving(
+                self.model, survivors
+            )
+            self._failed_shards.add(shard)
+            self._survivors = tuple(survivors)
+            self._fn = fn
+            self._replica_fn = rep_fn
+            self._replica_unavailable = False
+            self._params = params
+            self._subspaces = subspaces
+            self._x_sharding = None
+            tag = ",".join(map(str, sorted(self._failed_shards)))
+            self._variant = (
+                _pc.forward_variant(self.model)
+                + f"|degraded-shards=[{tag}]"
+            )
+            self._replica_variant = (
+                _pc.forward_variant(self.model, "replica")
+                + f"|degraded-shards=[{tag}]"
+            )
+            # every compiled program belonged to the old quorum
+            self._compiled.clear()
+            self._replica_compiled.clear()
+            self.bucket_costs.clear()
+        import warnings
+
+        telemetry.inc("sbt_serving_shard_failures_total")
+        telemetry.set_gauge("sbt_serving_degraded", 1.0)
+        telemetry.set_gauge("sbt_serving_degraded_replicas",
+                            float(len(survivors)))
+        telemetry.emit_event({
+            "kind": "serving_shard_failed",
+            "shard": shard,
+            "failed_shards": sorted(self._failed_shards),
+            "survivors": len(survivors),
+            "model": self.model_name,
+            "version": self.model_version,
+        })
+        warnings.warn(
+            f"serving shard {shard} dropped from the quorum; serving "
+            f"the {len(survivors)}-replica surviving aggregate "
+            "(degraded=true) until reset_degraded()",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return True
+
+    def reset_degraded(self) -> bool:
+        """Heal back to the full-quorum mesh program (the shard's
+        device recovered, or a chaos run ended). Returns whether
+        anything was reset."""
+        with self._build_lock:
+            if not self._failed_shards:
+                return False
+            from spark_bagging_tpu.parallel.sharded import (
+                replica_sharded_serving,
+            )
+
+            (fn, rep_fn, params, subspaces, self._x_sharding,
+             _n) = replica_sharded_serving(self.model, self.mesh)
+            self._failed_shards.clear()
+            self._survivors = None
+            self._fn = fn
+            self._replica_fn = rep_fn
+            self._params = params
+            self._subspaces = subspaces
+            self._variant = _pc.forward_variant(self.model)
+            self._replica_variant = _pc.forward_variant(
+                self.model, "replica")
+            self._compiled.clear()
+            self._replica_compiled.clear()
+            self.bucket_costs.clear()
+        telemetry.set_gauge("sbt_serving_degraded", 0.0)
+        telemetry.set_gauge("sbt_serving_degraded_replicas", 0.0)
+        return True
 
     # -- model-quality tap ---------------------------------------------
 
@@ -527,7 +684,20 @@ class EnsembleExecutor:
                 # kept for the (sampled) disagreement tap: one slab per
                 # packed batch is the tap's unit of work
                 first_slab = (Xp, fill)
-            slab_outs.append(self._forward_piece(Xp, fill))
+            while True:
+                try:
+                    slab_outs.append(self._forward_piece(Xp, fill))
+                    break
+                except faults.ShardFault as e:
+                    # a mesh shard failed mid-forward: drop it from
+                    # the quorum and re-serve this slab through the
+                    # surviving-replica aggregate. Each loop iteration
+                    # fails a NEW shard (bounded by the shard count);
+                    # a fault naming an already-failed shard is not a
+                    # new loss and propagates as an ordinary error
+                    if self.mesh is None or not self._degrade_shard(
+                            e.shard):
+                        raise
         # scatter back: slice each block's rows out of the slab outputs
         # (views when a block sat inside one slab; boundary-spanning
         # blocks concatenate their pieces)
@@ -565,6 +735,14 @@ class EnsembleExecutor:
         padding) through its compiled executable; returns the real
         rows' output."""
         bucket = Xp.shape[0]
+        if faults.ACTIVE is not None:
+            # chaos probes (one module-attribute read when unarmed):
+            # generic slab faults, plus the per-shard mesh-forward
+            # seam that simulates losing a device mid-traffic
+            faults.fire("executor.forward_piece", bucket=bucket)
+            if self.mesh is not None and not self._failed_shards:
+                faults.fire("executor.mesh_forward", bucket=bucket)
+        degraded = bool(self._failed_shards)
         compiled = self._compiled.get(bucket)
         if compiled is None:
             compiled = self._build(bucket)
@@ -573,8 +751,11 @@ class EnsembleExecutor:
                 ("sbt_serving_rows_total", float(fill)),
                 ("sbt_serving_padding_rows_total", float(bucket - fill)),
             ]
-            if self.mesh is not None:
+            if self.mesh is not None and not degraded:
                 counts.append(("sbt_serving_shard_forwards_total", 1.0))
+            if degraded:
+                counts.append(("sbt_serving_degraded_forwards_total",
+                               1.0))
             flops = self.bucket_costs.get(bucket, {}).get("flops")
             if flops:
                 # rows are interchangeable within a bucket's program,

@@ -43,7 +43,7 @@ import hashlib
 from collections import OrderedDict
 from typing import Any, Callable, NamedTuple
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 
 
@@ -167,6 +167,11 @@ class ProgramCache:
     def put(self, key: ProgramKey, compiled: Any) -> Any:
         """Insert-if-absent; returns the winning executable (the first
         insert wins, so racing builders converge on one program)."""
+        if faults.ACTIVE is not None:
+            # chaos probe: a failed insert surfaces to the compiling
+            # caller (executor build, swap pre-compile) exactly where
+            # an allocation failure would
+            faults.fire("program_cache.put", bucket=key.bucket)
         evicted = 0
         with self._lock:
             existing = self._entries.get(key)

@@ -32,7 +32,7 @@ from typing import Any
 
 import numpy as np
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.serving.executor import EnsembleExecutor
 
@@ -118,6 +118,23 @@ class ModelRegistry:
             "kind": "swap_rejected", "model": name, "error": msg,
         })
         raise ValueError(msg)
+
+    def _fail_swap(self, name: str, e: Exception) -> None:
+        """A swap that died BUILDING its replacement (AOT restore,
+        bucket pre-compile, quality attach) — as opposed to one
+        rejected by contract validation. The rollback is structural:
+        nothing was committed, so the prior live executor keeps
+        serving untouched; counted + flight-recorded as its own
+        incident kind."""
+        telemetry.inc("sbt_serving_swap_failed_total")
+        telemetry.emit_event({
+            "kind": "swap_failed", "model": name, "error": repr(e),
+        })
+        raise RuntimeError(
+            f"swap of {name!r} failed before commit ({e!r}); rolled "
+            "back — the prior live executor is unchanged and keeps "
+            "serving"
+        ) from e
 
     def register(self, name: str, model: Any, *, warmup: bool = False,
                  executable_cache: str | None = None,
@@ -227,18 +244,43 @@ class ModelRegistry:
                 "swap would change the served class set; register the "
                 "new label space under a new name instead",
             )
-        if executable_cache is not None:
-            new.restore_executables(executable_cache)
-        if warm:
-            from spark_bagging_tpu.serving.buckets import bucket_for
+        quality_gap: Exception | None = None
+        try:
+            if executable_cache is not None:
+                new.restore_executables(executable_cache)
+            if warm:
+                from spark_bagging_tpu.serving.buckets import bucket_for
 
-            for b in old.compiled_buckets:
-                # translate the observed traffic profile into the new
-                # executor's ladder (bounds may differ): the row counts
-                # that used to run in bucket b land in its image rung
-                new._build(bucket_for(
-                    b, new.min_bucket_rows, new.max_batch_rows
-                ))
+                for b in old.compiled_buckets:
+                    if faults.ACTIVE is not None:
+                        faults.fire("registry.swap.precompile",
+                                    bucket=b)
+                    # translate the observed traffic profile into the
+                    # new executor's ladder (bounds may differ): the
+                    # row counts that used to run in bucket b land in
+                    # its image rung
+                    new._build(bucket_for(
+                        b, new.min_bucket_rows, new.max_batch_rows
+                    ))
+            if entry.quality_opts is not None:
+                # sticky drift monitoring attaches to the replacement
+                # BEFORE commit: an attach failure rolls the swap back
+                # (prior executor + its monitor untouched), and the
+                # replacement is monitored from its very first batch —
+                # no commit-to-attach gap. One carve-out: a
+                # replacement with no fit-time profile (stream fit,
+                # older checkpoint) can never be monitored, and
+                # blocking a model upgrade on an optional plane is
+                # wrong — that case swaps anyway and warns below.
+                q_opts = dict(entry.quality_opts)
+                q_opts.setdefault("labels", {"model": str(name)})
+                try:
+                    self._attach_quality(new, q_opts)
+                except ValueError as e:
+                    quality_gap = e
+        # sbt-lint: disable=swallowed-fault — _fail_swap counts, flight-records, and re-raises (the rollback path)
+        except Exception as e:  # noqa: BLE001 — rollback, not delivery
+            self._fail_swap(name, e)
         stale_live = None
         live_ex = None
         with self._lock:
@@ -272,30 +314,21 @@ class ModelRegistry:
         telemetry.inc("sbt_serving_swaps_total")
         telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})
-        if entry.quality_opts is not None:
-            # drift monitoring is sticky per entry: re-attach to the
-            # NEW executor with FRESH sketches against the new model's
-            # own reference — a new model is a new "normal", and the
-            # old monitor's accumulated counts describe traffic scored
-            # against a profile that no longer serves. Best-effort:
-            # the swap is already COMMITTED (executor live, version
-            # bumped), so a monitoring failure here — typically a
-            # replacement model with no quality_profile_ (stream fit,
-            # older checkpoint) — must warn, not masquerade as a
-            # rejected swap the caller would retry or roll back
-            try:
-                self._attach_quality(new, entry.quality_opts)
-            except Exception as e:  # noqa: BLE001 — monitoring is optional
-                import warnings
+        if quality_gap is not None:
+            # the one attach failure that does NOT roll back: a
+            # replacement with no fit-time quality_profile_ (stream
+            # fit, older checkpoint) can never be monitored — the
+            # model upgrade ships, loudly unmonitored
+            import warnings
 
-                warnings.warn(
-                    f"swap of {name!r} succeeded but drift monitoring "
-                    f"could not re-attach: {e} (version {version} "
-                    "serves UNMONITORED; fit the replacement with this "
-                    "build or disable_quality first)",
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
+            warnings.warn(
+                f"swap of {name!r} succeeded but drift monitoring "
+                f"could not re-attach: {quality_gap} (version "
+                f"{version} serves UNMONITORED; fit the replacement "
+                "with this build or disable_quality first)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return new
 
     def enable_quality(self, name: str,
@@ -467,6 +500,11 @@ class ModelRegistry:
 
         cfg = self._read_serve_config(path)
         version: int | None = None
+        # kept verbatim so stale-manifest detection below can fall all
+        # the way back to what the CALLER asked for — a torn save's
+        # manifest must donate neither its version nor its executor
+        # configuration
+        caller_opts = dict(executor_opts)
         if cfg is not None:
             v = cfg.get("version")
             if isinstance(v, int) and v >= 1:
@@ -486,6 +524,30 @@ class ModelRegistry:
             # not an error (and not a spurious version bump)
             return live_executor
         model = load_model(path)
+        if cfg is not None and isinstance(
+                cfg.get("model_fingerprint"), str):
+            # torn-save detection: the manifest names the weights it
+            # was committed with; a mismatch means a save died between
+            # its checkpoint write and its manifest rename. The
+            # weights themselves are a complete, valid checkpoint —
+            # serve them — but the manifest's version/config describe
+            # a DIFFERENT publish and must not be adopted
+            from spark_bagging_tpu.serving import program_cache as _pcache
+
+            if _pcache.fingerprint_model(model) != cfg["model_fingerprint"]:
+                import warnings
+
+                warnings.warn(
+                    f"serve_config at {path!r} does not match the "
+                    "checkpoint weights next to it (a save() was "
+                    "killed before its manifest commit); ignoring the "
+                    "stale manifest's version AND executor config — "
+                    "loading as an ordinary register/swap with the "
+                    "caller's options",
+                    stacklevel=2,
+                )
+                version = None
+                executor_opts = caller_opts
         if executable_cache == "auto":
             auto = os.path.join(path, self.AOT_SUBDIR)
             executable_cache = auto if os.path.isdir(auto) else None
@@ -529,7 +591,20 @@ class ModelRegistry:
         adopts (see there for the rolling-swap rules). Donation is
         persisted as the entry's CONFIGURED value, not the resolved
         boolean — a checkpoint saved on CPU must not pin donation off
-        for the TPU peer that loads it."""
+        for the TPU peer that loads it.
+
+        Torn-write safety: each component writes atomically (the
+        checkpoint via its tmp+swap with a ``.old`` recovery slot, the
+        AOT dir via tmp+rename, the manifest via tmp+rename), the
+        manifest rename is LAST and is the save's commit point, and
+        the manifest binds itself to the weights it describes via
+        ``model_fingerprint``. A kill at ANY point between the steps
+        (the ``registry.save.*`` / ``checkpoint.write`` / ``aot.save``
+        fault-injection sites) leaves a directory :meth:`load` serves
+        correctly: a stale manifest is detected by fingerprint and
+        ignored (warned), mismatched AOT entries restore as counted
+        misses, and the previously published version stays loadable —
+        partial artifacts are never wrong answers."""
         import json
 
         from spark_bagging_tpu.utils.checkpoint import save_model
@@ -540,14 +615,23 @@ class ModelRegistry:
             version = entry.version
             donate_opt = entry.opts.get("donate_input")
         save_model(ex.model, path, compress=compress)
+        if faults.ACTIVE is not None:
+            faults.fire("registry.save.checkpoint")
         if executables and ex.compiled_buckets:
             ex.save_executables(os.path.join(path, self.AOT_SUBDIR))
+        if faults.ACTIVE is not None:
+            faults.fire("registry.save.aot")
         cfg = {
             "format": 1,
             "name": name,
             "version": version,
             "task": ex.task,
             "n_features": ex.n_features,
+            # binds this manifest to the exact weights it was written
+            # next to: load() ignores (and warns about) a manifest
+            # whose fingerprint does not match the checkpoint — the
+            # torn-save signature
+            "model_fingerprint": ex.fingerprint,
             "executor": {
                 "min_bucket_rows": ex.min_bucket_rows,
                 "max_batch_rows": ex.max_batch_rows,
@@ -561,6 +645,11 @@ class ModelRegistry:
         tmp = os.path.join(path, f"{self.SERVE_CONFIG}.tmp")
         with open(tmp, "w") as f:
             json.dump(cfg, f, indent=2)
+        if faults.ACTIVE is not None:
+            # the last kill window: everything written, nothing
+            # committed — load() must still serve the prior manifest's
+            # version (or detect the staleness by fingerprint)
+            faults.fire("registry.save.manifest")
         os.replace(tmp, os.path.join(path, self.SERVE_CONFIG))
 
     def batcher(self, name: str, **batcher_opts: Any):

@@ -48,8 +48,12 @@ DUMP_SCHEMA_VERSION = 1
 
 # event kinds that dump immediately (one incident = one event);
 # alert_fired is the quality plane's contribution — an alert arrives
-# with the black box of the traffic that tripped it
-TRIGGER_KINDS = ("serving_batch_error", "swap_rejected", "alert_fired")
+# with the black box of the traffic that tripped it; the PR-11 fault
+# plane adds worker crash loops, pre-commit swap failures, and lost
+# serving shards (each per-kind cooldown'd to one dump per incident)
+TRIGGER_KINDS = ("serving_batch_error", "swap_rejected", "alert_fired",
+                 "serving_crash_loop", "swap_failed",
+                 "serving_shard_failed")
 # event kind that dumps only as a burst
 BURST_KIND = "serving_overloaded"
 

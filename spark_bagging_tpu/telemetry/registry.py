@@ -80,6 +80,21 @@ SERIES_HELP: dict[str, str] = {
     "sbt_serving_aot_restored_total": "Bucket executables hydrated from a persisted AOT cache (no compile)",
     "sbt_serving_aot_misses_total": "AOT cache lookups that fell back to lowering (absent/key-mismatched/unreadable)",
     "sbt_serving_overloaded_total": "Requests shed with Overloaded backpressure",
+    "sbt_serving_shed_total": "Requests shed at the serving edge (label reason: overload/deadline/degraded)",
+    "sbt_serving_retries_total": "Transient micro-batch forward failures retried with backoff",
+    "sbt_serving_batch_bisects_total": "Failing coalesced batches split in half to isolate a poisoned request",
+    "sbt_serving_request_failures_total": "Requests failed by a forward error after retries and bisect isolation",
+    "sbt_serving_worker_crashes_total": "Batcher worker crashes caught by the supervisor",
+    "sbt_serving_worker_restarts_total": "Fresh batcher worker threads started by the supervisor (or revive())",
+    "sbt_serving_crash_loops_total": "Crash-loop detections that put a batcher into degraded reject mode",
+    "sbt_serving_shard_failures_total": "Mesh serving shards marked failed and dropped from the quorum",
+    "sbt_serving_degraded": "Executor serves a degraded surviving-replica aggregate (gauge, 0/1)",
+    "sbt_serving_degraded_replicas": "Replicas the degraded aggregate averages over (gauge; 0 when healthy)",
+    "sbt_serving_degraded_forwards_total": "Slab forwards served by a degraded surviving-subset program",
+    "sbt_serving_degraded_compiles_total": "Degraded-program bucket compiles (fault response, not serving compiles)",
+    "sbt_serving_swap_failed_total": "Hot swaps that died building the replacement and rolled back (live executor unchanged)",
+    "sbt_faults_armed": "A deterministic fault-injection plan is armed in this process (gauge, 0/1)",
+    "sbt_faults_injected_total": "Faults fired by the armed injection plan (labels site, action)",
     "sbt_serving_models_registered_total": "Models registered for serving",
     "sbt_serving_swaps_total": "Successful hot swaps",
     "sbt_serving_swap_rejected_total": "Hot swaps rejected by contract validation",

@@ -122,6 +122,7 @@ def health_report() -> dict[str, Any]:
             continue
         try:
             detail = dict(fn(owner))
+        # sbt-lint: disable=swallowed-fault — the fault IS the report: surfaced as healthy=False with the error in the /healthz body
         except Exception as e:  # noqa: BLE001 — a broken health probe
             # IS unhealth, not a reason to take the endpoint down
             detail = {"healthy": False, "error": repr(e)}
@@ -297,6 +298,7 @@ class _Handler(BaseHTTPRequestHandler):
             # report it on; writing a 500 here would raise again and
             # spam handle_error tracebacks on every aborted scrape
             pass
+        # sbt-lint: disable=swallowed-fault — surfaced to the scraper as a 500 body carrying the error
         except Exception as e:  # noqa: BLE001 — the instrument panel
             # must report its own faults, not close the connection
             try:

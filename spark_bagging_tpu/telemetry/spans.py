@@ -103,6 +103,7 @@ def _record_span(
         if do_sync:
             try:
                 _device_barrier()
+            # sbt-lint: disable=swallowed-fault — deliberate: the body's own exception (already propagating) must not be masked by the measurement barrier failing for the same cause
             except Exception:  # noqa: BLE001 — a body exception (the
                 # device failing mid-span) must not be masked by the
                 # measurement barrier failing for the same reason
@@ -179,6 +180,7 @@ def _under_trace() -> bool:
 
     try:
         return not jax.core.trace_state_clean()
+    # sbt-lint: disable=swallowed-fault — version-probe fallback (jax vintages without trace_state_clean); "not tracing" is the safe answer, and telemetry must never break a trace
     except Exception:  # noqa: BLE001 — never let telemetry break a trace
         return False
 

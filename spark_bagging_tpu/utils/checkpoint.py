@@ -272,6 +272,13 @@ def _save_model_impl(
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     _write_arrays(tmp, serialization.msgpack_serialize(tree), compress)
+    from spark_bagging_tpu import faults
+
+    if faults.ACTIVE is not None:
+        # torn-write drill: a kill HERE leaves only tmp debris — the
+        # previously installed checkpoint (and its .old recovery slot)
+        # stay untouched and loadable
+        faults.fire("checkpoint.write")
     # `path + ".old"` is the pid-INDEPENDENT crash-recovery slot: a
     # crash between the two swap renames leaves the previous complete
     # checkpoint there, where load_model falls back to. It is only

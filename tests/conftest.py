@@ -19,8 +19,19 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import time
+
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    # session wall-clock anchor for the tier-1 budget ratchet
+    # (tests/test_zz_tier_budget.py): recorded as early as pytest
+    # allows so the measured elapsed covers collection + every test
+    # that ran before the ratchet (which sorts last by filename under
+    # the tier's -p no:randomly ordering)
+    config._sbt_tier_t0 = time.monotonic()
 
 
 @pytest.fixture(scope="session")

@@ -251,6 +251,33 @@ def cold_path(req):
     return os.urandom(8).hex(), {k: str(v) for k, v in req.items()}
 """,
     ),
+    "swallowed-fault": (
+        """
+def serve_batch(executor, batch):
+    try:
+        return executor.forward(batch)
+    except Exception:
+        pass
+""",
+        """
+from spark_bagging_tpu import telemetry
+
+def serve_batch(executor, batch, future):
+    try:
+        return executor.forward(batch)
+    except Exception as e:
+        telemetry.inc("sbt_serving_batch_errors_total")
+        future.set_exception(e)
+    try:
+        return executor.forward(batch)
+    except OSError:
+        return None  # narrow handlers are deliberate-by-construction
+    try:
+        return executor.forward(batch)
+    except Exception:
+        raise
+""",
+    ),
     "shared-state-unlocked": (
         """
 import threading

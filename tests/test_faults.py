@@ -1,0 +1,765 @@
+"""Fault-tolerance layer tests [ISSUE 11]: the deterministic
+fault-injection framework (``spark_bagging_tpu/faults.py``) and the
+serving plane's responses to what it injects — deadline sheds, bounded
+retries, bisect-on-poison, worker supervision + crash-loop degraded
+mode, crash-safe registry swap/save, degraded-quorum mesh serving, and
+the ``--chaos`` replay scenario.
+
+Contract anchors:
+
+- a chaos experiment is a pure function of ``(plan, seed)`` — two
+  fresh plans from the same dict fire identically;
+- the UNARMED hot path pays nothing: no ``faults.fire`` call at all
+  (proven by patching ``fire`` to raise), zero compiles, no new locks;
+- a kill injected at any ``save()`` step leaves a checkpoint that
+  LOADS — partial artifacts are counted misses, never wrong answers;
+- degraded-quorum output is bitwise-equal to an offline recompute of
+  the surviving-subset aggregate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import faults, telemetry
+from spark_bagging_tpu.serving import (
+    DeadlineExceeded,
+    Degraded,
+    EnsembleExecutor,
+    MicroBatcher,
+    ModelRegistry,
+)
+from spark_bagging_tpu.serving import program_cache
+from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.enable()
+    yield
+    faults.disarm()  # no chaos plan may leak into later tests
+
+
+def _counter(name, labels=None):
+    return telemetry.registry().counter(name, labels=labels).value
+
+
+class _DummyExecutor:
+    """Jax-free executor stand-in: batcher robustness tests must not
+    pay XLA compiles for queueing semantics."""
+
+    task = "regression"
+    n_features = 4
+    classes_ = None
+
+    def __init__(self):
+        self.calls = 0
+        self.fail_next = 0
+
+    def forward(self, X):
+        self.calls += 1
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise faults.TransientFault("injected blip")
+        return X.sum(axis=1)
+
+
+def _fitted(seed=0, width=4, n_estimators=2):
+    from benchmarks.replay import _default_model
+
+    return _default_model(width, n_estimators, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def models():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return _fitted(seed=0), _fitted(seed=1)
+
+
+# -- plan grammar and determinism --------------------------------------
+
+
+class TestFaultPlan:
+    def test_unknown_site_and_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown injection site"):
+            faults.FaultSpec("nope.nope")
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faults.FaultSpec("batcher.submit", "explode", at=[1])
+        with pytest.raises(ValueError, match="needs a trigger"):
+            faults.FaultSpec("batcher.submit", "error")
+        with pytest.raises(ValueError, match="unknown fault-spec keys"):
+            faults.FaultSpec.from_dict(
+                {"site": "batcher.submit", "at": [1], "typo": 1}
+            )
+        with pytest.raises(ValueError, match="poison"):
+            faults.FaultSpec("batcher.worker", "poison", at=[1])
+        with pytest.raises(ValueError, match="at least one spec"):
+            faults.FaultPlan([])
+
+    def test_scheduled_triggers(self):
+        plan = faults.FaultPlan([
+            {"site": "batcher.worker", "action": "error", "at": [2, 4]},
+        ])
+        fired = []
+        for hit in range(1, 6):
+            try:
+                plan.fire("batcher.worker")
+            except faults.FaultInjected:
+                fired.append(hit)
+        assert fired == [2, 4]
+        snap = plan.snapshot()
+        assert snap["hits"] == {"batcher.worker": 5}
+        assert snap["fires"] == {"batcher.worker": 2}
+
+    def test_every_and_times_cap(self):
+        plan = faults.FaultPlan([
+            {"site": "batcher.worker", "action": "error", "every": 3,
+             "times": 2},
+        ])
+        fired = []
+        for hit in range(1, 13):
+            try:
+                plan.fire("batcher.worker")
+            except faults.FaultInjected:
+                fired.append(hit)
+        assert fired == [3, 6]  # times=2 caps the every-3 schedule
+
+    def test_probabilistic_draws_are_seeded(self):
+        spec = {"site": "batcher.worker", "action": "error", "p": 0.3}
+
+        def transcript(seed):
+            plan = faults.FaultPlan([spec], seed=seed)
+            out = []
+            for _ in range(64):
+                try:
+                    plan.fire("batcher.worker")
+                    out.append(0)
+                except faults.FaultInjected:
+                    out.append(1)
+            return out
+
+        assert transcript(7) == transcript(7)  # same seed, same faults
+        assert transcript(7) != transcript(8)  # a seed is a schedule
+
+    def test_roundtrip_and_digest(self, tmp_path):
+        plan = faults.builtin_plan("mixed", seed=3)
+        p = str(tmp_path / "plan.json")
+        plan.save(p)
+        again = faults.FaultPlan.load(p)
+        assert again.digest() == plan.digest()
+        assert again.to_dict() == plan.to_dict()
+        with pytest.raises(ValueError, match="unknown builtin"):
+            faults.builtin_plan("nope")
+
+    def test_actions_raise_their_types(self):
+        for action, exc in (
+            ("error", faults.FaultInjected),
+            ("transient", faults.TransientFault),
+            ("kill", faults.SimulatedKill),
+        ):
+            plan = faults.FaultPlan([
+                {"site": "batcher.worker", "action": action, "at": [1]},
+            ])
+            with pytest.raises(exc):
+                plan.fire("batcher.worker")
+        plan = faults.FaultPlan([
+            {"site": "executor.mesh_forward", "action": "shard",
+             "at": [1], "shard": 2},
+        ])
+        with pytest.raises(faults.ShardFault) as ei:
+            plan.fire("executor.mesh_forward")
+        assert ei.value.shard == 2
+        assert faults.TransientFault("x").transient
+        assert not faults.FaultInjected("x").transient
+
+
+# -- the zero-cost-unarmed contract ------------------------------------
+
+
+def test_unarmed_hot_paths_never_even_call_fire(monkeypatch):
+    """The acceptance gate's 'pays nothing' half: with no plan armed,
+    the probe call itself is skipped (one module-attribute read, no
+    lock, no allocation). Patching fire() to raise proves no hot path
+    reaches it."""
+
+    def boom(*a, **k):  # pragma: no cover — reaching it IS the failure
+        raise AssertionError("faults.fire called while unarmed")
+
+    monkeypatch.setattr(faults, "fire", boom)
+    assert faults.ACTIVE is None
+    ex = _DummyExecutor()
+    # coalesced path
+    b = MicroBatcher(ex, threaded=False)
+    f = b.submit(np.ones((2, 4), np.float32))
+    b.run_pending()
+    assert f.result(0).shape == (2,)
+    # direct-dispatch path (white-box: force the earned mode)
+    b2 = MicroBatcher(ex, threaded=True)
+    b2._mode_direct = True
+    assert b2.submit(np.ones((1, 4), np.float32)).result(1).shape == (1,)
+    b2.close()
+
+
+def test_unarmed_executor_forward_is_probe_free(monkeypatch, models):
+    m1, _ = models
+    ex = EnsembleExecutor(m1, min_bucket_rows=8, max_batch_rows=16)
+    ex.warmup()
+    monkeypatch.setattr(faults, "fire", lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("faults.fire called while unarmed")))
+    X = np.zeros((3, 4), np.float32)
+    c0 = _counter("sbt_serving_compiles_total")
+    ex.forward(X)
+    assert _counter("sbt_serving_compiles_total") == c0  # and no compiles
+
+
+# -- batcher robustness ------------------------------------------------
+
+
+def test_deadline_expiry_sheds_distinctly():
+    """In-queue expiry is DeadlineExceeded + reason="deadline" — not
+    Overloaded, and batch-mates without deadlines still serve."""
+    vt = [100.0]
+    b = MicroBatcher(_DummyExecutor(), threaded=False,
+                     clock=lambda: vt[0])
+    shed0 = _counter("sbt_serving_shed_total", {"reason": "deadline"})
+    f_dead = b.submit(np.ones((1, 4), np.float32), deadline_ms=5)
+    f_live = b.submit(np.ones((1, 4), np.float32))
+    vt[0] += 1.0  # a full virtual second passes before the claim
+    b.run_pending()
+    assert isinstance(f_dead.exception(0), DeadlineExceeded)
+    assert f_live.result(0).shape == (1,)
+    assert _counter("sbt_serving_shed_total",
+                    {"reason": "deadline"}) == shed0 + 1
+    with pytest.raises(ValueError, match="deadline_ms"):
+        b.submit(np.ones((1, 4), np.float32), deadline_ms=0)
+
+
+def test_deadline_not_expired_serves():
+    vt = [100.0]
+    b = MicroBatcher(_DummyExecutor(), threaded=False,
+                     clock=lambda: vt[0])
+    f = b.submit(np.ones((1, 4), np.float32), deadline_ms=50)
+    vt[0] += 0.01  # 10ms < 50ms: still fresh at claim
+    b.run_pending()
+    assert f.result(0).shape == (1,)
+
+
+def test_transient_failures_retry_with_bounded_budget():
+    ex = _DummyExecutor()
+    ex.fail_next = 2
+    b = MicroBatcher(ex, threaded=False, retries=3, retry_backoff_ms=0)
+    r0 = _counter("sbt_serving_retries_total")
+    f = b.submit(np.ones((2, 4), np.float32))
+    b.run_pending()
+    assert f.result(0).shape == (2,)  # absorbed by the retry budget
+    assert _counter("sbt_serving_retries_total") == r0 + 2
+
+    # budget exhausted -> the failure is delivered
+    ex.fail_next = 5
+    f2 = b.submit(np.ones((1, 4), np.float32))
+    b.run_pending()
+    assert isinstance(f2.exception(0), faults.TransientFault)
+
+
+def test_permanent_failure_does_not_consume_retries():
+    class _Perm(_DummyExecutor):
+        def forward(self, X):
+            self.calls += 1
+            raise RuntimeError("permanent")
+
+    perm = _Perm()
+    b = MicroBatcher(perm, threaded=False, retries=5,
+                     retry_backoff_ms=0)
+    r0 = _counter("sbt_serving_retries_total")
+    f = b.submit(np.ones((1, 4), np.float32))
+    b.run_pending()
+    assert isinstance(f.exception(0), RuntimeError)
+    assert _counter("sbt_serving_retries_total") == r0  # not transient
+    assert perm.calls == 1  # no blind re-forwarding of permanent errors
+
+
+def test_poisoned_request_fails_alone_via_bisect():
+    """One marked request in a 4-request coalesced batch: bisection
+    isolates it; the three batch-mates serve with exact results."""
+    b = MicroBatcher(_DummyExecutor(), threaded=False)
+    plan = faults.FaultPlan([
+        {"site": "batcher.submit", "action": "poison", "at": [2]},
+    ])
+    b0 = _counter("sbt_serving_batch_bisects_total")
+    rf0 = _counter("sbt_serving_request_failures_total")
+    with faults.armed(plan):
+        futs = [b.submit(np.full((1, 4), i, np.float32))
+                for i in range(4)]
+        b.run_pending()
+    assert isinstance(futs[1].exception(0), faults.PoisonedRequest)
+    for i in (0, 2, 3):
+        assert float(futs[i].result(0)[0]) == i * 4.0
+    assert _counter("sbt_serving_batch_bisects_total") > b0
+    assert _counter("sbt_serving_request_failures_total") == rf0 + 1
+
+
+def test_bisect_disabled_fails_whole_batch_together():
+    b = MicroBatcher(_DummyExecutor(), threaded=False,
+                     bisect_on_error=False)
+    plan = faults.FaultPlan([
+        {"site": "batcher.submit", "action": "poison", "at": [1]},
+    ])
+    with faults.armed(plan):
+        futs = [b.submit(np.ones((1, 4), np.float32)) for _ in range(3)]
+        b.run_pending()
+    for f in futs:
+        assert isinstance(f.exception(0), faults.PoisonedRequest)
+
+
+def test_direct_dispatch_honors_the_retry_contract():
+    """retries= applies on the adaptive direct path too — the path
+    that serves most low-concurrency traffic must not silently skip
+    the recovery ladder (review finding)."""
+    ex = _DummyExecutor()
+    ex.fail_next = 2
+    b = MicroBatcher(ex, threaded=True, retries=3, retry_backoff_ms=0)
+    b._mode_direct = True  # white-box: the earned mode
+    r0 = _counter("sbt_serving_retries_total")
+    try:
+        f = b.submit(np.ones((1, 4), np.float32))
+        assert f.result(5).shape == (1,)
+        assert _counter("sbt_serving_retries_total") == r0 + 2
+        # terminal direct-path failures count as request failures too
+        ex.fail_next = 9
+        rf0 = _counter("sbt_serving_request_failures_total")
+        b._mode_direct = True
+        f2 = b.submit(np.ones((1, 4), np.float32))
+        assert isinstance(f2.exception(5), faults.TransientFault)
+        assert _counter("sbt_serving_request_failures_total") == rf0 + 1
+    finally:
+        b.close()
+
+
+def test_worker_crash_mid_batch_never_strands_claimed_futures(
+        monkeypatch):
+    """A crash escaping even the batch guards (a dying sink, not just
+    the injected worker probe) must fail the futures that batch had
+    claimed BEFORE the supervisor takes over — a restarted worker
+    never revisits them (review finding)."""
+    b = MicroBatcher(_DummyExecutor(), threaded=True,
+                     direct_dispatch=False)
+
+    def boom(live, token):
+        raise RuntimeError("sink died in the scatter span")
+
+    monkeypatch.setattr(b, "_run_batch_held", boom)
+    try:
+        f = b.submit(np.ones((1, 4), np.float32))
+        err = f.exception(10)  # NOT a hang
+        assert isinstance(err, RuntimeError)
+        assert "crashed mid-batch" in str(err)
+    finally:
+        b.close()
+
+
+def test_worker_crash_is_supervised_and_restarted():
+    b = MicroBatcher(_DummyExecutor(), threaded=True,
+                     direct_dispatch=False)
+    c0 = _counter("sbt_serving_worker_crashes_total")
+    s0 = _counter("sbt_serving_worker_restarts_total")
+    plan = faults.FaultPlan([
+        {"site": "batcher.worker", "action": "error", "at": [1]},
+    ])
+    try:
+        with faults.armed(plan):
+            f = b.submit(np.ones((1, 4), np.float32))
+            assert isinstance(f.exception(10), RuntimeError)
+        # the supervisor restarts a fresh worker; traffic resumes
+        f2 = b.submit(np.ones((1, 4), np.float32))
+        assert f2.result(10).shape == (1,)
+        assert _counter("sbt_serving_worker_crashes_total") == c0 + 1
+        assert _counter("sbt_serving_worker_restarts_total") == s0 + 1
+        assert b.health()["worker_alive"]
+    finally:
+        b.close()
+
+
+def test_crash_loop_trips_degraded_reject_mode():
+    """N crashes inside the window => degraded reject: /healthz goes
+    unhealthy, submits shed with Degraded, exactly ONE flight dump for
+    the incident, revive() recovers."""
+    rec = FlightRecorder(cooldown_s=120)
+    rec.arm()
+    b = MicroBatcher(_DummyExecutor(), threaded=True,
+                     direct_dispatch=False,
+                     crash_loop_threshold=2, crash_loop_window_s=60)
+    plan = faults.FaultPlan([
+        {"site": "batcher.worker", "action": "error", "every": 1,
+         "times": 16},
+    ])
+    loops0 = _counter("sbt_serving_crash_loops_total")
+    shed0 = _counter("sbt_serving_shed_total", {"reason": "degraded"})
+    try:
+        with faults.armed(plan):
+            for _ in range(2):
+                f = b.submit(np.ones((1, 4), np.float32))
+                f.exception(10)  # each claim crashes the worker once
+            deadline = time.monotonic() + 10
+            while (not b.health()["degraded"]
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            health = b.health()
+            assert health["degraded"] and not health["healthy"]
+            with pytest.raises(Degraded):
+                b.submit(np.ones((1, 4), np.float32))
+        assert _counter("sbt_serving_crash_loops_total") == loops0 + 1
+        assert _counter("sbt_serving_shed_total",
+                        {"reason": "degraded"}) > shed0
+        # one incident, one dump (cooldown covers the whole window).
+        # The dump is written synchronously on the WORKER thread and a
+        # full-session registry snapshot is large — poll rather than
+        # racing the write
+        deadline = time.monotonic() + 15
+        crash_dumps: list = []
+        while time.monotonic() < deadline:
+            crash_dumps = [
+                p for p in rec.dumps
+                if json.load(open(p)).get("trigger", {}).get("kind")
+                == "serving_crash_loop"
+            ]
+            if crash_dumps:
+                break
+            time.sleep(0.05)
+        assert len(crash_dumps) == 1
+        # plan disarmed by the context manager: revive and serve again
+        b.revive()
+        assert b.health()["healthy"]
+        f = b.submit(np.ones((1, 4), np.float32))
+        assert f.result(10).shape == (1,)
+    finally:
+        rec.disarm()
+        b.close()
+
+
+# -- crash-safe registry -----------------------------------------------
+
+
+def test_swap_rolls_back_on_precompile_failure(models):
+    m1, m2 = models
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    ex1 = reg.register("m", m1, warmup=True)
+    X = np.zeros((3, 4), np.float32)
+    before = ex1.predict_proba(X)
+    f0 = _counter("sbt_serving_swap_failed_total")
+    plan = faults.FaultPlan([
+        {"site": "registry.swap.precompile", "action": "error",
+         "at": [1]},
+    ])
+    with faults.armed(plan):
+        with pytest.raises(RuntimeError, match="rolled back"):
+            reg.swap("m", m2, warm=True)
+    # the prior executor keeps serving, version unbumped, failure
+    # counted as its own incident kind (not a contract rejection)
+    assert reg.executor("m") is ex1
+    assert reg.version("m") == 1
+    np.testing.assert_array_equal(reg.executor("m").predict_proba(X),
+                                  before)
+    assert _counter("sbt_serving_swap_failed_total") == f0 + 1
+    # and a clean swap afterwards works
+    reg.swap("m", m2)
+    assert reg.version("m") == 2
+
+
+def test_swap_rolls_back_on_program_cache_fault(models):
+    m1, m2 = models
+    # cold unified cache: the warm pre-compile must actually reach
+    # cache().put for the armed fault to land there
+    program_cache.clear()
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    ex1 = reg.register("m", m1, warmup=True)
+    plan = faults.FaultPlan([
+        {"site": "program_cache.put", "action": "error", "at": [1]},
+    ])
+    with faults.armed(plan):
+        with pytest.raises(RuntimeError, match="rolled back"):
+            reg.swap("m", m2, warm=True)
+    assert reg.executor("m") is ex1 and reg.version("m") == 1
+
+
+@pytest.mark.parametrize("site", [
+    "checkpoint.write",
+    "registry.save.checkpoint",
+    "registry.save.aot",
+    "registry.save.manifest",
+])
+def test_torn_save_always_leaves_a_loadable_checkpoint(
+        site, models, tmp_path):
+    """Kill save() at every injected point between checkpoint write,
+    AOT dir write, and serve_config rename: load() must always
+    succeed, serve answers bitwise-consistent with whatever weights it
+    loaded, and treat partial artifacts as counted misses — never
+    wrong answers."""
+    m1, m2 = models
+    path = str(tmp_path / "ckpt")
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    reg.register("m", m1, warmup=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reg.save("m", path)  # clean publish of version 1 (m1)
+        reg.swap("m", m2)
+    plan = faults.FaultPlan([
+        {"site": site, "action": "kill", "at": [1]},
+    ])
+    with faults.armed(plan):
+        with pytest.raises(faults.SimulatedKill):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                reg.save("m", path)
+    # fresh-process simulation: cold program cache, fresh registry
+    program_cache.clear()
+    reg2 = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ex = reg2.load("m", path)  # must not raise at ANY kill point
+    loaded = reg2.model("m")
+    fp = program_cache.fingerprint_model(loaded)
+    if site == "checkpoint.write":
+        # the kill landed before the checkpoint's atomic swap: the
+        # prior version (m1, the clean v1 publish) is fully intact —
+        # weights, AOT, and manifest all still consistent
+        assert fp == program_cache.fingerprint_model(m1)
+        assert reg2.version("m") == 1
+    else:
+        # the checkpoint itself completed (m2) and everything after
+        # it is partial; whatever loaded must be m2's weights
+        assert fp == program_cache.fingerprint_model(m2)
+    # the never-wrong-answers gate: served output is bitwise-equal to
+    # the loaded weights' own batch predict
+    X = np.asarray(
+        np.random.default_rng(5).normal(size=(4, 4)), np.float32
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ex.predict_proba(X)),
+        np.asarray(loaded.predict_proba(X)),
+    )
+
+
+def test_stale_serve_config_detected_by_fingerprint(models, tmp_path):
+    """The manifest binds itself to its weights: a serve_config left
+    next to DIFFERENT weights (the torn-save signature, or an operator
+    copying checkpoints by hand) is ignored with a warning instead of
+    publishing a wrong version number."""
+    import shutil
+
+    m1, m2 = models
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    reg.register("m", m1, warmup=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reg.save("m", p1)
+        reg.swap("m", m2)
+        reg.save("m", p1)  # clean v2 publish at p1
+        # hand-build the torn state at p2: m1's weights under m2's
+        # serve_config
+        reg3 = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        reg3.register("m", m1, warmup=True)
+        reg3.save("m", p2)
+    shutil.copy(os.path.join(p1, "serve_config.json"),
+                os.path.join(p2, "serve_config.json"))
+    # poison the stale manifest's executor section too: neither its
+    # version NOR its config may be adopted (review finding)
+    cfg_path = os.path.join(p2, "serve_config.json")
+    cfg = json.load(open(cfg_path))
+    cfg["executor"]["max_batch_rows"] = 999
+    json.dump(cfg, open(cfg_path, "w"))
+    program_cache.clear()
+    reg2 = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    with pytest.warns(UserWarning, match="does not match the checkpoint"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("always")
+            reg2.load("m", p2)
+    # the stale manifest's version (2) was NOT adopted
+    assert reg2.version("m") == 1
+    assert (program_cache.fingerprint_model(reg2.model("m"))
+            == program_cache.fingerprint_model(m1))
+    # ...and neither was its executor config: the caller's (registry
+    # default) ladder won, not the stale manifest's 999
+    assert reg2.executor("m").max_batch_rows == 16
+
+
+# -- degraded-quorum mesh serving --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_setup():
+    import jax
+
+    from spark_bagging_tpu.parallel import make_mesh
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = _fitted(seed=0, width=8, n_estimators=8)
+    mesh = make_mesh(data=1, replica=4, devices=jax.devices()[:4])
+    return model, mesh
+
+
+def test_shard_loss_degrades_to_surviving_quorum_bitwise(mesh_setup):
+    """An injected shard failure drops the shard, serving continues on
+    the surviving-replica aggregate with degraded=true telemetry, and
+    the output is BITWISE-equal to a fresh offline recompute of the
+    surviving-subset aggregate. reset_degraded() heals bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bagging_tpu.parallel.sharded import replica_subset_serving
+
+    model, mesh = mesh_setup
+    ex = EnsembleExecutor(model, mesh=mesh, min_bucket_rows=8,
+                          max_batch_rows=32)
+    X = np.asarray(
+        np.random.default_rng(1).normal(size=(5, 8)), np.float32
+    )
+    healthy = np.asarray(ex.forward(X))
+    assert not ex.degraded and ex.surviving_replicas is None
+    sf0 = _counter("sbt_serving_shard_failures_total")
+    df0 = _counter("sbt_serving_degraded_forwards_total")
+    sc0 = _counter("sbt_serving_compiles_total")
+    plan = faults.FaultPlan([
+        {"site": "executor.mesh_forward", "action": "shard", "at": [1],
+         "shard": 1},
+    ])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.armed(plan):
+            served = np.asarray(ex.forward(X))
+    assert ex.degraded and ex.failed_shards == (1,)
+    assert ex.surviving_replicas == 6  # 8 replicas, shard of 2 lost
+    assert _counter("sbt_serving_shard_failures_total") == sf0 + 1
+    assert _counter("sbt_serving_degraded_forwards_total") > df0
+    # degraded compiles are their own counter — the serving
+    # zero-post-warmup-compile gate is untouched by the fault response
+    assert _counter("sbt_serving_compiles_total") == sc0
+    assert telemetry.registry().gauge("sbt_serving_degraded").value == 1.0
+
+    # the bitwise contract: fresh offline recompute of the surviving
+    # subset aggregate, same construction, padded to the same bucket
+    survivors = [i for i in range(8) if i // 2 != 1]
+    fn, _, p, s = replica_subset_serving(model, survivors)
+    Xp = np.zeros((8, 8), np.float32)
+    Xp[:5] = X
+    compiled = jax.jit(fn).lower(
+        p, s, jnp.zeros((8, 8), jnp.float32)
+    ).compile()
+    offline = np.asarray(compiled(p, s, Xp))[:5]
+    np.testing.assert_array_equal(served, offline)
+    assert not np.array_equal(served, healthy)  # 6 != 8 replicas
+
+    # healing restores the exact healthy bits
+    assert ex.reset_degraded()
+    np.testing.assert_array_equal(np.asarray(ex.forward(X)), healthy)
+    assert not ex.degraded
+    assert telemetry.registry().gauge("sbt_serving_degraded").value == 0.0
+
+
+def test_degrade_api_validates(mesh_setup, models):
+    model, mesh = mesh_setup
+    ex = EnsembleExecutor(model, mesh=mesh, min_bucket_rows=8,
+                          max_batch_rows=32)
+    with pytest.raises(ValueError, match="shard must be in"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ex.degrade_shards([9])
+    single = EnsembleExecutor(models[0], min_bucket_rows=8,
+                              max_batch_rows=16)
+    with pytest.raises(ValueError, match="mesh-serving only"):
+        single.degrade_shards([0])
+    assert not single.reset_degraded()  # healthy no-op
+
+
+# -- chaos replay ------------------------------------------------------
+
+
+def test_chaos_replay_is_deterministic_across_repeats(models):
+    """The acceptance drill in-process: a mixed chaos plan over the
+    deterministic replay — identical fault/retry/shed/failure counts
+    and byte-identical digests across repeats (replay_median raises on
+    any divergence), zero post-warmup compiles."""
+    from benchmarks.replay import replay_median
+    from spark_bagging_tpu.telemetry import workload as workload_mod
+
+    m1, _ = models
+    wl = workload_mod.synthetic_workload(
+        "poisson", rate_rps=200, duration_s=0.4, seed=0, rows=1,
+        width=4,
+    )
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=64)
+    reg.register("replay", m1, warmup=True)
+    spec = faults.builtin_plan_spec("mixed", seed=0)
+    report = replay_median(
+        wl, repeats=2, registry=reg, model_name="replay",
+        chaos=spec, retries=2,
+    )
+    chaos = report["chaos"]
+    assert chaos["plan"] == "mixed"
+    assert chaos["sites"]["fired_total"] > 0
+    # every injected transient was retried; every poisoned request
+    # failed alone and is accounted as an error, nothing else is
+    assert chaos["retries"] > 0
+    assert report["errors"] == chaos["request_failures"] > 0
+    assert report["served"] + report["errors"] == report["n_requests"]
+    assert report["post_warmup_compiles"] == 0
+    assert chaos["shed"] == {"overload": 0, "deadline": 0,
+                             "degraded": 0}
+    assert faults.ACTIVE is None  # replay disarmed on the way out
+
+
+def test_chaos_replay_cli_gate(tmp_path):
+    """`python -m benchmarks.replay --chaos mixed --check` exits 0:
+    byte-identical digests + identical fault transcripts across
+    repeats, SLO gate green. Budget-asserted like the other replay CLI
+    smokes."""
+    out = str(tmp_path / "report.json")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.replay",
+         "--chaos", "mixed", "--check",
+         "--duration", "0.4", "--rate", "150",
+         "--n-estimators", "4", "--width", "8",
+         "--out", out],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=240,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, (
+        f"chaos replay gate failed:\n{proc.stdout[-2000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )
+    assert elapsed < 60, (
+        f"chaos CLI smoke took {elapsed:.1f}s — budget is 60s; move "
+        "it to slow or shrink the workload"
+    )
+    report = json.load(open(out))
+    assert report["chaos"]["sites"]["fired_total"] > 0
+    assert report["slo"]["ok"]
+
+
+def test_chaos_rejects_unknown_plan_and_drift_combo():
+    from benchmarks.replay import main
+
+    with pytest.raises(SystemExit):
+        main(["--chaos", "not-a-plan"])
+    with pytest.raises(SystemExit):
+        main(["--chaos", "mixed", "--drift"])
+    # worker-only plans never fire in virtual mode (stepped batchers
+    # run no worker): the CLI must reject the vacuous combination
+    # rather than exit 0 having tested nothing (review finding)
+    for plan in ("worker-crash", "crash-loop"):
+        with pytest.raises(SystemExit):
+            main(["--chaos", plan])

@@ -76,6 +76,7 @@ def test_nested_param_get_set():
         est.set_params(no_such_param=1)
 
 
+@pytest.mark.slow  # ~5s [PR 11 budget offset]: full sklearn GridSearchCV sweep (many refits); get/set_params and cross_val_score compatibility stay tier-1
 def test_grid_search(cancer):
     X, y = cancer
     X = StandardScaler().fit_transform(X).astype(np.float32)

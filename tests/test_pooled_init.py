@@ -153,6 +153,7 @@ class TestPooledInit:
         GeneralizedLinearRegression(family="poisson", link="log",
                                     init="pooled")
 
+    @pytest.mark.slow  # ~7s [PR 11 budget offset]: SVC pooled-vs-cold accuracy sweep; pooled-init optimum parity stays tier-1 via the GLM/logistic variants
     def test_svc_pooled_matches_cold_accuracy(self, breast_cancer):
         from spark_bagging_tpu.models.svm import LinearSVC
 

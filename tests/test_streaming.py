@@ -305,6 +305,7 @@ def test_stream_tree_single_chunk_matches_inmemory_exactly(cancer):
         )
 
 
+@pytest.mark.slow  # ~6s [PR 11 budget offset]: multi-chunk accuracy band; the multi-chunk parity + determinism contracts stay tier-1 via faster tree-stream tests
 def test_stream_tree_multi_chunk_accuracy(cancer):
     X, y = cancer
     mem = BaggingClassifier(
@@ -321,6 +322,7 @@ def test_stream_tree_multi_chunk_accuracy(cancer):
     assert r["fits_per_sec"] > 0 and r["n_chunks"] == 5
 
 
+@pytest.mark.slow  # ~6s [PR 11 budget offset]: same-seed repeat determinism; byte-determinism is continuously enforced by the replay digests in tier-1
 def test_stream_tree_deterministic(cancer):
     X, y = cancer
     kw = dict(
@@ -388,6 +390,7 @@ def test_stream_oob_on_mesh_matches_unsharded(cancer):
     assert m.oob_score_ == pytest.approx(u.oob_score_, abs=0.02)
 
 
+@pytest.mark.slow  # ~9s [PR 11 budget offset]: data-mesh rejection drill fits a full stream bag to reach one ValueError; the replica-mesh OOB parity stays tier-1
 def test_stream_oob_tree_data_mesh_rejected(cancer):
     """Data-sharded tree streams fold the shard index into draws — OOB
     regeneration cannot replay them; replica-only meshes are fine."""
@@ -761,6 +764,7 @@ class _KillAfterScans(_ChunkSource):
         yield from self._inner.chunks()
 
 
+@pytest.mark.slow  # ~7s [PR 11 budget offset]: full interrupt+resume stream fit; checkpoint round-trip correctness stays tier-1 in test_checkpoint
 def test_tree_stream_checkpoint_resume(cancer, tmp_path):
     from spark_bagging_tpu.models import DecisionTreeClassifier
 
@@ -815,6 +819,7 @@ def test_tree_stream_resume_rejects_config_change(cancer, tmp_path):
 # ---------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~8s [PR 11 budget offset]: replica-mesh streamed-tree parity re-fits two full stream bags; serving-side mesh parity stays tier-1 in test_serving_sharded
 def test_tree_stream_replica_mesh_matches_unsharded(cancer):
     """Replica-only mesh: no data fold_in, so the streamed tree fit is
     numerically identical to the unsharded stream fit."""

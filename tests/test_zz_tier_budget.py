@@ -1,0 +1,47 @@
+"""Tier-1 wall-clock budget ratchet [ROADMAP item 5, ISSUE 11].
+
+The tier-1 ceiling (the 870 s ``timeout`` in the verify command) used
+to be rediscovered the hard way: the tree grew until a run hit RC 124.
+This file IS the continuous enforcement — it sorts last by filename
+(the tier runs with ``-p no:randomly``, so collection order is file
+order), measures the session's own elapsed wall-clock against the
+allocation, and fails with an actionable message while the run still
+finishes under the hard timeout.
+
+The allocation is deliberately BELOW the ceiling (90%): the ratchet
+must fire before the cliff, not be killed by it. When it trips, the
+fix is the PR-9/PR-11 discipline — move an equivalent amount of
+existing heavyweight tests to ``slow`` (with per-test reason comments)
+or restructure the tier — never raising the allocation to make the
+light turn green.
+"""
+
+import time
+
+import pytest
+
+#: the tier-1 verify command's hard timeout (ROADMAP)
+TIER1_CEILING_S = 870.0
+#: the ratchet fires at 90% — early warning, not post-mortem
+TIER1_ALLOCATION_S = 0.9 * TIER1_CEILING_S
+
+#: a session smaller than this is a targeted run (-k, one file), not
+#: the tier — the ratchet only means something over the full suite
+FULL_TIER_MIN_ITEMS = 600
+
+
+def test_tier1_wall_clock_within_allocation(request):
+    collected = request.session.testscollected
+    if collected < FULL_TIER_MIN_ITEMS:
+        pytest.skip(
+            f"partial session ({collected} items): the budget ratchet "
+            "gates only full tier-1 runs"
+        )
+    elapsed = time.monotonic() - request.config._sbt_tier_t0
+    assert elapsed < TIER1_ALLOCATION_S, (
+        f"tier-1 measured {elapsed:.0f}s against its "
+        f"{TIER1_ALLOCATION_S:.0f}s allocation ({TIER1_CEILING_S:.0f}s "
+        "hard ceiling): move heavyweight tests to -m slow (with "
+        "per-test reason comments) or split the tier — do NOT raise "
+        "the allocation"
+    )
