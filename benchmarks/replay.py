@@ -210,6 +210,62 @@ def _payloads(workload, n_features: int, seed: int, *,
     return payload
 
 
+def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
+    """Walk the served futures in request order and fold what the
+    tracing plane observed into digests + latency stats — the shared
+    back half of :func:`replay` and :func:`replay_fleet`. Returns
+    ``out_h``/``comp_h`` (sha256 objects over output bytes and batch
+    composition), sorted ``latencies``, ``forward_ms``, ``errors``,
+    ``served``."""
+    import numpy as np
+
+    out_h = hashlib.sha256()
+    comp_h = hashlib.sha256()
+    latencies: list[float] = []
+    forward_ms = 0.0
+    errors = 0
+    served = 0
+    batch_first_seen: dict[str, int] = {}
+    composition: list[tuple] = []
+    for idx in sorted(futs):
+        f = futs[idx]
+        try:
+            err = f.exception(timeout_s)
+        except Exception as e:  # noqa: BLE001 — a future still RUNNING
+            # (wedged device forward survived close()'s join timeout)
+            # raises TimeoutError here; a report with the request
+            # counted as an error beats a traceback with no report
+            err = e
+        tr = getattr(f, "trace", None)
+        bd = tr.breakdown if tr is not None else {}
+        if err is not None:
+            errors += 1
+            continue
+        served += 1
+        res = f.result(0)
+        arr = np.asarray(res)
+        out_h.update(str(arr.shape).encode())
+        out_h.update(str(arr.dtype).encode())
+        out_h.update(arr.tobytes())
+        if bd:
+            latencies.append(bd["total_ms"])
+            forward_ms += bd.get("forward_ms") or 0.0
+            bid = bd.get("batch_trace_id") or "?"
+            batch = batch_first_seen.setdefault(
+                bid, len(batch_first_seen)
+            )
+            composition.append(
+                (idx, batch, bd.get("batch_size"),
+                 str(bd.get("bucket")))
+            )
+    comp_h.update(json.dumps(composition).encode())
+    latencies.sort()
+    return {
+        "out_h": out_h, "comp_h": comp_h, "latencies": latencies,
+        "forward_ms": forward_ms, "errors": errors, "served": served,
+    }
+
+
 class ThrottledExecutor:
     """Executor wrapper adding a fixed host-side delay per forward —
     the scripted 'someone slowed the hot path' regression the SLO gate
@@ -272,8 +328,6 @@ def replay(
     registry operation). Telemetry is force-enabled for the drive (the
     report is BUILT from the tracing plane's breakdowns).
     """
-    import numpy as np
-
     from spark_bagging_tpu import telemetry
     from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
 
@@ -510,47 +564,13 @@ def replay(
             target.detach_quality()
 
     # -- collect what the tracing plane observed -----------------------
-    out_h = hashlib.sha256()
-    comp_h = hashlib.sha256()
-    latencies: list[float] = []
-    forward_ms = 0.0
-    errors = 0
-    served = 0
-    batch_first_seen: dict[str, int] = {}
-    composition: list[tuple] = []
-    for idx in sorted(futs):
-        f = futs[idx]
-        try:
-            err = f.exception(timeout_s)
-        except Exception as e:  # noqa: BLE001 — a future still RUNNING
-            # (wedged device forward survived close()'s join timeout)
-            # raises TimeoutError here; a report with the request
-            # counted as an error beats a traceback with no report
-            err = e
-        tr = getattr(f, "trace", None)
-        bd = tr.breakdown if tr is not None else {}
-        if err is not None:
-            errors += 1
-            continue
-        served += 1
-        res = f.result(0)
-        arr = np.asarray(res)
-        out_h.update(str(arr.shape).encode())
-        out_h.update(str(arr.dtype).encode())
-        out_h.update(arr.tobytes())
-        if bd:
-            latencies.append(bd["total_ms"])
-            forward_ms += bd.get("forward_ms") or 0.0
-            bid = bd.get("batch_trace_id") or "?"
-            batch = batch_first_seen.setdefault(
-                bid, len(batch_first_seen)
-            )
-            composition.append(
-                (idx, batch, bd.get("batch_size"),
-                 str(bd.get("bucket")))
-            )
-    comp_h.update(json.dumps(composition).encode())
-    latencies.sort()
+    collected = _collect_futures(futs, timeout_s)
+    out_h = collected["out_h"]
+    comp_h = collected["comp_h"]
+    latencies = collected["latencies"]
+    forward_ms = collected["forward_ms"]
+    errors = collected["errors"]
+    served = collected["served"]
 
     c1 = {name: counter(name) for name in c0}
     rows_d = c1["sbt_serving_rows_total"] - c0["sbt_serving_rows_total"]
@@ -682,6 +702,354 @@ def replay(
     }
 
 
+def replay_fleet(
+    workload,
+    *,
+    model,
+    fleet: int = 3,
+    seed: int = 0,
+    chaos: dict | None = None,
+    retries: int = 0,
+    retry_backoff_ms: float = 0.0,
+    roll_at: float = 0.35,
+    max_delay_ms: float = 2.0,
+    idle_flush_ms: float = 1.0,
+    max_batch_rows: int = 256,
+    max_queue: int = 1024,
+    min_bucket_rows: int = 8,
+    bucket_max_rows: int = 256,
+    warmup: bool = True,
+    timeout_s: float = 120.0,
+) -> dict:
+    """The fleet observability drill: ``fleet`` virtual peer processes
+    — each its OWN telemetry registry (``fleet.use_registry``), model
+    registry, and stepped batcher — served round-robin from one
+    workload on the virtual clock, under one
+    :class:`~spark_bagging_tpu.telemetry.fleet.FleetAggregator` ticked
+    once per coalescing window. Mid-replay the peers roll through a
+    version-2 swap one at a time (same fitted estimator, so outputs
+    stay bitwise-identical while the version plane moves), which the
+    aggregator must observe as skew rising above 0 and returning to 0
+    — the swap-convergence transcript. ``chaos`` arms a seeded fault
+    plan over the drive (``peer-loss`` injects scrape failures: fleet
+    health must degrade and recover). Everything the report digests —
+    merged metrics (deterministic plane), skew transcript, incident
+    timeline, fault transcript — is a pure function of
+    ``(workload, seed, plan)``, asserted across ``replay_median``
+    repeats. Virtual mode only: the drill IS the window/tick
+    interleaving, and a wall-clock worker would unmake it."""
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.serving import ModelRegistry
+    from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
+    from spark_bagging_tpu.telemetry import fleet as fleet_mod
+    from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+    from spark_bagging_tpu.telemetry.registry import Registry
+
+    if fleet < 2:
+        raise ValueError(f"a fleet drill needs >= 2 peers, got {fleet}")
+    telemetry.enable()
+    requests = workload.requests
+    if not requests:
+        raise ValueError("empty workload")
+    dur = workload.duration_s or 1.0
+
+    peers: list[dict] = []
+    for i in range(fleet):
+        reg = Registry()
+        with fleet_mod.use_registry(reg):
+            models = ModelRegistry(
+                min_bucket_rows=min_bucket_rows,
+                max_batch_rows=bucket_max_rows,
+            )
+            models.register("replay", model, warmup=warmup, version=1)
+            batcher = MicroBatcher(
+                (lambda m=models: m.executor("replay")),
+                max_delay_ms=max_delay_ms,
+                idle_flush_ms=idle_flush_ms,
+                max_batch_rows=max_batch_rows,
+                max_queue=max_queue,
+                threaded=False,
+                retries=retries,
+                retry_backoff_ms=retry_backoff_ms,
+            )
+        peers.append({
+            "name": f"p{i}", "registry": reg,
+            "models": models, "batcher": batcher,
+        })
+
+    # fleet rules on the drill's virtual timescale (the drift-drill
+    # convention): peer-lost windows small enough that the peer-loss
+    # plan's scripted outage sustains them; skew windows WIDER than a
+    # healthy roll's excursion so the clean drill fires nothing
+    rules = fleet_mod.default_fleet_rules(
+        skew_fast_s=dur * 0.10, skew_slow_s=dur * 0.30,
+        peer_fast_s=dur * 0.02, peer_slow_s=dur * 0.08,
+        burn_fast_s=dur * 0.10, burn_slow_s=dur * 0.30,
+        cooldown_s=dur * 10,
+    )
+    agg = fleet_mod.FleetAggregator(
+        [fleet_mod.RegistryPeer(p["name"], p["registry"])
+         for p in peers],
+        interval_s=0.0, rules=rules,
+        correlation_window_s=dur * 0.1,
+        stale_after_s=dur * 100,
+    )
+    plan = None
+    if chaos is not None:
+        from spark_bagging_tpu import faults as faults_mod
+
+        spec = chaos if isinstance(chaos, dict) else chaos.to_dict()
+        plan = faults_mod.FaultPlan.from_dict(spec)
+
+    n_features = peers[0]["models"].executor("replay").n_features
+    payload = _payloads(workload, n_features, seed)
+
+    def fleet_counter(name: str, labels: dict | None = None) -> float:
+        total = 0.0
+        for p in peers:
+            m = p["registry"].peek(name, labels)
+            if m is not None:
+                total += float(m.value)
+        return total
+
+    windows = plan_windows(
+        requests,
+        max_delay_s=max_delay_ms / 1e3,
+        idle_flush_s=idle_flush_ms / 1e3,
+    )
+    W = len(windows)
+    # rolling swap schedule: peer i at window roll0 + i*gap; the whole
+    # roll spans < the skew-stalled fast window so a HEALTHY roll
+    # never pages, with ticks left after the last swap to observe
+    # skew returning to 0
+    gap = max(1, W // (12 * fleet))
+    roll0 = max(1, int(roll_at * W))
+    if roll0 + (fleet - 1) * gap >= W - 1:
+        gap = 1
+        roll0 = max(1, W - fleet - 2)
+        if roll0 + (fleet - 1) * gap >= W - 1:
+            raise ValueError(
+                f"workload too short for a {fleet}-peer rolling-swap "
+                f"drill ({W} coalescing windows); lengthen it or "
+                "lower --fleet"
+            )
+    swap_windows = {roll0 + i * gap: i for i in range(fleet)}
+
+    # a dedicated recorder, like the drift drill: its dump count is
+    # this run's incident count, disarmed in finally. Armed only now
+    # — after every argument/plan/schedule validation that can raise
+    # — so an early ValueError can never leak an armed process-global
+    # sink nobody holds a reference to
+    flight = FlightRecorder(cooldown_s=dur * 10)
+    flight.arm()
+
+    c0_compiles = fleet_counter("sbt_serving_compiles_total")
+    chaos_c0 = {
+        name: fleet_counter(name)
+        for name in (
+            "sbt_serving_retries_total",
+            "sbt_serving_batch_bisects_total",
+            "sbt_serving_request_failures_total",
+        )
+    }
+    shed_reasons = ("overload", "deadline", "degraded")
+    shed0 = {r: fleet_counter("sbt_serving_shed_total",
+                              {"reason": r}) for r in shed_reasons}
+    if plan is not None:
+        from spark_bagging_tpu import faults as faults_mod
+
+        faults_mod.arm(plan)
+
+    futs: dict[int, object] = {}
+    overloads = 0
+    swap_compiles = 0.0
+    transcript: list[dict] = []
+    t_wall0 = time.perf_counter()
+    try:
+        for w_i, window in enumerate(windows):
+            vt = requests[window[0]].t
+            peer_i = swap_windows.get(w_i)
+            if peer_i is not None:
+                p = peers[peer_i]
+                with fleet_mod.use_registry(p["registry"]):
+                    before = fleet_counter("sbt_serving_compiles_total")
+                    # same fitted estimator at version 2: the full
+                    # swap machinery (validation, warm pre-compile,
+                    # version bump) runs while outputs stay bitwise-
+                    # identical — and the VERSION PLANE moves, which
+                    # is what the aggregator is here to see
+                    p["models"].swap(
+                        "replay", p["models"].model("replay"),
+                        version=2,
+                    )
+                    swap_compiles += (
+                        fleet_counter("sbt_serving_compiles_total")
+                        - before
+                    )
+            for idx in window:
+                p = peers[idx % fleet]
+                with fleet_mod.use_registry(p["registry"]):
+                    try:
+                        futs[idx] = p["batcher"].submit(
+                            payload(idx, requests[idx].rows)
+                        )
+                    except Overloaded:
+                        overloads += 1
+            for p in peers:
+                with fleet_mod.use_registry(p["registry"]):
+                    p["batcher"].run_pending()
+            agg.tick(now=vt, force=True)
+            health = agg.fleet_health(now=vt)
+            transcript.append({
+                "t": round(vt, 9),
+                "skew": agg.version_skew().get("replay", 0.0),
+                "fresh": health["fresh"],
+                "healthy": health["healthy"],
+            })
+        wall = time.perf_counter() - t_wall0
+    finally:
+        if plan is not None:
+            from spark_bagging_tpu import faults as faults_mod
+
+            faults_mod.disarm()
+        for p in peers:
+            with fleet_mod.use_registry(p["registry"]):
+                p["batcher"].close()
+        flight.disarm()
+
+    collected = _collect_futures(futs, timeout_s)
+    latencies = collected["latencies"]
+
+    merged = agg.merged_snapshot()
+    timeline = agg.incident_timeline(clock_key="now")
+    skews = [t["skew"] for t in transcript]
+    freshes = [t["fresh"] for t in transcript]
+    alerts_state = agg.alerts.state()
+    fleet_report = {
+        "peers": fleet,
+        "rolling_swaps": fleet,
+        "merged_series": len(merged),
+        "merged_digest": fleet_mod.merged_digest(merged),
+        "skew_transcript": transcript,
+        "skew_digest": hashlib.sha256(
+            json.dumps(transcript, sort_keys=True).encode()
+        ).hexdigest(),
+        "skew_max": max(skews),
+        "skew_final": skews[-1],
+        "converged": bool(max(skews) >= 1 and skews[-1] == 0),
+        "convergence_seconds": {
+            m: [round(v, 9) for v in obs]
+            for m, obs in agg.convergence_observations().items()
+        },
+        "health": {
+            "min_fresh": min(freshes),
+            "final_fresh": freshes[-1],
+            "final_healthy": transcript[-1]["healthy"],
+            "degraded_ticks": sum(1 for f in freshes if f < fleet),
+        },
+        "scrapes": agg.peek("sbt_fleet_scrapes_total").value,
+        "scrape_failures": {
+            p["name"]: agg.peek("sbt_fleet_scrape_failures_total",
+                                {"process": p["name"]}).value
+            for p in peers
+        },
+        "incidents": [
+            {"kind": i["kind"], "key": i["key"],
+             "peers": sorted(i["peers"]), "count": i["count"],
+             "t_start": round(i["t_start"], 9)}
+            for i in timeline["incidents"]
+        ],
+        "incident_digest": timeline["digest"],
+        "alerts": {
+            r["name"]: {k: r[k]
+                        for k in ("fired", "resolved", "suppressed")}
+            for r in alerts_state["rules"]
+        },
+        "flight_dumps": len(flight.dumps),
+    }
+    fleet_report["scrape_failures_total"] = sum(
+        fleet_report["scrape_failures"].values()
+    )
+
+    chaos_report = None
+    if plan is not None:
+        shed1 = {r: fleet_counter("sbt_serving_shed_total",
+                                  {"reason": r}) for r in shed_reasons}
+        chaos_report = {
+            "plan": plan.name,
+            "seed": plan.seed,
+            "plan_digest": plan.digest(),
+            "sites": plan.snapshot(),
+            "retries": int(
+                fleet_counter("sbt_serving_retries_total")
+                - chaos_c0["sbt_serving_retries_total"]
+            ),
+            "bisects": int(
+                fleet_counter("sbt_serving_batch_bisects_total")
+                - chaos_c0["sbt_serving_batch_bisects_total"]
+            ),
+            "request_failures": int(
+                fleet_counter("sbt_serving_request_failures_total")
+                - chaos_c0["sbt_serving_request_failures_total"]
+            ),
+            "degraded_forwards": 0,
+            "shed": {r: int(shed1[r] - shed0[r])
+                     for r in shed_reasons},
+            "degraded": False,
+            "surviving_replicas": None,
+        }
+
+    import jax
+
+    return {
+        "metric": "workload_replay",
+        "schema": REPLAY_SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "mode": "virtual",
+        "speed": 1.0,
+        "seed": seed,
+        "workload": workload.summary(),
+        "workload_digest": workload_digest(workload),
+        "batcher": {
+            "max_delay_ms": max_delay_ms,
+            "idle_flush_ms": idle_flush_ms,
+            "max_batch_rows": max_batch_rows,
+            "max_queue": max_queue,
+        },
+        "burst": 0,
+        "swaps": fleet,
+        "n_requests": len(requests),
+        "served": collected["served"],
+        "errors": collected["errors"],
+        "overloads": overloads,
+        "batches": int(fleet_counter("sbt_serving_batches_total")),
+        "post_warmup_compiles": int(
+            fleet_counter("sbt_serving_compiles_total")
+            - c0_compiles - swap_compiles
+        ),
+        "swap_compiles": int(swap_compiles),
+        "wall_seconds": round(wall, 6),
+        "rps": (round(collected["served"] / wall, 2)
+                if wall > 0 else None),
+        "latency_ms": {
+            "p50": _percentile(latencies, 0.50),
+            "p95": _percentile(latencies, 0.95),
+            "p99": _percentile(latencies, 0.99),
+            "max": latencies[-1] if latencies else None,
+        },
+        "forward_ms_total": round(collected["forward_ms"], 3),
+        "padding": {
+            "rows": int(fleet_counter("sbt_serving_padding_rows_total")),
+        },
+        "model": {"name": "replay", "version": 2},
+        "composition_digest": collected["comp_h"].hexdigest(),
+        "output_digest": collected["out_h"].hexdigest(),
+        "drift": None,
+        "chaos": chaos_report,
+        "fleet": fleet_report,
+    }
+
+
 def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     """Median-of-``repeats`` replay (the BENCH protocol: thread noise
     on small hosts swings single runs; the median is the stable
@@ -697,7 +1065,11 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
 
     if repeats < 1:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
-    runs = [replay(workload, **kwargs) for _ in range(repeats)]
+    fleet = kwargs.get("fleet", 0)
+    drive = replay_fleet if fleet else replay
+    if not fleet:
+        kwargs.pop("fleet", None)  # replay() takes no fleet kwarg
+    runs = [drive(workload, **kwargs) for _ in range(repeats)]
     head = runs[0]
     if head["mode"] == "virtual":
         for r in runs[1:]:
@@ -736,6 +1108,24 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             f"drift.{key} changed "
                             f"({head['drift'][key]!r} -> "
                             f"{r['drift'][key]!r})"
+                        )
+            if head.get("fleet") is not None:
+                # the fleet plane's whole deterministic surface:
+                # merged metrics (deterministic-series projection),
+                # the skew transcript, the incident timeline, the
+                # alert transcript, and the scrape bookkeeping
+                for key in ("merged_digest", "skew_digest",
+                            "incident_digest", "incidents", "alerts",
+                            "skew_max", "skew_final", "converged",
+                            "convergence_seconds", "health",
+                            "scrapes", "scrape_failures",
+                            "flight_dumps"):
+                    if r["fleet"][key] != head["fleet"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"fleet.{key} changed "
+                            f"({head['fleet'][key]!r} -> "
+                            f"{r['fleet'][key]!r})"
                         )
     merged = dict(head)
     merged["repeats"] = repeats
@@ -784,6 +1174,49 @@ def _drift_checks(report: dict) -> list[dict]:
     ]
 
 
+def _fleet_checks(report: dict) -> list[dict]:
+    """The fleet-drill gate: the rolling swap was OBSERVED (skew rose
+    to >= 1) and CONVERGED (final skew 0, with a recorded
+    time-to-convergence observation); under an injected peer outage
+    (scrape failures > 0), quorum health degraded — some tick saw
+    fewer fresh peers than configured — and recovered by the end;
+    without one, no tick ever lost a peer."""
+    f = report.get("fleet") or {}
+    peers = f.get("peers")
+    health = f.get("health") or {}
+
+    def check(name, actual, limit, op, ok) -> dict:
+        return {"name": name, "actual": actual, "limit": limit,
+                "op": op, "ok": bool(ok)}
+
+    skew_max = f.get("skew_max")
+    skew_final = f.get("skew_final")
+    conv = (f.get("convergence_seconds") or {}).get("replay") or []
+    checks = [
+        check("fleet_skew_rose", skew_max, 1, ">=",
+              skew_max is not None and skew_max >= 1),
+        check("fleet_skew_converged", skew_final, 0, "==",
+              skew_final == 0),
+        check("fleet_convergence_observed", len(conv), 1, ">=",
+              len(conv) >= 1),
+    ]
+    if (f.get("scrape_failures_total") or 0) > 0:
+        checks += [
+            check("fleet_health_degraded", health.get("min_fresh"),
+                  peers, "<", (health.get("min_fresh") or 0) < peers),
+            check("fleet_health_recovered",
+                  health.get("final_fresh"), peers, "==",
+                  health.get("final_fresh") == peers
+                  and health.get("final_healthy") is True),
+        ]
+    else:
+        checks.append(
+            check("fleet_quorum_held", health.get("min_fresh"),
+                  peers, "==", health.get("min_fresh") == peers)
+        )
+    return checks
+
+
 def check_report(report: dict, *, spec=None, baseline: dict | None = None,
                  rps_tolerance: float | None = None,
                  latency_tolerance: float | None = None):
@@ -800,6 +1233,9 @@ def check_report(report: dict, *, spec=None, baseline: dict | None = None,
     if report.get("drift") is not None:
         checks += _drift_checks(report)
         kind = "absolute+drift"
+    if report.get("fleet") is not None:
+        checks += _fleet_checks(report)
+        kind += "+fleet"
     if baseline is not None:
         kw = {}
         if rps_tolerance is not None:
@@ -862,6 +1298,16 @@ def main(argv: list[str] | None = None) -> int:
     drv.add_argument("--burst-at", type=float, default=0.5)
     drv.add_argument("--swaps", type=int, default=0,
                      help="hot-swap the model N times mid-replay")
+    drv.add_argument("--fleet", type=int, default=0,
+                     help="drive N virtual peer processes (each its "
+                          "own telemetry registry + model registry + "
+                          "stepped batcher) round-robin under one "
+                          "FleetAggregator on the virtual clock, with "
+                          "a rolling version swap mid-replay — the "
+                          "fleet observability drill: merged-metrics "
+                          "digest, skew transcript (rise -> 0), and "
+                          "incident timeline asserted identical "
+                          "across repeats")
     drv.add_argument("--chaos", default=None,
                      help="splice a seeded fault schedule into the "
                           "replay: a builtin plan name (blips, "
@@ -983,8 +1429,14 @@ def main(argv: list[str] | None = None) -> int:
                 )
         except ValueError as e:
             ap.error(str(e))
+        sites = {f.get("site") for f in chaos_spec.get("faults", ())}
+        if "fleet.scrape" in sites and args.fleet < 2:
+            ap.error(
+                f"--chaos {args.chaos!r} arms fleet.scrape, which "
+                "only fires under a fleet aggregator: combine with "
+                "--fleet N (>= 2)"
+            )
         if args.mode == "virtual":
-            sites = {f.get("site") for f in chaos_spec.get("faults", ())}
             if sites <= {"batcher.worker"}:
                 # virtual mode runs a stepped batcher: no worker
                 # thread exists, so a worker-only plan would arm, fire
@@ -1019,52 +1471,93 @@ def main(argv: list[str] | None = None) -> int:
     if args.save_workload:
         wl.save(args.save_workload)
 
-    reg_opts: dict = dict(
-        min_bucket_rows=args.min_bucket_rows,
-        max_batch_rows=args.bucket_max_rows,
-    )
-    if args.devices:
-        from spark_bagging_tpu.parallel import make_mesh
+    if args.fleet:
+        # the fleet drill builds its own N per-peer registries; the
+        # single-target scenario flags have no meaning over it
+        if args.fleet < 2:
+            ap.error(f"--fleet needs >= 2 peers, got {args.fleet}")
+        if args.mode != "virtual":
+            ap.error("--fleet is a virtual-clock drill (the window/"
+                     "tick interleaving IS the experiment); --mode "
+                     "timed cannot drive it")
+        for flag, val in (("--drift", args.drift),
+                          ("--swaps", args.swaps),
+                          ("--burst", args.burst),
+                          ("--throttle-ms", args.throttle_ms),
+                          ("--devices", args.devices)):
+            if val:
+                ap.error(f"{flag} does not combine with --fleet (the "
+                         "drill scripts its own rolling swap)")
+        if args.model_checkpoint:
+            from spark_bagging_tpu.utils.checkpoint import load_model
 
-        reg_opts["mesh"] = make_mesh(data=1, replica=args.devices)
-    reg = ModelRegistry(**reg_opts)
-    if args.model_checkpoint:
-        reg.load("replay", args.model_checkpoint, warm=True)
-    else:
-        reg.register(
-            "replay",
-            _default_model(width, args.n_estimators, seed=args.seed),
-            warmup=True,
+            model = load_model(args.model_checkpoint)
+        else:
+            model = _default_model(width, args.n_estimators,
+                                   seed=args.seed)
+        report = replay_median(
+            wl, repeats=args.repeats,
+            fleet=args.fleet, model=model,
+            chaos=chaos_spec, retries=retries,
+            retry_backoff_ms=args.retry_backoff_ms,
+            max_delay_ms=args.max_delay_ms,
+            idle_flush_ms=args.idle_flush_ms,
+            max_batch_rows=args.max_batch_rows,
+            max_queue=args.max_queue,
+            min_bucket_rows=args.min_bucket_rows,
+            bucket_max_rows=args.bucket_max_rows,
+            seed=args.seed,
         )
+    else:
+        reg_opts: dict = dict(
+            min_bucket_rows=args.min_bucket_rows,
+            max_batch_rows=args.bucket_max_rows,
+        )
+        if args.devices:
+            from spark_bagging_tpu.parallel import make_mesh
 
-    target: dict = {"registry": reg, "model_name": "replay"}
-    if args.throttle_ms > 0:
-        if args.swaps:
-            ap.error("--throttle-ms wraps a bare executor; it cannot "
-                     "combine with --swaps (a registry operation)")
-        if args.drift:
-            ap.error("--throttle-ms wraps a bare executor with no "
-                     "model attached; it cannot combine with --drift "
-                     "(which needs the model's quality profile)")
-        target = {"executor": ThrottledExecutor(
-            reg.executor("replay"), delay_s=args.throttle_ms / 1e3,
-        )}
+            reg_opts["mesh"] = make_mesh(data=1, replica=args.devices)
+        reg = ModelRegistry(**reg_opts)
+        if args.model_checkpoint:
+            reg.load("replay", args.model_checkpoint, warm=True)
+        else:
+            reg.register(
+                "replay",
+                _default_model(width, args.n_estimators,
+                               seed=args.seed),
+                warmup=True,
+            )
 
-    report = replay_median(
-        wl, repeats=args.repeats, **target,
-        mode=args.mode, speed=args.speed,
-        burst=args.burst, burst_at=args.burst_at, swaps=args.swaps,
-        chaos=chaos_spec, retries=retries,
-        retry_backoff_ms=args.retry_backoff_ms,
-        drift=args.drift, drift_at=args.drift_at,
-        drift_shift=args.drift_shift, drift_scale=args.drift_scale,
-        psi_threshold=args.psi_threshold,
-        max_delay_ms=args.max_delay_ms,
-        idle_flush_ms=args.idle_flush_ms,
-        max_batch_rows=args.max_batch_rows,
-        max_queue=args.max_queue,
-        seed=args.seed,
-    )
+        target: dict = {"registry": reg, "model_name": "replay"}
+        if args.throttle_ms > 0:
+            if args.swaps:
+                ap.error("--throttle-ms wraps a bare executor; it "
+                         "cannot combine with --swaps (a registry "
+                         "operation)")
+            if args.drift:
+                ap.error("--throttle-ms wraps a bare executor with no "
+                         "model attached; it cannot combine with "
+                         "--drift (which needs the model's quality "
+                         "profile)")
+            target = {"executor": ThrottledExecutor(
+                reg.executor("replay"), delay_s=args.throttle_ms / 1e3,
+            )}
+
+        report = replay_median(
+            wl, repeats=args.repeats, **target,
+            mode=args.mode, speed=args.speed,
+            burst=args.burst, burst_at=args.burst_at, swaps=args.swaps,
+            chaos=chaos_spec, retries=retries,
+            retry_backoff_ms=args.retry_backoff_ms,
+            drift=args.drift, drift_at=args.drift_at,
+            drift_shift=args.drift_shift, drift_scale=args.drift_scale,
+            psi_threshold=args.psi_threshold,
+            max_delay_ms=args.max_delay_ms,
+            idle_flush_ms=args.idle_flush_ms,
+            max_batch_rows=args.max_batch_rows,
+            max_queue=args.max_queue,
+            seed=args.seed,
+        )
 
     out = args.out or os.path.join(
         telemetry.telemetry_dir(), "replay_report.json"
@@ -1100,6 +1593,18 @@ def main(argv: list[str] | None = None) -> int:
             "shed": c["shed"],
             "degraded": c["degraded"],
             "errors": report["errors"],
+        }
+    if report.get("fleet") is not None:
+        f = report["fleet"]
+        summary["fleet"] = {
+            "peers": f["peers"],
+            "skew_max": f["skew_max"],
+            "converged": f["converged"],
+            "convergence_s": f["convergence_seconds"].get("replay"),
+            "min_fresh": f["health"]["min_fresh"],
+            "scrape_failures": f["scrape_failures_total"],
+            "incidents": len(f["incidents"]),
+            "merged_digest": f["merged_digest"][:16],
         }
     if report.get("drift") is not None:
         d = report["drift"]

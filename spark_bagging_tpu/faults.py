@@ -86,6 +86,7 @@ SITES: dict[str, str] = {
     "registry.save.manifest": "before the serve_config.json commit rename",
     "checkpoint.write": "inside the checkpoint writer, before its atomic swap",
     "aot.save": "inside save_executables, before its atomic install",
+    "fleet.scrape": "per peer scrape attempt by the fleet aggregator (peer-loss drills)",
 }
 
 ACTIONS = ("error", "transient", "poison", "shard", "kill", "delay")
@@ -441,7 +442,14 @@ def builtin_plan_spec(name: str, seed: int = 0) -> dict[str, Any]:
     - ``worker-crash``: the batcher worker dies and the supervisor
       restarts it;
     - ``crash-loop``: enough worker crashes inside the window to trip
-      degraded reject mode.
+      degraded reject mode;
+    - ``peer-loss``: one fleet peer's scrapes fail for a stretch and
+      recover — the aggregator marks it stale (excluded from merge and
+      quorum, never merged as zeros), fleet health degrades, then
+      heals. Tuned for a 3-peer fleet scraped in construction order
+      (``every=3`` lands on the last peer each tick; ``times=20``
+      bounds the outage so recovery happens inside the replay):
+      ``replay.py --chaos peer-loss --fleet 3``.
 
     The worker drills need a THREADED batcher (``replay.py`` requires
     ``--mode timed`` for them — virtual replay steps a worker-less
@@ -473,6 +481,10 @@ def builtin_plan_spec(name: str, seed: int = 0) -> dict[str, Any]:
         "crash-loop": [
             {"site": "batcher.worker", "action": "error",
              "every": 1, "times": 10},
+        ],
+        "peer-loss": [
+            {"site": "fleet.scrape", "action": "error",
+             "every": 3, "times": 20},
         ],
     }
     if name not in plans:

@@ -314,6 +314,13 @@ class ModelRegistry:
         telemetry.inc("sbt_serving_swaps_total")
         telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})
+        # not a flight-recorder trigger (a swap is routine), but it IS
+        # timeline material: the fleet incident correlator lines swap
+        # commits up against the dumps/alerts/sheds around them
+        telemetry.emit_event({
+            "kind": "model_swapped", "model": name,
+            "version": int(version),
+        })
         if quality_gap is not None:
             # the one attach failure that does NOT roll back: a
             # replacement with no fit-time quality_profile_ (stream

@@ -78,6 +78,7 @@ from spark_bagging_tpu.telemetry.spans import phase, span
 from spark_bagging_tpu.telemetry.state import STATE as _state
 from spark_bagging_tpu.telemetry import (
     alerts,
+    fleet,
     quality,
     recorder,
     slo,
@@ -99,7 +100,7 @@ __all__ = [
     "read_events", "last_metrics_snapshot", "runs",
     "record_fit_report", "Registry", "reset", "telemetry_dir",
     "default_log_path", "tracing", "recorder", "workload", "slo",
-    "quality", "alerts",
+    "quality", "alerts", "fleet",
     "sinks_active", "arrival_events_wanted", "start_server",
     "stop_server", "server_address",
 ]

@@ -7,6 +7,15 @@ an empty registry). With a JSONL event-log path (written by
 snapshot and renders that — the offline way to turn a recorded run
 into a scrape-able dump.
 
+``dump --merge a.jsonl b.jsonl ...`` merges SEVERAL per-process logs
+into one fleet dump through the exact same merge the live
+``FleetAggregator`` uses (``telemetry/fleet.py``): counters sum,
+gauges keep per-process values under a ``process=`` label (derived
+from each file's name) plus ``fleet=min/max/sum`` aggregates, and
+histograms merge bucket-wise — so the dump's ``# quantiles`` lines
+are computed from the union of the processes' bucket counts, never
+from averaged percentiles.
+
 Every histogram additionally gets a ``# quantiles`` comment line with
 its p50/p95/p99 estimate (log-bucket interpolation) — comment lines
 are legal in the exposition format, so the output stays scrape-
@@ -17,6 +26,7 @@ parseable while a human reading the dump gets the SLO trio for free
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -48,8 +58,16 @@ def main(argv: list[str] | None = None) -> int:
         "dump", help="render metrics in Prometheus text format"
     )
     dump.add_argument(
-        "jsonl", nargs="?", default=None,
-        help="JSONL event log to render (default: this process's registry)",
+        "jsonl", nargs="*", default=[],
+        help="JSONL event log(s) to render (default: this process's "
+             "registry; several only with --merge)",
+    )
+    dump.add_argument(
+        "--merge", action="store_true",
+        help="merge the per-process snapshots of SEVERAL event logs "
+             "into one fleet dump (the FleetAggregator's exact merge: "
+             "counters sum, gauges get process= labels + fleet "
+             "min/max/sum, histograms merge bucket-wise)",
     )
     dump.add_argument(
         "--no-quantiles", action="store_true",
@@ -59,16 +77,52 @@ def main(argv: list[str] | None = None) -> int:
 
     from spark_bagging_tpu import telemetry
 
-    if args.jsonl is None:
-        snap = telemetry.registry().snapshot()
-    else:
-        events = telemetry.read_events(args.jsonl)
+    def _read_snapshot(path: str):
+        events = telemetry.read_events(path)
         snap = telemetry.last_metrics_snapshot(events)
         if snap is None:
             print(
-                f"no metrics snapshot found in {args.jsonl!r} "
+                f"no metrics snapshot found in {path!r} "
                 "(was the capture closed?)", file=sys.stderr,
             )
+        return snap
+
+    if args.merge:
+        if not args.jsonl:
+            p.error("--merge needs at least one JSONL event log")
+        from spark_bagging_tpu.telemetry import fleet
+
+        named = []
+        seen: dict[str, int] = {}
+        for path in args.jsonl:
+            snap = _read_snapshot(path)
+            if snap is None:
+                return 1
+            # process label from the file name; duplicates get a
+            # #index suffix so two runs named telemetry.jsonl stay
+            # distinguishable in the merged gauges
+            base = os.path.basename(path)
+            for suffix in (".workload.jsonl", ".jsonl"):
+                if base.endswith(suffix):
+                    base = base[: -len(suffix)]
+                    break
+            n = seen.get(base, 0)
+            seen[base] = n + 1
+            named.append((base if n == 0 else f"{base}#{n}", snap))
+        snap, dropped = fleet.merge_snapshots(named)
+        for name in dropped:
+            print(
+                f"dropped {name!r}: processes disagree on metric kind "
+                "or histogram bounds (cannot merge exactly)",
+                file=sys.stderr,
+            )
+    elif not args.jsonl:
+        snap = telemetry.registry().snapshot()
+    elif len(args.jsonl) > 1:
+        p.error("several event logs need --merge")
+    else:
+        snap = _read_snapshot(args.jsonl[0])
+        if snap is None:
             return 1
     sys.stdout.write(telemetry.render_prometheus(snap))
     if not args.no_quantiles:

@@ -184,9 +184,15 @@ class AlertEngine:
     engine holds no thread of its own: deterministic by construction.
     """
 
-    def __init__(self, rules=(), ) -> None:
+    def __init__(self, rules=(), *, registry=None) -> None:
         self._lock = make_lock("telemetry.alerts")
         self._states: dict[str, _RuleState] = {}
+        # where rule series are sampled from: anything with a
+        # ``peek(name, labels)`` returning an object carrying
+        # ``kind``/``value`` (the process Registry, or the fleet
+        # aggregator's merged-series view). None = the process-wide
+        # registry, read at evaluate time.
+        self._registry = registry
         for r in rules:
             self.add_rule(r)
 
@@ -207,15 +213,16 @@ class AlertEngine:
 
     # -- sampling ------------------------------------------------------
 
-    @staticmethod
-    def _read_series(rule: AlertRule) -> float | None:
+    def _read_series(self, rule: AlertRule) -> float | None:
         """Current value of the rule's series, or None when there is
         nothing to sample: the series was never written (absent data
         is 'no evidence' — it must NOT read as 0.0, or an ``op "<"``
         rule would page on a service that served no traffic), or it
         exists under the wrong metric kind for the rule (a value rule
         aimed at a histogram must not poison the whole pass)."""
-        metric = STATE.registry.peek(rule.series, rule.labels)
+        reg = self._registry if self._registry is not None \
+            else STATE.registry
+        metric = reg.peek(rule.series, rule.labels)
         if metric is None:
             return None
         want = "counter" if rule.kind == "rate" else "gauge"
@@ -305,8 +312,13 @@ class AlertEngine:
                             "sbt_alerts_fired_total",
                             {"rule": rule.name},
                         ))
+                        # stamped HERE, not in the emit path: consumers
+                        # that hold the event itself (the fleet
+                        # aggregator's incident log) need the wall
+                        # clock even when no sink is subscribed
                         events.append({
                             "kind": "alert_fired",
+                            "ts": time.time(),
                             "rule": rule.name,
                             "series": rule.series,
                             "value": v,
@@ -338,6 +350,7 @@ class AlertEngine:
                     ))
                     events.append({
                         "kind": "alert_resolved",
+                        "ts": time.time(),
                         "rule": rule.name,
                         "series": rule.series,
                         "value": v,

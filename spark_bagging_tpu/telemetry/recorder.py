@@ -57,6 +57,12 @@ TRIGGER_KINDS = ("serving_batch_error", "swap_rejected", "alert_fired",
 # event kind that dumps only as a burst
 BURST_KIND = "serving_overloaded"
 
+# event kinds the fleet incident timeline collects from each peer's
+# ring: every dump trigger, the overload bursts, and the swap commits
+# (not incidents themselves, but the events incidents correlate WITH —
+# "did that flight dump land right after peer 2's rolling swap?")
+TIMELINE_KINDS = TRIGGER_KINDS + (BURST_KIND, "model_swapped")
+
 
 # sbt-lint: shared-state
 class FlightRecorder:
@@ -98,6 +104,10 @@ class FlightRecorder:
         self._seq = 0
         self._armed = False
         self.dumps: list[str] = []  # paths written, in order
+        # compact per-dump records (path, ts, trigger kind + handle):
+        # what a fleet aggregator scrapes to place this peer's dumps on
+        # the correlated incident timeline without re-reading the files
+        self.dump_records: list[dict] = []
 
     # -- sink protocol -------------------------------------------------
 
@@ -221,11 +231,37 @@ class FlightRecorder:
         with open(tmp, "w") as f:
             json.dump(payload, f, default=str)
         os.replace(tmp, path)
+        record = {
+            "path": path,
+            "ts": payload["ts"],
+            "seq": seq,
+            "kind": (trigger or {}).get("kind") or "manual",
+        }
+        # the trigger's correlation handle, when it carries one: the
+        # alert rule, the model a swap died on, the failing trace
+        for key in ("rule", "model", "trace_id"):
+            v = (trigger or {}).get(key)
+            if v is not None:
+                record[key] = v
         with self._lock:
             self.dumps.append(path)
+            self.dump_records.append(record)
         if STATE.enabled:
             STATE.registry.inc("sbt_flight_dumps_total")
         return path
+
+    def timeline_feed(self, *, dumps: int = 32,
+                      events: int = 64) -> dict[str, list[dict]]:
+        """The peer-side incident feed: the most recent dump records
+        plus the ring's timeline-relevant events (dump triggers,
+        overload bursts, swap commits). ``/varz`` exposes it as the
+        ``flight`` section, which is what the fleet aggregator's
+        ``/fleet/incidents`` correlation consumes."""
+        with self._lock:
+            recs = list(self.dump_records[-dumps:])
+            ring = list(self._ring)
+        evs = [e for e in ring if e.get("kind") in TIMELINE_KINDS]
+        return {"dumps": recs, "events": evs[-events:]}
 
     # -- lifecycle -----------------------------------------------------
 

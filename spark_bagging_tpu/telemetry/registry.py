@@ -127,6 +127,18 @@ SERIES_HELP: dict[str, str] = {
     "sbt_flight_dumps_suppressed_total": "Flight-recorder dumps suppressed by cooldown",
     "sbt_process_uptime_seconds": "Seconds since the exposition server started (gauge)",
     "sbt_process_rss_bytes": "Resident set size of this process (gauge, sampled at scrape)",
+    "sbt_fleet_peers": "Peer processes configured on the fleet aggregator (gauge)",
+    "sbt_fleet_peers_fresh": "Peers whose latest scrape succeeded and is within the staleness bound (gauge)",
+    "sbt_fleet_peers_stale": "Peers excluded from the merge/quorum: failed or overdue last scrape (gauge)",
+    "sbt_fleet_quorum": "Fleet quorum health: 1 healthy, 0 lost (gauge; degraded still counts 1)",
+    "sbt_fleet_scrapes_total": "Peer scrape attempts by the fleet aggregator",
+    "sbt_fleet_scrape_failures_total": "Peer scrapes that failed (timeout/HTTP error; label process)",
+    "sbt_fleet_scrape_age_seconds": "Seconds since the last successful scrape of a peer (gauge, label process)",
+    "sbt_fleet_merged_series": "Peer-derived series in the latest merge, before the fleet-synthesized sbt_fleet_* series are appended (gauge)",
+    "sbt_fleet_merge_conflicts_total": "Series dropped from a merge because peers disagree on kind or histogram bounds",
+    "sbt_fleet_version": "Live model version reported by one peer (gauge, labels model+process)",
+    "sbt_fleet_version_skew": "Max minus min live model version across fresh peers (gauge, label model; 0 = converged)",
+    "sbt_fleet_convergence_seconds": "Rolling-swap convergence time: version skew rising above 0 until back to 0 (histogram, label model)",
 }
 
 
@@ -268,6 +280,32 @@ class Histogram:
             out[f"p{int(q * 100)}"] = v if math.isfinite(v) else None
         return out
 
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other``'s observations into this histogram EXACTLY —
+        bucket-wise count addition, so the merged histogram is
+        indistinguishable from one that observed the concatenation of
+        both observation streams (same bucket counts ⇒ same quantile
+        estimates: the fleet aggregator's no-percentile-averaging
+        guarantee rides on this). Requires identical bucket bounds —
+        two grids cannot be combined without losing exactness, so a
+        mismatch raises instead of approximating. Exemplars adopt the
+        newer entry per bucket (last-write-wins, matching
+        :meth:`observe`). Returns ``self``."""
+        if self.bounds != other.bounds:
+            raise ValueError(
+                f"cannot merge histograms with different bounds "
+                f"({len(self.bounds)} vs {len(other.bounds)} buckets)"
+            )
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        for i, ex in other.exemplars.items():
+            mine = self.exemplars.get(i)
+            if mine is None or ex.get("ts", 0) >= mine.get("ts", 0):
+                self.exemplars[i] = dict(ex)
+        return self
+
 
 # sbt-lint: shared-state
 class Registry:
@@ -355,30 +393,15 @@ class Registry:
         out = []
         with self._lock:
             for (name, labels), m in sorted(self._metrics.items()):
-                entry: dict[str, Any] = {
-                    "name": name,
-                    "kind": m.kind,
-                    "labels": dict(labels),
-                }
                 if m.kind == "histogram":
-                    entry["buckets"] = [
-                        ["+Inf" if b == math.inf else b, c]
-                        for b, c in zip(m.bounds, m.counts)
-                    ]
-                    entry["sum"] = m.sum
-                    entry["count"] = m.count
-                    if m.exemplars:
-                        entry["exemplars"] = [
-                            {
-                                "le": ("+Inf"
-                                       if m.bounds[i] == math.inf
-                                       else m.bounds[i]),
-                                **ex,
-                            }
-                            for i, ex in sorted(m.exemplars.items())
-                        ]
+                    entry = histogram_entry(name, dict(labels), m)
                 else:
-                    entry["value"] = m.value
+                    entry = {
+                        "name": name,
+                        "kind": m.kind,
+                        "labels": dict(labels),
+                        "value": m.value,
+                    }
                 out.append(entry)
         # quantile interpolation happens OUTSIDE the lock, from each
         # entry's copied bucket counts — every metric writer blocks on
@@ -431,20 +454,62 @@ def _fmt_value(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
+def histogram_entry(name: str, labels: dict, h: Histogram) -> dict:
+    """Serialize one histogram as a snapshot entry — the JSON shape
+    :meth:`Registry.snapshot` emits and :func:`histogram_from_entry`
+    inverts. One serializer for both the live registry and the fleet
+    merge (a shape drift between them would silently break the
+    ``dump --merge`` / ``/fleet/varz`` round-trip)."""
+    entry: dict[str, Any] = {
+        "name": name,
+        "kind": "histogram",
+        "labels": dict(labels),
+        "buckets": [
+            ["+Inf" if b == math.inf else b, c]
+            for b, c in zip(h.bounds, h.counts)
+        ],
+        "sum": h.sum,
+        "count": h.count,
+    }
+    if h.exemplars:
+        entry["exemplars"] = [
+            {
+                "le": "+Inf" if h.bounds[i] == math.inf else h.bounds[i],
+                **ex,
+            }
+            for i, ex in sorted(h.exemplars.items())
+        ]
+    return entry
+
+
+def histogram_from_entry(entry: dict) -> Histogram:
+    """Reconstruct a live :class:`Histogram` from one snapshot entry
+    (the JSON shape :meth:`Registry.snapshot` emits). The exemplar list
+    is folded back keyed by bucket index so round-tripped histograms
+    merge like live ones."""
+    h = Histogram(buckets=[
+        math.inf if b == "+Inf" else float(b)
+        for b, _ in entry["buckets"]
+    ])
+    h.counts = [int(c) for _, c in entry["buckets"]]
+    h.count = int(entry["count"])
+    h.sum = float(entry["sum"])
+    bound_index = {b: i for i, b in enumerate(h.bounds)}
+    for ex in entry.get("exemplars", ()):
+        le = ex.get("le")
+        i = bound_index.get(math.inf if le == "+Inf" else float(le))
+        if i is not None:
+            h.exemplars[i] = {k: v for k, v in ex.items() if k != "le"}
+    return h
+
+
 def snapshot_quantiles(entry: dict) -> dict[str, float]:
     """p50/p95/p99 for one histogram snapshot entry. Live snapshots
     carry them precomputed; entries read back from an old JSONL log
     are reconstructed from their bucket counts (same interpolation)."""
     if "quantiles" in entry:
         return entry["quantiles"]
-    h = Histogram(buckets=[
-        math.inf if b == "+Inf" else float(b)
-        for b, _ in entry["buckets"]
-    ])
-    h.counts = [c for _, c in entry["buckets"]]
-    h.count = entry["count"]
-    h.sum = entry["sum"]
-    return h.quantiles()
+    return histogram_from_entry(entry).quantiles()
 
 
 def render_prometheus(snapshot: list[dict]) -> str:

@@ -28,7 +28,15 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
   transitions just by polling;
 - ``GET /debug/drift`` — every attached quality monitor's drift
   summary (per-feature PSI/KS vs the training reference, live
-  medians, disagreement stats).
+  medians, disagreement stats);
+- ``GET /fleet/metrics`` / ``/fleet/varz`` / ``/fleet/healthz`` /
+  ``/fleet/incidents`` — the fleet plane (``telemetry/fleet.py``):
+  when a :class:`~spark_bagging_tpu.telemetry.fleet.FleetAggregator`
+  is installed, each scrape ticks it (interval-limited) and serves
+  the exactly-merged N-process view — summed counters,
+  ``process=``-labeled gauges, bucket-merged histograms with exact
+  fleet quantiles, quorum health over peer healthz + scrape
+  staleness, and the correlated incident timeline.
 
 Opt-in, two ways: ``telemetry.start_server(port)`` from code, or the
 ``SBT_METRICS_PORT`` environment variable (checked at package import;
@@ -156,10 +164,11 @@ def _refresh_process_gauges() -> tuple[float | None, int | None]:
 
 
 def _varz() -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import recorder
     from spark_bagging_tpu.telemetry.state import STATE
 
     uptime, rss = _refresh_process_gauges()
-    return {
+    out = {
         "ts": time.time(),
         "pid": os.getpid(),
         "uptime_seconds": uptime,
@@ -168,6 +177,13 @@ def _varz() -> dict[str, Any]:
         "health": health_report(),
         "metrics": STATE.registry.snapshot(quantiles=True),
     }
+    rec = recorder.get()
+    if rec is not None:
+        # the peer-side incident feed: dump records + ring trigger
+        # events — what a fleet aggregator's /fleet/incidents
+        # correlation consumes from this process's scrape
+        out["flight"] = {"armed": rec.armed, **rec.timeline_feed()}
+    return out
 
 
 def _debug_spans(query: dict[str, list[str]]) -> dict[str, Any]:
@@ -232,6 +248,34 @@ def _alerts() -> dict[str, Any]:
     return eng.state()
 
 
+def _fleet(route: str):
+    """Dispatch a ``/fleet/*`` route against the process-default
+    aggregator: each scrape ticks it (interval-limited — a tight curl
+    loop cannot hammer the peers), then serves the requested merged
+    view. ``(status, body, content_type|None)``; JSON when None."""
+    from spark_bagging_tpu.telemetry import fleet
+    from spark_bagging_tpu.telemetry.registry import render_prometheus
+
+    agg = fleet.get()
+    if agg is None:
+        return 404, {
+            "error": "no fleet aggregator installed; install one with "
+                     "telemetry.fleet.install(FleetAggregator([...]))",
+        }, None
+    agg.tick()
+    if route == "metrics":
+        return 200, render_prometheus(agg.merged_snapshot()), \
+            "text/plain; version=0.0.4"
+    if route == "varz":
+        return 200, agg.fleet_varz(), None
+    if route == "healthz":
+        report = agg.fleet_health()
+        return (200 if report["healthy"] else 503), report, None
+    if route == "incidents":
+        return 200, agg.incident_timeline(), None
+    return 404, {"error": f"no route /fleet/{route}"}, None
+
+
 def _debug_runs() -> dict[str, Any]:
     from spark_bagging_tpu.telemetry import sinks
 
@@ -282,12 +326,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _alerts())
             elif url.path == "/debug/drift":
                 self._send_json(200, _debug_drift())
+            elif url.path.startswith("/fleet/"):
+                code, body, ctype = _fleet(url.path[len("/fleet/"):])
+                if ctype is not None:
+                    self._send(code, body, ctype)
+                else:
+                    self._send_json(code, body)
             elif url.path == "/":
                 self._send_json(200, {
                     "endpoints": [
                         "/metrics", "/healthz", "/varz", "/alerts",
                         "/debug/spans", "/debug/runs",
                         "/debug/workload", "/debug/drift",
+                        "/fleet/metrics", "/fleet/varz",
+                        "/fleet/healthz", "/fleet/incidents",
                     ],
                 })
             else:

@@ -419,6 +419,7 @@ class TestConfigResumePersist:
         assert len(rows) >= 5
         assert all(r["backend"] == "tpu" for r in rows)
 
+    @pytest.mark.slow  # ~4.3s [PR 12 budget offset]: subprocess bench-CLI rewrite drill; artifact-carrying behavior is cold-path tooling, and the config/resume contracts stay tier-1 via the in-process persist tests
     def test_rewrite_carries_unknown_top_level_keys(self, tmp_path):
         """A run over an artifact file must not strip its provenance
         note (or any future top-level metadata) when rewriting."""

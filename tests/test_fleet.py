@@ -1,0 +1,733 @@
+"""Fleet observability plane [ISSUE 12]: exact cross-process metric
+federation (counters sum, gauges get process labels + min/max/sum,
+histograms merge bucket-wise so fleet quantiles are EXACT — never
+averaged percentiles), scrape staleness and quorum health, swap
+convergence (version skew rise -> 0), the correlated incident
+timeline, the `/fleet/*` scrape routes over real HTTP, and the
+offline `dump --merge` CLI sharing the live merge code path.
+"""
+
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.telemetry import fleet
+from spark_bagging_tpu.telemetry import server as tserver
+from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+from spark_bagging_tpu.telemetry.registry import (
+    Histogram,
+    Registry,
+    histogram_from_entry,
+)
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_clock():
+    """Wall-clock anchor for the budget test: created when the FIRST
+    test of this module runs (module import happens at collection,
+    long before)."""
+    return time.perf_counter()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    fleet.uninstall()
+    # earlier suites leave weakly-registered health sources behind
+    # (e.g. a closed batcher awaiting GC); the self-scrape test reads
+    # this process's real /healthz, which must start from a clean slate
+    tserver.clear_health_sources()
+    yield
+    tserver.stop_server()
+    telemetry.recorder.disarm()
+    fleet.uninstall()
+    tserver.clear_health_sources()
+    telemetry.reset()
+    telemetry.enable()
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- Histogram.merge: the exact primitive ------------------------------
+
+class TestHistogramMerge:
+    def test_merged_quantiles_equal_concatenated_observations(self):
+        """THE no-percentile-averaging guarantee: merging two
+        histograms bucket-wise is indistinguishable from one histogram
+        that observed both streams, so every quantile of the merge
+        equals the quantile of the union — not the average of the two
+        peers' quantiles."""
+        rng = np.random.default_rng(7)
+        obs_a = list(rng.lognormal(mean=-3.0, sigma=1.0, size=700))
+        obs_b = list(rng.lognormal(mean=0.5, sigma=2.0, size=300))
+        a, b, union = Histogram(), Histogram(), Histogram()
+        for v in obs_a:
+            a.observe(v)
+            union.observe(v)
+        for v in obs_b:
+            b.observe(v)
+            union.observe(v)
+        a.merge(b)
+        assert a.counts == union.counts
+        assert a.count == union.count == 1000
+        assert a.sum == pytest.approx(union.sum)
+        for q in (0.5, 0.95, 0.99):
+            assert a.quantile(q) == union.quantile(q)
+        # and the merged p99 is NOT the average of the peers' p99s
+        # (the skewed mixture makes the difference visible)
+        fresh_a = Histogram()
+        for v in obs_a:
+            fresh_a.observe(v)
+        avg_p99 = (fresh_a.quantile(0.99) + b.quantile(0.99)) / 2
+        assert union.quantile(0.99) != pytest.approx(avg_p99, rel=1e-6)
+
+    def test_count_sum_invariants_and_empty_merge(self):
+        a, b = Histogram(), Histogram()
+        for v in (0.01, 0.5, 3.0):
+            a.observe(v)
+        a.merge(b)  # empty right side: identity
+        assert a.count == 3 and sum(a.counts) == 3
+        b.merge(a)  # empty left side: copy
+        assert b.counts == a.counts and b.sum == a.sum
+
+    def test_bounds_mismatch_raises(self):
+        a = Histogram()
+        b = Histogram(buckets=[1.0, 2.0])
+        with pytest.raises(ValueError, match="bounds"):
+            a.merge(b)
+
+    def test_exemplars_newest_wins(self):
+        a, b = Histogram(), Histogram()
+        a.observe(0.05, exemplar="old")
+        b.observe(0.05, exemplar="new")
+        b.exemplars[next(iter(b.exemplars))]["ts"] += 10.0
+        a.merge(b)
+        (ex,) = a.exemplars.values()
+        assert ex["trace_id"] == "new"
+
+    def test_roundtrip_through_snapshot_entry(self):
+        reg = Registry()
+        for v in (0.002, 0.02, 4.0):
+            reg.observe("sbt_chunk_seconds", v, exemplar="t1")
+        (entry,) = reg.snapshot()
+        h = histogram_from_entry(entry)
+        live = reg.histogram("sbt_chunk_seconds")
+        assert h.counts == live.counts and h.count == live.count
+        assert h.exemplars  # exemplar folded back by bucket
+
+
+# -- snapshot merge ----------------------------------------------------
+
+class TestMergeSnapshots:
+    def _two_regs(self):
+        r1, r2 = Registry(), Registry()
+        r1.inc("sbt_serving_requests_total", 3)
+        r2.inc("sbt_serving_requests_total", 5)
+        r1.set("sbt_serving_queue_depth", 2.0)
+        r2.set("sbt_serving_queue_depth", 7.0)
+        r1.observe("sbt_serving_latency_seconds", 0.01)
+        r2.observe("sbt_serving_latency_seconds", 1.5)
+        return r1, r2
+
+    def test_counters_sum_gauges_label_hists_merge(self):
+        r1, r2 = self._two_regs()
+        merged, dropped = fleet.merge_snapshots(
+            [("p0", r1.snapshot()), ("p1", r2.snapshot())]
+        )
+        assert dropped == []
+        by = {(e["name"], tuple(sorted(e["labels"].items()))): e
+              for e in merged}
+        assert by[("sbt_serving_requests_total", ())]["value"] == 8
+        assert by[(
+            "sbt_serving_queue_depth", (("process", "p0"),)
+        )]["value"] == 2.0
+        assert by[(
+            "sbt_serving_queue_depth", (("fleet", "min"),)
+        )]["value"] == 2.0
+        assert by[(
+            "sbt_serving_queue_depth", (("fleet", "max"),)
+        )]["value"] == 7.0
+        assert by[(
+            "sbt_serving_queue_depth", (("fleet", "sum"),)
+        )]["value"] == 9.0
+        hist = by[("sbt_serving_latency_seconds", ())]
+        assert hist["count"] == 2 and hist["sum"] == pytest.approx(1.51)
+
+    def test_gauge_with_reserved_label_is_a_conflict_not_a_collision(self):
+        # the merge owns 'process'/'fleet' on gauges: a pre-labeled
+        # series (e.g. re-merging an already-merged snapshot) must be
+        # dropped-and-reported, never silently collapsed into
+        # duplicate-label entries
+        r1, r2 = Registry(), Registry()
+        r1.set("sbt_serving_queue_depth", 1.0,
+               labels={"process": "x"})
+        r2.set("sbt_serving_queue_depth", 2.0,
+               labels={"process": "y"})
+        r2.set("sbt_serving_shard_devices", 4.0)
+        merged, dropped = fleet.merge_snapshots(
+            [("p0", r1.snapshot()), ("p1", r2.snapshot())]
+        )
+        assert dropped == ["sbt_serving_queue_depth"]
+        names = {e["name"] for e in merged}
+        assert "sbt_serving_queue_depth" not in names
+        assert "sbt_serving_shard_devices" in names
+
+    def test_kind_conflict_drops_series_whole(self):
+        r1, r2 = Registry(), Registry()
+        r1.inc("sbt_x_total", 1)
+        r2.set("sbt_x_total", 5.0)  # same name, different kind
+        r2.inc("sbt_serving_requests_total", 2)
+        merged, dropped = fleet.merge_snapshots(
+            [("p0", r1.snapshot()), ("p1", r2.snapshot())]
+        )
+        assert dropped == ["sbt_x_total"]
+        names = {e["name"] for e in merged}
+        assert "sbt_x_total" not in names
+        assert "sbt_serving_requests_total" in names
+
+    def test_merged_digest_inclusion_and_exemplar_stripping(self):
+        import copy
+
+        r1, r2 = self._two_regs()
+        snaps = [("p0", r1.snapshot()), ("p1", r2.snapshot())]
+        merged, _ = fleet.merge_snapshots(snaps)
+        d1 = fleet.merged_digest(merged)
+        # a deterministic-plane series shifts the digest...
+        r1.observe("sbt_serving_batch_fill_ratio", 0.5,
+                   exemplar="trace-xyz")
+        merged2, _ = fleet.merge_snapshots(
+            [("p0", r1.snapshot()), ("p1", r2.snapshot())]
+        )
+        d2 = fleet.merged_digest(merged2)
+        assert d2 != d1
+        # ...but its exemplars (wall-clock ts, process-global trace
+        # ids) are stripped: mutating one leaves the digest unchanged
+        mutated = copy.deepcopy(merged2)
+        for e in mutated:
+            for ex in e.get("exemplars", ()):
+                ex["ts"] = 12345.0
+                ex["trace_id"] = "other"
+        assert fleet.merged_digest(mutated) == d2
+        # wall-clock series stay outside the deterministic plane
+        r2.observe("sbt_serving_latency_seconds", 0.25)
+        r2.set("sbt_process_rss_bytes", 12345.0)
+        merged3, _ = fleet.merge_snapshots(
+            [("p0", r1.snapshot()), ("p1", r2.snapshot())]
+        )
+        assert fleet.merged_digest(merged3) == d2
+        # the no-filter digest sees everything
+        assert fleet.merged_digest(merged3, series=None) != \
+            fleet.merged_digest(merged2, series=None)
+
+
+# -- the aggregator ----------------------------------------------------
+
+class _FlakyPeer:
+    """Scripted peer: fails while ``down`` is set."""
+
+    def __init__(self, name, registry):
+        self.name = name
+        self._reg = registry
+        self.down = False
+
+    def scrape(self):
+        if self.down:
+            raise RuntimeError("scripted outage")
+        return {"metrics": self._reg.snapshot()}
+
+
+class TestAggregator:
+    def test_stale_peer_freezes_counters_drops_gauges_never_zeros(self):
+        r1, r2 = Registry(), Registry()
+        r1.inc("sbt_serving_requests_total", 10)
+        r2.inc("sbt_serving_requests_total", 32)
+        r2.set("sbt_serving_queue_depth", 7.0)
+        flaky = _FlakyPeer("p1", r2)
+        agg = fleet.FleetAggregator(
+            [fleet.RegistryPeer("p0", r1), flaky],
+            interval_s=0.0, clock=lambda: 0.0,
+        )
+        agg.scrape_all(now=1.0)
+        assert agg.peek("sbt_serving_requests_total").value == 42
+        flaky.down = True
+        r2.inc("sbt_serving_requests_total", 100)  # unseen progress
+        agg.scrape_all(now=2.0)
+        # the stale peer's counter FREEZES at its last-known value —
+        # never zeroed (which would make the merged sum non-monotonic
+        # and read as a failure spike to rate rules on recovery) —
+        # while its gauges drop out and the staleness is visible
+        assert agg.peek("sbt_serving_requests_total").value == 42
+        assert agg.peek("sbt_serving_queue_depth",
+                        {"process": "p1"}) is None
+        assert agg.peek("sbt_fleet_peers_stale").value == 1
+        assert agg.peek("sbt_fleet_scrape_failures_total",
+                        {"process": "p1"}).value == 1
+        age = agg.peek("sbt_fleet_scrape_age_seconds",
+                       {"process": "p1"})
+        assert age.value == pytest.approx(1.0)
+        flaky.down = False
+        agg.scrape_all(now=3.0)
+        assert agg.peek("sbt_serving_requests_total").value == 142
+        assert agg.peek("sbt_serving_queue_depth",
+                        {"process": "p1"}).value == 7.0
+        assert agg.peek("sbt_fleet_peers_stale").value == 0
+
+    def test_never_scraped_peer_has_no_age_series(self):
+        flaky = _FlakyPeer("p0", Registry())
+        flaky.down = True
+        agg = fleet.FleetAggregator(
+            [flaky], interval_s=0.0, clock=lambda: 0.0,
+        )
+        agg.scrape_all(now=1.0)
+        # absent, not zero — and not +Inf, which is not JSON: a strict
+        # /fleet/varz consumer must never see a bare Infinity token
+        assert agg.peek("sbt_fleet_scrape_age_seconds",
+                        {"process": "p0"}) is None
+        body = json.dumps(
+            {"metrics": agg.merged_snapshot()}, allow_nan=False
+        )
+        assert "Infinity" not in body
+
+    def test_quorum_health_degrades_then_loses(self):
+        regs = [Registry() for _ in range(3)]
+        flakies = [_FlakyPeer(f"p{i}", r) for i, r in enumerate(regs)]
+        agg = fleet.FleetAggregator(flakies, interval_s=0.0,
+                                    clock=lambda: 0.0)
+        agg.scrape_all(now=1.0)
+        h = agg.fleet_health(now=1.0)
+        assert h["healthy"] and not h["degraded"]
+        flakies[2].down = True
+        agg.scrape_all(now=2.0)
+        h = agg.fleet_health(now=2.0)
+        assert h["healthy"] and h["degraded"]  # 2/3 >= majority
+        flakies[1].down = True
+        agg.scrape_all(now=3.0)
+        h = agg.fleet_health(now=3.0)
+        assert not h["healthy"]  # 1/3 < majority: quorum lost
+        assert agg.peek("sbt_fleet_quorum").value == 0.0
+
+    def test_peer_reported_unhealthz_counts_against_quorum(self):
+        r = Registry()
+        sick = fleet.RegistryPeer(
+            "p0", r, health=lambda: {"healthy": False, "reason": "x"}
+        )
+        agg = fleet.FleetAggregator([sick], interval_s=0.0,
+                                    clock=lambda: 0.0)
+        agg.scrape_all(now=1.0)
+        h = agg.fleet_health(now=1.0)
+        assert h["peers"]["p0"]["fresh"] is True
+        assert not h["healthy"]  # fresh but unhealthy: no quorum of 1
+
+    def test_version_skew_rise_and_convergence_histogram(self):
+        r1, r2 = Registry(), Registry()
+        for r in (r1, r2):
+            r.set("sbt_serving_model_version", 1.0,
+                  labels={"model": "m"})
+        agg = fleet.FleetAggregator(
+            [fleet.RegistryPeer("p0", r1), fleet.RegistryPeer("p1", r2)],
+            interval_s=0.0, clock=lambda: 0.0,
+        )
+        agg.scrape_all(now=0.0)
+        assert agg.version_skew() == {"m": 0.0}
+        r1.set("sbt_serving_model_version", 2.0, labels={"model": "m"})
+        agg.scrape_all(now=1.0)
+        assert agg.version_skew() == {"m": 1.0}
+        assert agg.peek("sbt_fleet_version",
+                        {"model": "m", "process": "p0"}).value == 2.0
+        assert agg.peek("sbt_fleet_version_skew").value == 1.0
+        r2.set("sbt_serving_model_version", 2.0, labels={"model": "m"})
+        agg.scrape_all(now=3.5)
+        assert agg.version_skew() == {"m": 0.0}
+        # the excursion's duration landed in the convergence histogram
+        assert agg.convergence_observations() == {"m": [2.5]}
+        entry = next(
+            e for e in agg.merged_snapshot()
+            if e["name"] == "sbt_fleet_convergence_seconds"
+        )
+        assert entry["count"] == 1
+
+    def test_skew_holds_open_when_lagging_peer_goes_stale(self):
+        """A peer that wedges mid-upgrade at the OLD version and stops
+        answering scrapes is exactly the stalled roll the skew metric
+        exists to expose: skew is computed over LAST-KNOWN versions,
+        so the excursion stays open through the outage (no spurious
+        convergence) and closes only when the peer actually reports
+        the new version."""
+        r1, r2 = Registry(), Registry()
+        for r in (r1, r2):
+            r.set("sbt_serving_model_version", 1.0,
+                  labels={"model": "m"})
+        flaky = _FlakyPeer("p1", r2)
+        agg = fleet.FleetAggregator(
+            [fleet.RegistryPeer("p0", r1), flaky],
+            interval_s=0.0, clock=lambda: 0.0,
+        )
+        agg.scrape_all(now=0.0)
+        r1.set("sbt_serving_model_version", 2.0, labels={"model": "m"})
+        agg.scrape_all(now=1.0)
+        assert agg.version_skew() == {"m": 1.0}
+        flaky.down = True  # p1 wedges, still at v1
+        agg.scrape_all(now=2.0)
+        agg.scrape_all(now=3.0)
+        assert agg.version_skew() == {"m": 1.0}  # NOT fake-converged
+        assert agg.convergence_observations() == {}
+        assert agg.peek("sbt_fleet_version",
+                        {"model": "m", "process": "p1"}).value == 1.0
+        flaky.down = False
+        r2.set("sbt_serving_model_version", 2.0, labels={"model": "m"})
+        agg.scrape_all(now=5.0)
+        assert agg.version_skew() == {"m": 0.0}
+        # the excursion spans the whole outage: opened at 1.0
+        assert agg.convergence_observations() == {"m": [4.0]}
+
+    def test_alert_engine_over_merged_series(self):
+        r = Registry()
+        flaky = _FlakyPeer("p1", Registry())
+        rules = fleet.default_fleet_rules(
+            peer_fast_s=1.0, peer_slow_s=2.0, cooldown_s=100.0,
+        )
+        agg = fleet.FleetAggregator(
+            [fleet.RegistryPeer("p0", r), flaky],
+            interval_s=0.0, rules=rules, clock=lambda: 0.0,
+        )
+        flaky.down = True
+        for t in range(6):
+            agg.scrape_all(now=float(t))
+        state = {s["name"]: s for s in agg.alerts.state()["rules"]}
+        assert state["fleet-peer-lost"]["fired"] == 1
+        assert state["fleet-peer-lost"]["active"] is True
+        flaky.down = False
+        agg.scrape_all(now=6.0)
+        state = {s["name"]: s for s in agg.alerts.state()["rules"]}
+        assert state["fleet-peer-lost"]["active"] is False
+        assert state["fleet-peer-lost"]["resolved"] == 1
+        # the other rules stayed quiet
+        assert state["fleet-skew-stalled"]["fired"] == 0
+        assert state["fleet-burn-rate"]["fired"] == 0
+        # the firing reached the PRODUCTION (wall-clock) incident
+        # timeline even though no telemetry sink was subscribed —
+        # alert events are ts-stamped at creation, not at emission
+        timeline = agg.incident_timeline()
+        assert [(i["kind"], i["key"]) for i in timeline["incidents"]
+                if i["kind"] == "alert_fired"] == \
+            [("alert_fired", "fleet-peer-lost")]
+
+    def test_interval_rate_limits_ticks(self):
+        calls = []
+
+        class CountingPeer:
+            name = "p0"
+
+            def scrape(self):
+                calls.append(1)
+                return {"metrics": []}
+
+        agg = fleet.FleetAggregator([CountingPeer()], interval_s=5.0,
+                                    clock=lambda: 0.0)
+        assert agg.tick(now=0.0) is True
+        assert agg.tick(now=1.0) is False  # inside the interval
+        assert agg.tick(now=1.0, force=True) is True
+        assert agg.tick(now=6.0) is True
+        assert len(calls) == 3
+
+    def test_peek_absent_is_none_and_validation(self):
+        r = Registry()
+        agg = fleet.FleetAggregator([fleet.RegistryPeer("p0", r)],
+                                    interval_s=0.0, clock=lambda: 0.0)
+        assert agg.peek("sbt_never_written_total") is None
+        with pytest.raises(ValueError, match="at least one peer"):
+            fleet.FleetAggregator([])
+        with pytest.raises(ValueError, match="duplicate"):
+            fleet.FleetAggregator([fleet.RegistryPeer("a", r),
+                                   fleet.RegistryPeer("a", r)])
+        with pytest.raises(ValueError, match="quorum"):
+            fleet.FleetAggregator([fleet.RegistryPeer("a", r)],
+                                  quorum=5)
+
+
+# -- incident correlation ----------------------------------------------
+
+class TestIncidents:
+    def test_same_trigger_groups_inside_window(self):
+        feeds = [
+            ("p0", {"dumps": [], "events": [
+                {"kind": "alert_fired", "rule": "burn", "ts": 100.0},
+            ]}),
+            ("p1", {"dumps": [
+                {"kind": "serving_batch_error", "ts": 101.0,
+                 "path": "flight_1.json"},
+            ], "events": [
+                {"kind": "alert_fired", "rule": "burn", "ts": 102.0},
+            ]}),
+            ("p2", {"dumps": [], "events": [
+                {"kind": "alert_fired", "rule": "burn", "ts": 300.0},
+            ]}),
+        ]
+        incidents, events = fleet.correlate_incidents(
+            feeds, window_s=5.0
+        )
+        assert [e["t"] for e in events] == [100.0, 101.0, 102.0, 300.0]
+        # two same-trigger alert firings 2s apart -> ONE incident
+        # spanning two peers; the 300s one is a separate incident;
+        # the flight dump is its own trigger kind
+        kinds = [(i["kind"], i["count"], sorted(i["peers"]))
+                 for i in incidents]
+        assert ("alert_fired", 2, ["p0", "p1"]) in kinds
+        assert ("alert_fired", 1, ["p2"]) in kinds
+        assert ("serving_batch_error", 1, ["p1"]) in kinds
+        assert fleet.timeline_digest(incidents) == \
+            fleet.timeline_digest(incidents)
+
+    def test_clock_key_selects_and_filters(self):
+        feeds = [("p0", {"dumps": [], "events": [
+            {"kind": "alert_fired", "rule": "r", "ts": 1e9,
+             "now": 0.25},
+            {"kind": "model_swapped", "model": "m", "ts": 1e9},
+        ]})]
+        incidents, events = fleet.correlate_incidents(
+            feeds, window_s=1.0, clock_key="now"
+        )
+        # only the virtually-stamped event survives on the virtual
+        # clock (never mix wall and virtual timestamps in one order)
+        assert len(events) == 1 and events[0]["t"] == 0.25
+        incidents_w, events_w = fleet.correlate_incidents(
+            feeds, window_s=1.0, clock_key="ts"
+        )
+        assert len(events_w) == 2
+
+    def test_recorder_timeline_feed_records_dumps(self, tmp_path):
+        rec = FlightRecorder(dir=str(tmp_path), cooldown_s=0.0)
+        rec.arm()
+        try:
+            telemetry.emit_event({"kind": "model_swapped",
+                                  "model": "m", "version": 2})
+            telemetry.emit_event({"kind": "serving_batch_error",
+                                  "error": "boom"})
+            telemetry.emit_event({"kind": "span", "name": "noise"})
+        finally:
+            rec.disarm()
+        feed = rec.timeline_feed()
+        assert [d["kind"] for d in feed["dumps"]] == \
+            ["serving_batch_error"]
+        assert feed["dumps"][0]["path"].endswith(".json")
+        kinds = [e["kind"] for e in feed["events"]]
+        assert kinds == ["model_swapped", "serving_batch_error"]
+
+
+# -- /fleet/* routes over real HTTP ------------------------------------
+
+class TestFleetRoutes:
+    def test_routes_404_without_aggregator(self):
+        port = tserver.start_server(0)
+        code, body = _get(port, "/fleet/varz")
+        assert code == 404 and "no fleet aggregator" in body
+        code, body = _get(port, "/")
+        assert "/fleet/incidents" in body
+
+    def test_fleet_varz_quantiles_are_exact_union(self):
+        """THE acceptance assertion: /fleet/varz p50/p95/p99 equal the
+        quantiles computed from the union of the peers' bucket counts
+        — no percentile averaging anywhere."""
+        rng = np.random.default_rng(3)
+        r1, r2 = Registry(), Registry()
+        union = Histogram()
+        for v in rng.lognormal(mean=-4, sigma=1.5, size=400):
+            r1.observe("sbt_serving_latency_seconds", float(v))
+            union.observe(float(v))
+        for v in rng.lognormal(mean=-1, sigma=1.0, size=250):
+            r2.observe("sbt_serving_latency_seconds", float(v))
+            union.observe(float(v))
+        fleet.install(fleet.FleetAggregator(
+            [fleet.RegistryPeer("p0", r1), fleet.RegistryPeer("p1", r2)],
+            interval_s=0.0,
+        ))
+        port = tserver.start_server(0)
+        code, body = _get(port, "/fleet/varz")
+        assert code == 200
+        varz = json.loads(body)
+        entry = next(e for e in varz["metrics"]
+                     if e["name"] == "sbt_serving_latency_seconds")
+        assert entry["count"] == 650
+        assert [c for _, c in entry["buckets"]] == union.counts
+        for q, want in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            assert entry["quantiles"][q] == pytest.approx(
+                union.quantile(want), rel=0, abs=0
+            )
+
+    def test_fleet_metrics_healthz_and_incidents(self):
+        r1 = Registry()
+        r1.inc("sbt_serving_requests_total", 4)
+        r1.set("sbt_serving_queue_depth", 1.0)
+        flaky = _FlakyPeer("p1", Registry())
+        flaky.down = True
+        agg = fleet.FleetAggregator(
+            [fleet.RegistryPeer("p0", r1), flaky], interval_s=0.0,
+        )
+        fleet.install(agg)
+        port = tserver.start_server(0)
+        code, body = _get(port, "/fleet/metrics")
+        assert code == 200
+        assert "sbt_serving_requests_total 4" in body
+        assert 'sbt_serving_queue_depth{process="p0"} 1' in body
+        assert 'sbt_fleet_scrape_failures_total{process="p1"} 1' in body
+        # 1/2 fresh+healthy < majority(2)=2 -> quorum lost -> 503
+        code, body = _get(port, "/fleet/healthz")
+        assert code == 503
+        report = json.loads(body)
+        assert report["healthy"] is False
+        assert report["peers"]["p1"]["fresh"] is False
+        flaky.down = False
+        code, body = _get(port, "/fleet/healthz")
+        assert code == 200
+        code, body = _get(port, "/fleet/incidents")
+        assert code == 200
+        timeline = json.loads(body)
+        assert {"incidents", "events", "digest"} <= set(timeline)
+
+    def test_http_peer_scrapes_a_real_varz(self):
+        """An HTTPPeer pointed at this process's own exposition server
+        — the loopback transport the production fleet uses — merges
+        alongside an in-process peer, and a dead URL is a counted
+        failure, not zeros."""
+        telemetry.registry().inc("sbt_serving_requests_total", 6)
+        port = tserver.start_server(0)
+        other = Registry()
+        other.inc("sbt_serving_requests_total", 10)
+        agg = fleet.FleetAggregator(
+            [
+                fleet.HTTPPeer("self", f"http://127.0.0.1:{port}"),
+                fleet.RegistryPeer("mem", other),
+                fleet.HTTPPeer("ghost", "http://127.0.0.1:1",
+                               timeout_s=0.2),
+            ],
+            interval_s=0.0,
+        )
+        agg.scrape_all()
+        assert agg.peek("sbt_serving_requests_total").value == 16
+        assert agg.peek("sbt_fleet_scrape_failures_total",
+                        {"process": "ghost"}).value == 1
+        h = agg.fleet_health()
+        assert h["healthy"] and h["degraded"]
+        # the self peer's varz carried its flight feed section
+        st = agg._status["self"]
+        assert "flight" in (st.snapshot or {})
+
+
+# -- use_registry (the virtual-peer seam) ------------------------------
+
+def test_use_registry_swaps_and_restores():
+    main_reg = telemetry.registry()
+    peer = Registry()
+    with fleet.use_registry(peer):
+        telemetry.inc("sbt_serving_requests_total", 3)
+        assert telemetry.registry() is peer
+    assert telemetry.registry() is main_reg
+    assert peer.counter("sbt_serving_requests_total").value == 3
+    assert main_reg.peek("sbt_serving_requests_total") is None
+    with pytest.raises(RuntimeError):
+        with fleet.use_registry(peer):
+            raise RuntimeError("x")
+    assert telemetry.registry() is main_reg
+
+
+# -- faults: the fleet.scrape site -------------------------------------
+
+def test_peer_loss_fault_site_fires_deterministically():
+    from spark_bagging_tpu import faults
+
+    regs = [Registry() for _ in range(3)]
+    agg = fleet.FleetAggregator(
+        [fleet.RegistryPeer(f"p{i}", r) for i, r in enumerate(regs)],
+        interval_s=0.0, clock=lambda: 0.0,
+    )
+    plan = faults.builtin_plan("peer-loss")
+    with faults.armed(plan):
+        for t in range(25):
+            agg.scrape_all(now=float(t))
+    # every=3, times=20 over 3 peers scraped in order: the LAST peer
+    # fails on the first 20 ticks, then recovers
+    assert agg.peek("sbt_fleet_scrape_failures_total",
+                    {"process": "p2"}).value == 20
+    assert agg.peek("sbt_fleet_scrape_failures_total",
+                    {"process": "p0"}).value == 0
+    assert agg.peek("sbt_fleet_peers_stale").value == 0  # recovered
+    snap = plan.snapshot()
+    assert snap["hits"]["fleet.scrape"] == 75  # 25 ticks x 3 peers
+    assert snap["fires"]["fleet.scrape"] == 20
+
+
+# -- offline merge CLI -------------------------------------------------
+
+class TestDumpMergeCLI:
+    def _capture_log(self, path, n):
+        telemetry.reset()
+        with telemetry.capture(str(path)):
+            telemetry.inc("sbt_serving_requests_total", n)
+            telemetry.set_gauge("sbt_serving_queue_depth", float(n))
+            telemetry.observe("sbt_serving_latency_seconds", 0.01 * n)
+
+    def test_merge_two_logs_into_one_fleet_dump(self, tmp_path):
+        from spark_bagging_tpu.telemetry.__main__ import main
+
+        a, b = tmp_path / "peer_a.jsonl", tmp_path / "peer_b.jsonl"
+        self._capture_log(a, 2)
+        self._capture_log(b, 5)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main(["dump", "--merge", str(a), str(b)])
+        assert rc == 0
+        out = buf.getvalue()
+        assert "sbt_serving_requests_total 7" in out
+        assert 'sbt_serving_queue_depth{process="peer_a"} 2' in out
+        assert 'sbt_serving_queue_depth{fleet="sum"} 7' in out
+        # merged histogram: 2 observations, quantiles from the union
+        assert "sbt_serving_latency_seconds_count 2" in out
+        assert "# quantiles sbt_serving_latency_seconds" in out
+
+    def test_merge_validations(self, tmp_path):
+        from spark_bagging_tpu.telemetry.__main__ import main
+
+        a, b = tmp_path / "x.jsonl", tmp_path / "y.jsonl"
+        self._capture_log(a, 1)
+        self._capture_log(b, 1)
+        with pytest.raises(SystemExit):
+            main(["dump", str(a), str(b)])  # several need --merge
+        with pytest.raises(SystemExit):
+            main(["dump", "--merge"])  # --merge needs files
+        # duplicate basenames stay distinguishable
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        c = sub / "x.jsonl"
+        self._capture_log(c, 3)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main(["dump", "--merge", str(a), str(c)])
+        assert rc == 0
+        out = buf.getvalue()
+        assert 'process="x"' in out and 'process="x#1"' in out
+
+
+def test_zz_fleet_suite_under_budget(_module_clock):
+    """Tier-1 allowance for this module (the PR-11 ratchet
+    discipline): the whole fleet suite must stay a lightweight unit
+    suite — the heavyweight end-to-end drill lives in test_replay's
+    budgeted CLI gate."""
+    elapsed = time.perf_counter() - _module_clock
+    assert elapsed < 20.0, (
+        f"tests/test_fleet.py took {elapsed:.1f}s; move the offender "
+        "to -m slow or shrink it"
+    )

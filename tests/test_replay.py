@@ -300,6 +300,126 @@ def test_plain_replay_carries_no_drift_section(executor, wl):
 
 # -- tier-1 CLI smoke (budgeted like the lint gate) --------------------
 
+def test_fleet_drill_deterministic_and_bitwise(clf, wl):
+    """The ISSUE 12 drill, in-process: N virtual peers (own registries
+    + stepped batchers) under one aggregator — the skew transcript
+    rises during the rolling swap and returns to 0, a convergence
+    duration is observed, every digest (merged metrics, skew,
+    incidents) is reproducible, and distributing the SAME workload
+    over 3 peers serves byte-identical outputs to the single-executor
+    replay of the same (workload, seed)."""
+    r1 = R.replay_fleet(wl, model=clf, fleet=3, seed=3,
+                        min_bucket_rows=8, bucket_max_rows=32)
+    r2 = R.replay_fleet(wl, model=clf, fleet=3, seed=3,
+                        min_bucket_rows=8, bucket_max_rows=32)
+    f1, f2 = r1["fleet"], r2["fleet"]
+    for key in ("merged_digest", "skew_digest", "incident_digest",
+                "convergence_seconds", "scrapes", "scrape_failures"):
+        assert f1[key] == f2[key], key
+    assert r1["output_digest"] == r2["output_digest"]
+    assert r1["served"] == wl.n_requests and r1["errors"] == 0
+    # the version plane moved and converged, and the excursion's
+    # duration was measured
+    assert f1["skew_max"] >= 1 and f1["skew_final"] == 0
+    assert f1["converged"] is True
+    assert len(f1["convergence_seconds"]["replay"]) == 1
+    # a healthy drill pages nothing
+    assert all(a["fired"] == 0 for a in f1["alerts"].values())
+    assert f1["incidents"] == [] and f1["flight_dumps"] == 0
+    assert f1["health"]["min_fresh"] == 3
+    # fleet distribution changes WHERE rows run, never their bytes:
+    # the per-request output stream matches the single-executor replay
+    single = R.replay(wl, executor=EnsembleExecutor(
+        clf, min_bucket_rows=8, max_batch_rows=32
+    ), seed=3)
+    assert r1["output_digest"] == single["output_digest"]
+    # a different payload seed is a different fleet experiment
+    r3 = R.replay_fleet(wl, model=clf, fleet=3, seed=4,
+                        min_bucket_rows=8, bucket_max_rows=32)
+    assert r3["output_digest"] != r1["output_digest"]
+
+
+def test_fleet_drill_validation(clf, wl):
+    with pytest.raises(ValueError, match=">= 2 peers"):
+        R.replay_fleet(wl, model=clf, fleet=1)
+    # CLI combination guards
+    with pytest.raises(SystemExit):
+        R.main(["--fleet", "3", "--drift"])
+    with pytest.raises(SystemExit):
+        R.main(["--fleet", "3", "--swaps", "2"])
+    with pytest.raises(SystemExit):
+        R.main(["--fleet", "3", "--mode", "timed"])
+    with pytest.raises(SystemExit):
+        # fleet.scrape can only fire under an aggregator
+        R.main(["--chaos", "peer-loss"])
+
+
+def test_fleet_cli_gate_under_budget(tmp_path):
+    """`python -m benchmarks.replay --fleet 3 --check` (in-process,
+    scaled down): exit 0 with the fleet checks green — skew rose,
+    converged, convergence observed, quorum held — inside a 20 s
+    tier-1 allowance."""
+    import json
+
+    t0 = time.monotonic()
+    out = str(tmp_path / "fleet_report.json")
+    rc = R.main([
+        "--fleet", "3", "--synthetic", "poisson", "--rate", "300",
+        "--duration", "0.4", "--width", "6", "--n-estimators", "4",
+        "--bucket-max-rows", "32", "--repeats", "2",
+        "--check", "--out", out,
+    ])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 20.0, f"fleet gate took {elapsed:.1f}s"
+    report = json.loads(open(out).read())
+    assert report["slo"]["ok"] is True
+    checks = {c["name"]: c for c in report["slo"]["checks"]}
+    assert checks["fleet_skew_rose"]["ok"]
+    assert checks["fleet_skew_converged"]["ok"]
+    assert checks["fleet_convergence_observed"]["ok"]
+    assert checks["fleet_quorum_held"]["ok"]
+    assert report["post_warmup_compiles"] == 0
+
+
+def test_fleet_chaos_peer_loss_cli(tmp_path):
+    """`--chaos peer-loss --fleet 3`: scrapes of one peer fail for a
+    scripted stretch — fleet health degrades (excluded from quorum,
+    never merged as zeros) and recovers, the peer-lost alert fires
+    exactly once (with its flight dump), and the whole fault/health/
+    incident transcript is byte-identical across repeats (asserted by
+    replay_median, or this exits nonzero)."""
+    import json
+
+    t0 = time.monotonic()
+    out = str(tmp_path / "fleet_chaos_report.json")
+    rc = R.main([
+        "--fleet", "3", "--chaos", "peer-loss",
+        "--synthetic", "poisson", "--rate", "300",
+        "--duration", "0.4", "--width", "6", "--n-estimators", "4",
+        "--bucket-max-rows", "32", "--repeats", "2",
+        "--check", "--out", out,
+    ])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 25.0, f"peer-loss gate took {elapsed:.1f}s"
+    report = json.loads(open(out).read())
+    checks = {c["name"]: c for c in report["slo"]["checks"]}
+    assert checks["fleet_health_degraded"]["ok"]
+    assert checks["fleet_health_recovered"]["ok"]
+    f = report["fleet"]
+    assert f["scrape_failures"]["p2"] == 20
+    assert f["health"]["min_fresh"] == 2
+    assert f["alerts"]["fleet-peer-lost"]["fired"] == 1
+    assert f["alerts"]["fleet-peer-lost"]["resolved"] == 1
+    assert f["flight_dumps"] == 1
+    # the fired alert is on the incident timeline with its virtual
+    # timestamp, attributed to the fleet engine
+    kinds = {(i["kind"], i["key"]) for i in f["incidents"]}
+    assert ("alert_fired", "fleet-peer-lost") in kinds
+    assert report["chaos"]["sites"]["fires"]["fleet.scrape"] == 20
+
+
 def test_cli_smoke_replay_check_under_budget(tmp_path):
     """`python -m benchmarks.replay --check` end to end (in-process:
     the subprocess would re-pay the JAX import): tiny synthetic

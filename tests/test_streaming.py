@@ -860,6 +860,7 @@ def test_tree_stream_data_mesh_accuracy(cancer):
         ).fit_stream(ArrayChunks(X, y, chunk_rows=100), classes=[0, 1])
 
 
+@pytest.mark.slow  # ~6s [PR 12 budget offset]: resume-under-changed-mesh rejection; the resume config-change rejection contract stays tier-1 via test_tree_stream_resume_rejects_config_change
 def test_tree_stream_resume_rejects_mesh_change(cancer, tmp_path):
     """The weight stream folds the data-shard index — resuming under a
     different data-axis size must be refused."""
