@@ -216,7 +216,8 @@ def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
     back half of :func:`replay` and :func:`replay_fleet`. Returns
     ``out_h``/``comp_h`` (sha256 objects over output bytes and batch
     composition), sorted ``latencies``, ``forward_ms``, ``errors``,
-    ``served``."""
+    ``served``, and ``records`` — one compact per-request breakdown
+    record per future (the attribution section's raw material)."""
     import numpy as np
 
     out_h = hashlib.sha256()
@@ -227,6 +228,7 @@ def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
     served = 0
     batch_first_seen: dict[str, int] = {}
     composition: list[tuple] = []
+    records: list[dict] = []
     for idx in sorted(futs):
         f = futs[idx]
         try:
@@ -238,10 +240,22 @@ def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
             err = e
         tr = getattr(f, "trace", None)
         bd = tr.breakdown if tr is not None else {}
+        rec: dict = {"idx": idx}
+        if tr is not None:
+            rec["trace_id"] = tr.trace_id
+        for k in ("total_ms", "queue_ms", "forward_ms", "path",
+                  "batch_size", "error"):
+            if bd.get(k) is not None:
+                rec[k] = bd[k]
+        if bd.get("bucket") is not None:
+            rec["bucket"] = str(bd["bucket"])
         if err is not None:
             errors += 1
+            rec.setdefault("error", repr(err))
+            records.append(rec)
             continue
         served += 1
+        records.append(rec)
         res = f.result(0)
         arr = np.asarray(res)
         out_h.update(str(arr.shape).encode())
@@ -263,6 +277,121 @@ def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
     return {
         "out_h": out_h, "comp_h": comp_h, "latencies": latencies,
         "forward_ms": forward_ms, "errors": errors, "served": served,
+        "records": records,
+    }
+
+
+# virtual-event synthesis for the attribution tail: counter -> event
+# kind, measured as per-window deltas on the virtual clock. Compiles
+# are deliberately ABSENT — whether a swap's warm pre-compile really
+# compiles depends on program-cache state (cold first repeat, warm
+# later ones), and the attribution digest is asserted identical across
+# repeats; the deterministic carrier of compile absorption in a
+# virtual drill is the scripted `model_swapped` event instead.
+_ATTR_EVENT_COUNTERS: dict[str, str] = {
+    "sbt_serving_retries_total": "serving_retry",
+    "sbt_serving_batch_bisects_total": "serving_bisect",
+    "sbt_serving_batch_errors_total": "serving_batch_error",
+    "sbt_serving_degraded_forwards_total": "serving_degraded",
+}
+
+
+def _attribution_section(
+    plane,
+    records: list[dict],
+    *,
+    virtual_times: dict[int, tuple[float, float]] | None = None,
+    window_events: list[dict] | None = None,
+    max_delay_ms: float = 2.0,
+    tail_k: int = 8,
+) -> dict:
+    """Build a replay report's ``attribution`` section from the perf
+    plane's accumulators + the per-request records.
+
+    The timing surfaces (stage seconds/shares, measured
+    seconds-per-row, MFU) are wall-clock and reported as-is; the
+    ``digest`` covers only the DETERMINISTIC projection — per-path
+    request counts, per-bucket forward counts + compile-time
+    FLOPs/bytes, and the tail verdicts, which in virtual mode are
+    computed on the virtual clock (queue wait = window close − arrival,
+    events synthesized from per-window counter deltas) and are
+    therefore a pure function of ``(workload, seed, knobs, plan)``.
+    """
+    from spark_bagging_tpu.telemetry import perf as perf_mod
+
+    summary = plane.summary()
+    paths: dict[str, int] = {}
+    for r in records:
+        p = r.get("path") or "?"
+        paths[p] = paths.get(p, 0) + 1
+    if virtual_times is not None:
+        vrecords = []
+        for r in records:
+            idx = r["idx"]
+            times = virtual_times.get(idx)
+            if times is None:
+                continue
+            arrival, close = times
+            vr: dict = {
+                "idx": idx, "t": close,
+                "queue_ms": round((close - arrival) * 1e3, 9),
+            }
+            if r.get("error") is not None:
+                vr["error"] = r["error"]
+            if r.get("bucket") is not None:
+                vr["bucket"] = r["bucket"]
+            vrecords.append(vr)
+        # window_s=0: an event joins exactly the window it was
+        # measured in (both sides carry the identical close-time float
+        # under clock_key="t" — the virtual clock, never wall "ts")
+        tail_all = perf_mod.correlate_tail(
+            vrecords, window_events or [], window_s=0.0,
+            queue_threshold_ms=max_delay_ms * 0.5, clock_key="t",
+        )
+        clock = "virtual"
+    else:
+        # timed mode: wall-clock records (documented non-deterministic
+        # — replay_median skips the digest assertion there)
+        tail_all = perf_mod.correlate_tail(
+            records, window_events or [], queue_frac=0.5,
+        )
+        clock = "wall"
+    verdict_counts: dict[str, int] = {}
+    for t in tail_all:
+        verdict_counts[t["verdict"]] = (
+            verdict_counts.get(t["verdict"], 0) + 1
+        )
+    tail = sorted(
+        tail_all,
+        key=lambda t: (-(t.get("queue_ms") or t.get("total_ms") or 0.0),
+                       t.get("idx", 0)),
+    )[:tail_k]
+    det = {
+        "requests": len(records),
+        "paths": paths,
+        "buckets": {
+            b: {k: c[k] for k in ("forwards", "rows",
+                                  "flops_per_forward",
+                                  "bytes_per_forward")}
+            for b, c in summary["cost_model"].items()
+        },
+        "verdicts": verdict_counts,
+        "tail": [[t.get("idx"), t["verdict"]] for t in tail],
+    }
+    return {
+        "clock": clock,
+        "stages": summary["stages"],
+        "by_key": summary["by_key"],
+        "paths": paths,
+        "cost_model": summary["cost_model"],
+        "achieved_flops": summary["achieved_flops"],
+        "peak_tflops_bf16": summary["peak_tflops_bf16"],
+        "mfu": summary["mfu"],
+        "verdicts": verdict_counts,
+        "tail": tail,
+        "digest": hashlib.sha256(
+            json.dumps(det, sort_keys=True).encode()
+        ).hexdigest(),
     }
 
 
@@ -447,6 +576,11 @@ def replay(
     overloads = 0
     swaps_done = 0
     swap_compiles = 0.0
+    # attribution bookkeeping: per-request virtual (arrival, close)
+    # times and per-window counter-delta events — the deterministic
+    # inputs of the tail verdicts (virtual mode only)
+    virtual_times: dict[int, tuple[float, float]] = {}
+    window_events: list[dict] = []
 
     def do_swap() -> None:
         # same fitted estimator, fresh executor: the swap machinery
@@ -497,6 +631,13 @@ def replay(
         from spark_bagging_tpu import faults as faults_mod
 
         faults_mod.arm(plan)
+    # the performance-attribution plane observes the whole drive (the
+    # report's `attribution` section is built from it); the previous
+    # plane — if the host process runs one — is restored in finally
+    from spark_bagging_tpu.telemetry import perf as perf_mod
+
+    plane = perf_mod.PerfAttribution(refresh_every=0)
+    prev_plane = perf_mod.install(plane)
     t_wall0 = time.perf_counter()
     try:
         if mode == "virtual":
@@ -510,9 +651,17 @@ def replay(
                  for k in range(swaps)}
                 if swaps > 0 else set()
             )
+            attr_prev = {name: counter(name)
+                         for name in _ATTR_EVENT_COUNTERS}
             for w_i, window in enumerate(windows):
+                # the window's virtual service time: the last arrival
+                # it coalesced (the moment run_pending drains it)
+                close_t = requests[window[-1]].t
                 if w_i in swap_at:
                     do_swap()
+                    window_events.append(
+                        {"kind": "model_swapped", "t": close_t}
+                    )
                 for idx in window:
                     try:
                         futs[idx] = batcher.submit(
@@ -521,7 +670,17 @@ def replay(
                         )
                     except Overloaded:
                         overloads += 1
+                        continue
+                    virtual_times[idx] = (requests[idx].t, close_t)
                 batcher.run_pending()
+                for name, kind in _ATTR_EVENT_COUNTERS.items():
+                    cur = counter(name)
+                    if cur > attr_prev[name]:
+                        window_events.append({
+                            "kind": kind, "t": close_t,
+                            "count": int(cur - attr_prev[name]),
+                        })
+                        attr_prev[name] = cur
                 if alert_engine is not None:
                     # tick on the VIRTUAL clock (the window's open
                     # time): alert transitions become a pure function
@@ -558,6 +717,9 @@ def replay(
 
             faults_mod.disarm()
         batcher.close()
+        # restore AFTER close: a timed-mode worker's final batch must
+        # still land its breakdown in THIS replay's plane
+        perf_mod.install(prev_plane)
         if flight is not None:
             flight.disarm()
         if monitor is not None and hasattr(target, "detach_quality"):
@@ -699,6 +861,13 @@ def replay(
         "output_digest": out_h.hexdigest(),
         "drift": drift_report,
         "chaos": chaos_report,
+        "attribution": _attribution_section(
+            plane, collected["records"],
+            virtual_times=(virtual_times if mode == "virtual"
+                           else None),
+            window_events=window_events,
+            max_delay_ms=max_delay_ms,
+        ),
     }
 
 
@@ -1046,6 +1215,9 @@ def replay_fleet(
         "output_digest": collected["out_h"].hexdigest(),
         "drift": None,
         "chaos": chaos_report,
+        # per-peer attribution is not merged (the drill's registries
+        # are swapped per peer); the single-target replay carries it
+        "attribution": None,
         "fleet": fleet_report,
     }
 
@@ -1108,6 +1280,20 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             f"drift.{key} changed "
                             f"({head['drift'][key]!r} -> "
                             f"{r['drift'][key]!r})"
+                        )
+            if head.get("attribution") is not None:
+                # the attribution digest covers the deterministic
+                # projection only (per-path counts, per-bucket forward
+                # counts + compile-time costs, virtual-clock tail
+                # verdicts) — wall-clock stage seconds are reported
+                # but deliberately outside it
+                for key in ("digest", "verdicts", "paths"):
+                    if r["attribution"][key] != head["attribution"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"attribution.{key} changed "
+                            f"({head['attribution'][key]!r} -> "
+                            f"{r['attribution'][key]!r})"
                         )
             if head.get("fleet") is not None:
                 # the fleet plane's whole deterministic surface:
@@ -1605,6 +1791,13 @@ def main(argv: list[str] | None = None) -> int:
             "scrape_failures": f["scrape_failures_total"],
             "incidents": len(f["incidents"]),
             "merged_digest": f["merged_digest"][:16],
+        }
+    if report.get("attribution") is not None:
+        a = report["attribution"]
+        summary["attribution"] = {
+            "verdicts": a["verdicts"],
+            "mfu": a["mfu"],
+            "digest": a["digest"][:16],
         }
     if report.get("drift") is not None:
         d = report["drift"]

@@ -72,6 +72,35 @@ sys.path.insert(0, REPO)
 
 _X = None  # the request pool; clients index random rows out of it
 
+_mfu_warned = [False]
+
+
+def _serving_mfu(rps: float | None, flops_per_row: float | None,
+                 peak_tflops: float | None) -> float | None:
+    """Serving MFU: measured request throughput × per-row compiled
+    FLOPs over the device's bf16 peak. Returns None — with a ONE-TIME
+    warning naming why — when the device kind is unknown (CPU,
+    unrecognized accelerator) or the backend reported no cost
+    analysis; silence would read as "nobody measured it" where the
+    truth is "this host can't"."""
+    import warnings
+
+    if peak_tflops is None or flops_per_row is None:
+        if not _mfu_warned[0]:
+            _mfu_warned[0] = True
+            why = ("unknown device kind (no published peak)"
+                   if peak_tflops is None
+                   else "backend reported no compiled FLOPs")
+            warnings.warn(
+                f"serving MFU unavailable: {why}; reporting mfu=null",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return None
+    if not rps:
+        return None
+    return rps * flops_per_row / (peak_tflops * 1e12)
+
 
 def _percentile(sorted_vals: list, q: float) -> float:
     if not sorted_vals:
@@ -385,6 +414,18 @@ def main() -> int:
         "sbt_serving_compiles_total"
     ).value
 
+    # MFU inputs: per-row compiled FLOPs at the top bucket (the rung
+    # coalesced traffic rides) and the device's published bf16 peak
+    from spark_bagging_tpu.utils.profiling import device_peak_tflops
+
+    peak = device_peak_tflops()
+    flops_per_row = None
+    if ex.bucket_costs:
+        top = max(ex.bucket_costs)
+        top_flops = ex.bucket_costs[top].get("flops")
+        if top_flops:
+            flops_per_row = top_flops / top
+
     batcher_opts = dict(
         max_delay_ms=args.max_delay_ms,
         idle_flush_ms=args.idle_flush_ms,
@@ -427,6 +468,7 @@ def main() -> int:
         # coalescing worker) — includes the discarded warmup run's
         # requests, the split RATIO is the signal
         served["dispatch"] = {"direct": d1 - d0, "coalesced": c1 - c0}
+        served["mfu"] = _serving_mfu(served["rps"], flops_per_row, peak)
         result["levels"].append({
             "concurrency": conc,
             "naive": naive,               # conc sync client threads
@@ -437,6 +479,17 @@ def main() -> int:
     result["compiles_post_warmup"] = telemetry.registry().counter(
         "sbt_serving_compiles_total"
     ).value - compiles_after_warmup
+
+    # headline serving MFU (ROADMAP item 4's measured-cost input): the
+    # best served throughput across levels against the device peak —
+    # None (with the warn-once explanation) on hosts that can't report
+    # it, never a silently missing key
+    best_rps = max(
+        (lvl["served"]["rps"] for lvl in result["levels"]),
+        default=None,
+    )
+    result["peak_tflops_bf16"] = peak
+    result["mfu"] = _serving_mfu(best_rps, flops_per_row, peak)
 
     # first-class visibility for the low-concurrency story (ROADMAP
     # item 3): adaptive direct dispatch exists to win this number, and

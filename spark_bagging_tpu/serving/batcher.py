@@ -102,6 +102,7 @@ import numpy as np
 from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.serving.buckets import bucket_for, pack_plan
+from spark_bagging_tpu.telemetry import perf as _perf
 from spark_bagging_tpu.telemetry import tracing
 
 _SHUTDOWN = object()
@@ -1271,9 +1272,17 @@ class MicroBatcher:
             "path": path,
             "bucket": (buckets[0] if len(buckets) == 1
                        else list(buckets) or None),
+            "model_name": getattr(ex, "model_name", None),
             "model_version": getattr(ex, "model_version", None),
             "batch_trace_id": bctx.trace_id if bctx else None,
         }
         if error is not None:
             bd["error"] = error
         r.trace.breakdown.update(bd)
+        # performance-attribution probe (telemetry/perf.py): rides the
+        # breakdown that was just built — one module-attribute read
+        # when no plane is installed, and no probe at all on the bare
+        # hot path (trace None returned above)
+        ap = _perf.ACTIVE
+        if ap is not None:
+            ap.observe_breakdown(bd, trace_id=r.trace.trace_id)

@@ -29,6 +29,7 @@ import numpy as np
 
 from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.telemetry import perf as _perf
 from spark_bagging_tpu.telemetry import tracing
 from spark_bagging_tpu.serving import program_cache as _pc
 from spark_bagging_tpu.serving.buckets import (
@@ -772,6 +773,12 @@ class EnsembleExecutor:
         # attach the bucket choice to whatever request/batch trace is
         # current (multi-slab packs annotate once per slab)
         tracing.annotate(bucket=bucket)
+        # performance-attribution probe (telemetry/perf.py): measured
+        # per-bucket forward seconds joined with the compile-time cost
+        # gauges. The faults.ACTIVE pattern — one module-attribute
+        # read when no plane is installed, no clock, no call
+        ap = _perf.ACTIVE
+        t_perf = time.perf_counter() if ap is not None else 0.0
         if telemetry.sinks_active():
             with telemetry.span("serving_forward", bucket=bucket,
                                 rows=fill):
@@ -784,6 +791,10 @@ class EnsembleExecutor:
             # machinery — it was a measurable slice of the direct
             # path's per-request budget
             out = np.asarray(compiled(self._params, self._subspaces, Xp))
+        if ap is not None:
+            ap.observe_forward(bucket, fill,
+                               time.perf_counter() - t_perf,
+                               self.bucket_costs.get(bucket))
         return out[:fill]
 
     # -- sklearn-flavored conveniences ---------------------------------

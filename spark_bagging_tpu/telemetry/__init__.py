@@ -36,6 +36,13 @@ is the TPU-native equivalent, one subsystem with three layers:
    plus a declarative burn-rate alert engine over live registry
    series (``sbt_alerts_*``; ``alert_fired`` events trigger the
    flight recorder). Served at ``/debug/drift`` and ``/alerts``.
+6. **Performance attribution plane** (``perf.py``) — opt-in per-stage
+   cost accounting off the request breakdowns (``sbt_perf_stage_*``),
+   a measured per-bucket cost model (seconds-per-row, achieved
+   FLOP/s, serving MFU), the tail-latency explainer
+   (``/debug/tail``: deterministic verdicts joining slow requests
+   with concurrent process events), and on-demand live device
+   profiling (``/debug/profile``, single-flight + auto-stop).
 
 Cost contract: **zero overhead when disabled** — every instrumentation
 site in the engines guards on :func:`enabled` (one attribute read) or
@@ -79,6 +86,7 @@ from spark_bagging_tpu.telemetry.state import STATE as _state
 from spark_bagging_tpu.telemetry import (
     alerts,
     fleet,
+    perf,
     quality,
     recorder,
     slo,
@@ -100,7 +108,7 @@ __all__ = [
     "read_events", "last_metrics_snapshot", "runs",
     "record_fit_report", "Registry", "reset", "telemetry_dir",
     "default_log_path", "tracing", "recorder", "workload", "slo",
-    "quality", "alerts", "fleet",
+    "quality", "alerts", "fleet", "perf",
     "sinks_active", "arrival_events_wanted", "start_server",
     "stop_server", "server_address",
 ]

@@ -1,4 +1,4 @@
-"""CLI: ``python -m spark_bagging_tpu.telemetry dump [events.jsonl]``.
+"""CLI: ``python -m spark_bagging_tpu.telemetry dump|profile ...``.
 
 With no argument, dumps THIS process's registry in Prometheus text
 format (useful from a REPL/notebook via ``%run``; a fresh process has
@@ -21,6 +21,15 @@ its p50/p95/p99 estimate (log-bucket interpolation) — comment lines
 are legal in the exposition format, so the output stays scrape-
 parseable while a human reading the dump gets the SLO trio for free
 (``--no-quantiles`` drops them for byte-stable diffs).
+
+``profile --seconds N [--port P | --url http://host:port]`` triggers
+an on-demand live device profile on a RUNNING serving process through
+its exposition server's ``/debug/profile`` route (the port defaults
+to ``$SBT_METRICS_PORT``): the capture starts immediately, auto-stops
+after N seconds (hard-capped server-side), and lands under the
+process's ``telemetry_dir()/profiles/`` — no restart, no code change.
+``profile --stop`` ends a running capture early. Exit 1 when the
+process already has a capture running (HTTP 409 single-flight).
 """
 
 from __future__ import annotations
@@ -49,6 +58,58 @@ def _quantile_comments(snapshot: list[dict]) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def _profile_cmd(p: argparse.ArgumentParser, args) -> int:
+    """Drive a remote process's ``/debug/profile`` route (stdlib
+    urllib — the CLI must work on an operator box with nothing but
+    this package installed)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    base = args.url
+    if base is None:
+        port = args.port
+        if port is None:
+            env = os.environ.get("SBT_METRICS_PORT", "")
+            if not env:
+                p.error(
+                    "no target: pass --port/--url or set "
+                    "SBT_METRICS_PORT to the serving process's "
+                    "exposition port"
+                )
+            port = int(env)
+        base = f"http://127.0.0.1:{port}"
+    if args.stop:
+        url = f"{base.rstrip('/')}/debug/profile?action=stop"
+    else:
+        if args.seconds <= 0:
+            p.error(f"--seconds must be > 0, got {args.seconds}")
+        url = (f"{base.rstrip('/')}/debug/profile"
+               f"?seconds={args.seconds}")
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode("utf-8"))
+        # sbt-lint: disable=swallowed-fault — the HTTPError itself is the payload: stringified into the body printed to stderr with exit 1 below
+        except Exception:  # noqa: BLE001 — a non-JSON error body
+            body = {"error": str(e)}
+        print(json.dumps(body), file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"cannot reach {url!r}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(body))
+    if body.get("started"):
+        print(
+            f"profiling for {args.seconds}s into {body.get('dir')!r} "
+            "(auto-stops; view with tensorboard/perfetto)",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m spark_bagging_tpu.telemetry", description=__doc__
@@ -73,7 +134,35 @@ def main(argv: list[str] | None = None) -> int:
         "--no-quantiles", action="store_true",
         help="omit the per-histogram `# quantiles` comment lines",
     )
+    prof = sub.add_parser(
+        "profile",
+        help="trigger an on-demand live device profile on a running "
+             "serving process via its /debug/profile route",
+    )
+    prof.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="capture duration; the server auto-stops the profiler "
+             "after this (clamped to its hard max)",
+    )
+    prof.add_argument(
+        "--port", type=int, default=None,
+        help="exposition-server port on localhost "
+             "(default: $SBT_METRICS_PORT)",
+    )
+    prof.add_argument(
+        "--url", default=None,
+        help="full base URL of the exposition server "
+             "(overrides --port)",
+    )
+    prof.add_argument(
+        "--stop", action="store_true",
+        help="stop the process's running capture instead of starting "
+             "one",
+    )
     args = p.parse_args(argv)
+
+    if args.cmd == "profile":
+        return _profile_cmd(p, args)
 
     from spark_bagging_tpu import telemetry
 

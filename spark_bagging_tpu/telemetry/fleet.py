@@ -303,7 +303,8 @@ def merged_digest(entries: list[dict],
     for e in entries:
         if include is not None and e["name"] not in include:
             continue
-        keep.append({k: v for k, v in e.items() if k != "exemplars"})
+        keep.append({k: v for k, v in e.items()
+                     if k not in ("exemplars", "slow_exemplars")})
     keep.sort(key=_entry_sort_key)
     return hashlib.sha256(
         json.dumps(keep, sort_keys=True).encode()

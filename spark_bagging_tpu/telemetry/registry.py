@@ -139,6 +139,15 @@ SERIES_HELP: dict[str, str] = {
     "sbt_fleet_version": "Live model version reported by one peer (gauge, labels model+process)",
     "sbt_fleet_version_skew": "Max minus min live model version across fresh peers (gauge, label model; 0 = converged)",
     "sbt_fleet_convergence_seconds": "Rolling-swap convergence time: version skew rising above 0 until back to 0 (histogram, label model)",
+    "sbt_perf_stage_seconds": "Per-request wall-clock attributed to one pipeline stage (histogram, labels stage=queue/forward/scatter + path)",
+    "sbt_perf_stage_share": "Share of total request wall-clock spent in one stage (gauge, labels stage + path)",
+    "sbt_perf_bucket_seconds_per_row": "Measured forward seconds per served row at this bucket (gauge, label bucket — the live cost model)",
+    "sbt_perf_bucket_achieved_flops": "Achieved FLOP/s of this bucket's forward: compiled FLOPs over measured seconds (gauge, label bucket)",
+    "sbt_perf_mfu": "Serving model-FLOPs utilization: achieved FLOP/s over the device bf16 peak (gauge; absent on unknown device kinds)",
+    "sbt_perf_dropped_total": "Perf-attribution observations dropped by the fixed-memory key cap",
+    "sbt_profile_captures_total": "On-demand jax.profiler captures started (/debug/profile, trace(), the CLI)",
+    "sbt_profile_rejected_total": "Profile captures rejected by the single-flight guard (one capture per process)",
+    "sbt_profile_active": "A device-profile capture is currently running (gauge, 0/1)",
 }
 
 
@@ -198,9 +207,22 @@ class Histogram:
     recent exemplar (a trace id) per bucket, so a latency spike in the
     p99 bucket comes with a concrete request to go look up in the
     span log — the histogram-to-trace jump of OpenMetrics exemplars.
+
+    Alongside newest-wins, a small **top-K-by-value reservoir**
+    (``slow_exemplars``, :data:`RESERVOIR_K` entries) retains the
+    LARGEST observations seen: newest-per-bucket alone would hand the
+    tail explainer (``/debug/tail``) mostly fresh fast requests —
+    under steady traffic the slow outlier that defined the p99 is
+    evicted from its bucket within seconds. The rule is deterministic
+    (a strictly greater value evicts the current minimum; ties keep
+    the incumbent) and O(K) under the registry lock the observe
+    already holds.
     """
 
     kind = "histogram"
+
+    #: top-K-by-duration exemplar reservoir size (per histogram)
+    RESERVOIR_K = 4
 
     def __init__(self, buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
         self.bounds = tuple(sorted(buckets))
@@ -212,6 +234,8 @@ class Histogram:
         # bucket index -> {"trace_id", "value", "ts"} (last write wins:
         # the freshest example of that latency class is the useful one)
         self.exemplars: dict[int, dict[str, Any]] = {}
+        # unordered top-K-by-value entries, same shape as exemplars
+        self.slow_exemplars: list[dict[str, Any]] = []
 
     def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
@@ -219,10 +243,19 @@ class Histogram:
             if v <= b:
                 self.counts[i] += 1
                 if exemplar is not None:
-                    self.exemplars[i] = {
+                    entry = {
                         "trace_id": exemplar, "value": v,
                         "ts": time.time(),
                     }
+                    self.exemplars[i] = entry
+                    slow = self.slow_exemplars
+                    if len(slow) < self.RESERVOIR_K:
+                        slow.append(dict(entry))
+                    else:
+                        m = min(range(len(slow)),
+                                key=lambda j: slow[j]["value"])
+                        if v > slow[m]["value"]:
+                            slow[m] = dict(entry)
                 break
         # count AFTER the bucket: quantile() reads the live object
         # without the registry lock (stats paths), in the opposite
@@ -290,7 +323,11 @@ class Histogram:
         two grids cannot be combined without losing exactness, so a
         mismatch raises instead of approximating. Exemplars adopt the
         newer entry per bucket (last-write-wins, matching
-        :meth:`observe`). Returns ``self``."""
+        :meth:`observe`); the slow reservoirs merge by the reservoir's
+        own rule — the K largest values across both peers win (ties
+        broken toward the newer ``ts``), so the fleet view's tail
+        exemplars are exactly the fleet's slowest requests. Returns
+        ``self``."""
         if self.bounds != other.bounds:
             raise ValueError(
                 f"cannot merge histograms with different bounds "
@@ -304,6 +341,11 @@ class Histogram:
             mine = self.exemplars.get(i)
             if mine is None or ex.get("ts", 0) >= mine.get("ts", 0):
                 self.exemplars[i] = dict(ex)
+        pool = self.slow_exemplars + [dict(e) for e in
+                                      other.slow_exemplars]
+        pool.sort(key=lambda e: (-e.get("value", 0.0),
+                                 -e.get("ts", 0.0)))
+        self.slow_exemplars = pool[:self.RESERVOIR_K]
         return self
 
 
@@ -479,6 +521,11 @@ def histogram_entry(name: str, labels: dict, h: Histogram) -> dict:
             }
             for i, ex in sorted(h.exemplars.items())
         ]
+    if h.slow_exemplars:
+        entry["slow_exemplars"] = sorted(
+            (dict(ex) for ex in h.slow_exemplars),
+            key=lambda e: (-e.get("value", 0.0), -e.get("ts", 0.0)),
+        )
     return entry
 
 
@@ -500,6 +547,8 @@ def histogram_from_entry(entry: dict) -> Histogram:
         i = bound_index.get(math.inf if le == "+Inf" else float(le))
         if i is not None:
             h.exemplars[i] = {k: v for k, v in ex.items() if k != "le"}
+    h.slow_exemplars = [dict(ex) for ex in
+                        entry.get("slow_exemplars", ())]
     return h
 
 

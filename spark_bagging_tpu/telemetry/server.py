@@ -29,6 +29,15 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
 - ``GET /debug/drift`` — every attached quality monitor's drift
   summary (per-feature PSI/KS vs the training reference, live
   medians, disagreement stats);
+- ``GET /debug/tail`` — the tail-latency explainer
+  (``telemetry/perf.py``): the slowest retained requests, each joined
+  against the flight recorder's concurrent events into a verdict
+  (queue-dominated / compile-absorbed / retry-inflated /
+  degraded-path / genuinely-slow-forward);
+- ``GET /debug/profile?seconds=N`` — on-demand live device profiling:
+  starts a single-flight ``jax.profiler`` capture that auto-stops
+  after N seconds (hard-capped) into ``telemetry_dir()/profiles/``;
+  409 while one is already running, ``?action=stop`` ends it early;
 - ``GET /fleet/metrics`` / ``/fleet/varz`` / ``/fleet/healthz`` /
   ``/fleet/incidents`` — the fleet plane (``telemetry/fleet.py``):
   when a :class:`~spark_bagging_tpu.telemetry.fleet.FleetAggregator`
@@ -232,6 +241,57 @@ def _debug_drift() -> dict[str, Any]:
     return quality.debug_summary()
 
 
+def _debug_tail(query: dict[str, list[str]]) -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import perf
+
+    try:
+        limit = max(1, int((query.get("limit") or ["8"])[0]))
+    except ValueError:
+        limit = 8
+    try:
+        window_s = float((query.get("window_s") or ["1.0"])[0])
+    except ValueError:
+        window_s = 1.0
+    return perf.tail_report(limit=limit, window_s=window_s)
+
+
+def _debug_profile(query: dict[str, list[str]]) -> tuple[int, dict]:
+    """On-demand live device profiling: ``?seconds=N`` starts a
+    jax.profiler capture that auto-stops after N seconds (clamped to
+    the hard maximum) into ``telemetry_dir()/profiles/``; a second
+    request while one runs is rejected with 409 (the single-flight
+    guard shared with ``utils.profiling.trace()``); ``?action=stop``
+    ends a capture early."""
+    from spark_bagging_tpu.utils import profiling
+
+    action = (query.get("action") or ["start"])[0]
+    if action == "stop":
+        info = profiling.stop_profile()
+        if info is None:
+            return 200, {"stopped": False,
+                         "note": "no capture was running"}
+        return 200, {"stopped": True, **info}
+    if action != "start":
+        return 400, {"error": f"unknown action {action!r} "
+                              "(start or stop)"}
+    try:
+        seconds = float((query.get("seconds") or ["5"])[0])
+    except ValueError:
+        return 400, {"error": "seconds must be a number"}
+    if seconds <= 0:
+        return 400, {"error": f"seconds must be > 0, got {seconds}"}
+    try:
+        info = profiling.start_profile(max_seconds=seconds)
+    except profiling.ProfilerBusy as e:
+        return 409, {"error": str(e), "active": profiling.profile_active()}
+    return 200, {
+        "started": True,
+        "max_seconds_cap": profiling.PROFILE_MAX_SECONDS,
+        "view": "tensorboard --logdir " + str(info["dir"]),
+        **info,
+    }
+
+
 def _alerts() -> dict[str, Any]:
     from spark_bagging_tpu.telemetry import alerts
 
@@ -326,6 +386,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _alerts())
             elif url.path == "/debug/drift":
                 self._send_json(200, _debug_drift())
+            elif url.path == "/debug/tail":
+                self._send_json(200, _debug_tail(query))
+            elif url.path == "/debug/profile":
+                code, body = _debug_profile(query)
+                self._send_json(code, body)
             elif url.path.startswith("/fleet/"):
                 code, body, ctype = _fleet(url.path[len("/fleet/"):])
                 if ctype is not None:
@@ -338,6 +403,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "/metrics", "/healthz", "/varz", "/alerts",
                         "/debug/spans", "/debug/runs",
                         "/debug/workload", "/debug/drift",
+                        "/debug/tail", "/debug/profile",
                         "/fleet/metrics", "/fleet/varz",
                         "/fleet/healthz", "/fleet/incidents",
                     ],

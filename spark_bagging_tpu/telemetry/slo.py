@@ -47,12 +47,23 @@ class SLOSpec:
     FLOPs over total FLOPs, the honest denominator.
     ``max_post_warmup_compiles`` defaults to 0 — the serving
     subsystem's founding contract.
+
+    ``max_stage_share`` bounds per-stage attribution shares from the
+    report's ``attribution`` section (``telemetry/perf.py``): a dict
+    like ``{"queue": 0.5}`` fails the gate when queue wait exceeds
+    half the measured request wall-clock — "slow because waiting" is
+    a different regression than "slow because computing", and this is
+    where a spec says which one it refuses to ship.
     """
 
     FIELDS = (
         "p50_ms", "p95_ms", "p99_ms", "min_rps", "max_padding_waste",
-        "max_overloads", "max_post_warmup_compiles",
+        "max_overloads", "max_post_warmup_compiles", "max_stage_share",
     )
+
+    #: valid keys for ``max_stage_share`` (the perf plane's exact
+    #: wall-clock decomposition)
+    STAGES = ("queue", "forward", "scatter")
 
     def __init__(
         self,
@@ -64,6 +75,7 @@ class SLOSpec:
         max_padding_waste: float | None = None,
         max_overloads: int | None = None,
         max_post_warmup_compiles: int | None = 0,
+        max_stage_share: dict[str, float] | None = None,
     ) -> None:
         self.p50_ms = p50_ms
         self.p95_ms = p95_ms
@@ -72,6 +84,20 @@ class SLOSpec:
         self.max_padding_waste = max_padding_waste
         self.max_overloads = max_overloads
         self.max_post_warmup_compiles = max_post_warmup_compiles
+        if max_stage_share is not None:
+            unknown = set(max_stage_share) - set(self.STAGES)
+            if unknown:
+                raise ValueError(
+                    f"unknown stages in max_stage_share: "
+                    f"{sorted(unknown)}; have {list(self.STAGES)}"
+                )
+            for stage, limit in max_stage_share.items():
+                if not 0.0 <= float(limit) <= 1.0:
+                    raise ValueError(
+                        f"max_stage_share[{stage!r}] must be in "
+                        f"[0, 1], got {limit}"
+                    )
+        self.max_stage_share = max_stage_share
 
     def to_dict(self) -> dict[str, Any]:
         return {f: getattr(self, f) for f in self.FIELDS}
@@ -173,6 +199,14 @@ def evaluate(spec: SLOSpec, report: dict[str, Any]) -> SLOResult:
             "post_warmup_compiles", report.get("post_warmup_compiles"),
             spec.max_post_warmup_compiles, "<=",
         ))
+    if spec.max_stage_share:
+        stages = (report.get("attribution") or {}).get("stages") or {}
+        for stage in sorted(spec.max_stage_share):
+            share = (stages.get(stage) or {}).get("share")
+            checks.append(_check(
+                f"stage_share_{stage}", share,
+                spec.max_stage_share[stage], "<=",
+            ))
     return SLOResult(checks, kind="absolute")
 
 

@@ -5,31 +5,194 @@ the TPU-native equivalents are ``jax.profiler`` traces (viewable in
 TensorBoard/Perfetto) and ``jax.named_scope`` annotations that the
 ensemble engine wraps around its phases (bootstrap / train / aggregate)
 so device traces segment by ensemble phase.
+
+Live profiling discipline: ``jax.profiler`` allows ONE capture per
+process, and a second ``start_trace`` raises from deep inside jax with
+the first capture left running. :func:`start_profile` /
+:func:`stop_profile` wrap it in a **single-flight guard** shared by
+every entry point — the :func:`trace` context manager, the
+``/debug/profile`` server route, and the
+``python -m spark_bagging_tpu.telemetry profile`` CLI — so a second
+concurrent capture is rejected with :class:`ProfilerBusy` (a clean,
+catchable contract) instead of a jax internal error, and an optional
+hard ``max_seconds`` auto-stop guarantees a production process asked
+for "a few seconds of trace" can never be left paying profiler
+overhead forever. Artifacts default under ``telemetry_dir()/profiles/``
+(gitignored with the rest of the run artifacts).
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import os
+import threading
 import time
-from typing import Iterator
+from typing import Any, Iterator
 
 import jax
+
+from spark_bagging_tpu.analysis.locks import make_lock
 
 log = logging.getLogger("spark_bagging_tpu")
 
 
+class ProfilerBusy(RuntimeError):
+    """A device-profile capture is already running in this process —
+    ``jax.profiler`` is single-flight, so the second caller must wait
+    or stop the live capture, not stack a new one."""
+
+
+#: hard ceiling on any auto-stopped capture: a live serving process
+#: must never be left tracing indefinitely because a requested
+#: duration was fat-fingered
+PROFILE_MAX_SECONDS = 120.0
+
+_profile_lock = make_lock("utils.profiling")
+# guarded by _profile_lock; "timer" is the auto-stop handle
+_profile: dict[str, Any] = {"active": False, "dir": None,
+                            "t_start": None, "stops_at": None,
+                            "timer": None, "seq": 0}
+
+
+def default_profile_dir() -> str:
+    """Where on-demand captures land: ``telemetry_dir()/profiles/``
+    (``$SBT_TELEMETRY_DIR`` aware, covered by the same ``.gitignore``
+    entry as every other run artifact)."""
+    from spark_bagging_tpu.telemetry import telemetry_dir
+
+    path = os.path.join(telemetry_dir(), "profiles")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def profile_active() -> dict[str, Any] | None:
+    """Snapshot of the live capture (dir, started, stops_at), or None."""
+    with _profile_lock:
+        if not _profile["active"]:
+            return None
+        return {
+            "dir": _profile["dir"],
+            "t_start": _profile["t_start"],
+            "stops_at": _profile["stops_at"],
+        }
+
+
+def start_profile(log_dir: str | None = None, *,
+                  max_seconds: float | None = None) -> dict[str, Any]:
+    """Start a device-trace capture (single-flight).
+
+    ``log_dir`` defaults to a fresh timestamped directory under
+    :func:`default_profile_dir`. ``max_seconds`` arms a daemon timer
+    that auto-stops the capture (clamped to
+    :data:`PROFILE_MAX_SECONDS`) — the ``/debug/profile`` route's
+    contract; ``None`` captures until :func:`stop_profile`.
+
+    Raises :class:`ProfilerBusy` when a capture is already running
+    (counted as ``sbt_profile_rejected_total``); never leaves the
+    guard held on a failed ``jax.profiler`` start.
+    """
+    from spark_bagging_tpu import telemetry
+
+    if max_seconds is not None:
+        if max_seconds <= 0:
+            raise ValueError(
+                f"max_seconds must be > 0, got {max_seconds}"
+            )
+        max_seconds = min(float(max_seconds), PROFILE_MAX_SECONDS)
+    with _profile_lock:
+        if _profile["active"]:
+            telemetry.inc("sbt_profile_rejected_total")
+            raise ProfilerBusy(
+                f"a profile capture is already running into "
+                f"{_profile['dir']!r} (started "
+                f"{time.time() - _profile['t_start']:.1f}s ago); stop "
+                "it first — jax.profiler allows one capture per process"
+            )
+        _profile["seq"] += 1
+        gen = _profile["seq"]
+        if log_dir is None:
+            log_dir = os.path.join(
+                default_profile_dir(),
+                f"profile_{int(time.time() * 1000)}_{gen}",
+            )
+        # a failed start leaves the guard released: state is only
+        # updated after start_trace returns
+        jax.profiler.start_trace(log_dir)
+        now = time.time()
+        stops_at = (now + max_seconds if max_seconds is not None
+                    else None)
+        _profile.update(active=True, dir=log_dir, t_start=now,
+                        stops_at=stops_at)
+        if max_seconds is not None:
+            # the timer carries its capture's GENERATION: a stale
+            # callback that lost the cancel race (it had already
+            # started firing when a manual stop cancelled it, then a
+            # new capture began) must not stop the NEXT capture
+            timer = threading.Timer(max_seconds, stop_profile,
+                                    kwargs={"_gen": gen})
+            timer.daemon = True
+            _profile["timer"] = timer
+            timer.start()
+        # counters/gauge inside the lock: a stop/start interleave must
+        # never leave sbt_profile_active contradicting the guard state
+        telemetry.inc("sbt_profile_captures_total")
+        telemetry.set_gauge("sbt_profile_active", 1.0)
+    return {"dir": log_dir, "t_start": now, "stops_at": stops_at,
+            "max_seconds": max_seconds}
+
+
+def stop_profile(_gen: int | None = None) -> dict[str, Any] | None:
+    """Stop the live capture and return ``{"dir", "seconds"}`` — or
+    None when nothing is running (idempotent: the auto-stop timer and
+    a manual stop may race; the loser is a no-op). ``_gen`` is the
+    auto-stop timer's generation check — a stale timer whose capture
+    was already stopped manually no-ops instead of killing whatever
+    capture is live now."""
+    from spark_bagging_tpu import telemetry
+
+    with _profile_lock:
+        if not _profile["active"]:
+            return None
+        if _gen is not None and _gen != _profile["seq"]:
+            return None  # stale auto-stop from a finished capture
+        timer = _profile["timer"]
+        if timer is not None:
+            timer.cancel()
+        out = {
+            "dir": _profile["dir"],
+            "seconds": time.time() - _profile["t_start"],
+        }
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # the capture is over even when stop_trace itself failed
+            # (a torn artifact beats a wedged single-flight guard that
+            # rejects every future capture)
+            _profile.update(active=False, dir=None, t_start=None,
+                            stops_at=None, timer=None)
+            telemetry.set_gauge("sbt_profile_active", 0.0)
+    return out
+
+
 @contextlib.contextmanager
-def trace(log_dir: str) -> Iterator[None]:
+def trace(log_dir: str | None = None, *,
+          max_seconds: float | None = None) -> Iterator[None]:
     """Capture a device trace for everything inside the block.
 
     View with TensorBoard (``tensorboard --logdir <dir>``) or Perfetto.
+    ``log_dir`` defaults into ``telemetry_dir()/profiles/``. Shares the
+    process single-flight guard with ``/debug/profile``: a concurrent
+    or nested capture raises :class:`ProfilerBusy` up front instead of
+    a jax internal error out of the context manager (which used to
+    leave the FIRST capture's ``stop_trace`` running in this block's
+    ``finally`` and kill it too).
     """
-    jax.profiler.start_trace(log_dir)
+    start_profile(log_dir, max_seconds=max_seconds)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_profile()
 
 
 @contextlib.contextmanager

@@ -25,6 +25,14 @@ import numpy as np
 import pytest
 
 
+# per-module wall-clock accumulator (setup + call + teardown), fed by
+# pytest_runtest_logreport below; tests/test_zz_tier_budget.py writes
+# it out as telemetry_dir()/tier1_timings.json so tier-restructuring
+# work (ROADMAP item 5) starts from measured data instead of
+# rediscovering where the seconds go with --durations runs
+_MODULE_TIMES: dict[str, float] = {}
+
+
 def pytest_configure(config):
     # session wall-clock anchor for the tier-1 budget ratchet
     # (tests/test_zz_tier_budget.py): recorded as early as pytest
@@ -32,6 +40,14 @@ def pytest_configure(config):
     # that ran before the ratchet (which sorts last by filename under
     # the tier's -p no:randomly ordering)
     config._sbt_tier_t0 = time.monotonic()
+    config._sbt_module_times = _MODULE_TIMES
+
+
+def pytest_runtest_logreport(report):
+    mod = report.nodeid.split("::", 1)[0]
+    _MODULE_TIMES[mod] = (
+        _MODULE_TIMES.get(mod, 0.0) + getattr(report, "duration", 0.0)
+    )
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,717 @@
+"""Performance attribution plane [ISSUE 13]: per-stage cost
+accounting fed from the trace breakdowns, the measured per-bucket cost
+model (seconds-per-row / achieved FLOP/s / MFU), the deterministic
+tail-latency explainer (`correlate_tail` + /debug/tail), on-demand
+live device profiling (/debug/profile, single-flight + auto-stop),
+the latency-histogram slow-exemplar reservoir, and the zero-overhead
+contract of the new hot-path probes."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.serving import EnsembleExecutor, MicroBatcher
+from spark_bagging_tpu.telemetry import perf, recorder
+from spark_bagging_tpu.telemetry.registry import (
+    Histogram,
+    Registry,
+    SERIES_HELP,
+    histogram_entry,
+    histogram_from_entry,
+)
+from spark_bagging_tpu.utils import profiling
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_clock():
+    return time.perf_counter()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    perf.disable()
+    yield
+    perf.disable()
+    profiling.stop_profile()  # never leak the single-flight guard
+    telemetry.reset()
+    telemetry.enable()
+
+
+@pytest.fixture(scope="module")
+def clf():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(96, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3),
+        n_estimators=4, seed=0,
+    ).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def warmed_ex(clf):
+    ex = EnsembleExecutor(clf, min_bucket_rows=8, max_batch_rows=32)
+    ex.warmup()
+    return ex
+
+
+def _bd(total=10.0, queue=2.0, forward=6.0, batch=8.0,
+        path="coalesced", **extra):
+    bd = {"total_ms": total, "queue_ms": queue, "forward_ms": forward,
+          "batch_ms": batch, "path": path, "batch_size": 1,
+          "bucket": 8}
+    bd.update(extra)
+    return bd
+
+
+# -- stage rollups -----------------------------------------------------
+
+class TestStageRollups:
+    def test_shares_partition_the_wall_clock(self):
+        p = perf.PerfAttribution(refresh_every=0)
+        p.observe_breakdown(_bd(total=10, queue=2, forward=6, batch=8))
+        p.observe_breakdown(_bd(total=20, queue=10, forward=8, batch=10))
+        s = p.summary()
+        assert s["requests"] == 2
+        st = s["stages"]
+        # queue 12ms, forward 14ms, scatter (8-6)+(10-8)=4ms, total 30
+        assert st["queue"]["seconds"] == pytest.approx(0.012)
+        assert st["forward"]["seconds"] == pytest.approx(0.014)
+        assert st["scatter"]["seconds"] == pytest.approx(0.004)
+        assert sum(v["share"] for v in st.values()) == pytest.approx(1.0)
+
+    def test_keys_split_by_path_and_model(self):
+        p = perf.PerfAttribution(refresh_every=0)
+        p.observe_breakdown(_bd(path="direct", model_name="m"))
+        p.observe_breakdown(_bd(path="coalesced", model_name="m"))
+        p.observe_breakdown(_bd(path="coalesced", model_name="m"))
+        keys = {(e["path"], e["model"]): e["requests"]
+                for e in p.summary()["by_key"]}
+        assert keys == {("direct", "m"): 1, ("coalesced", "m"): 2}
+
+    def test_fixed_memory_key_cap_counts_drops(self):
+        p = perf.PerfAttribution(refresh_every=0, max_keys=2)
+        for i in range(5):
+            p.observe_breakdown(_bd(model_name=f"m{i}"))
+        s = p.summary()
+        assert len(s["by_key"]) == 2
+        assert s["dropped_keys"] == 3
+        assert s["requests"] == 5  # observations still counted
+        p.export()
+        assert telemetry.registry().counter(
+            "sbt_perf_dropped_total").value == 3
+
+    def test_key_cap_also_bounds_registry_series(self):
+        """A label-cardinality accident (many distinctly-named models)
+        must not grow the REGISTRY either: dropped keys export no
+        sbt_perf_stage_seconds series — the fixed-memory contract
+        covers the instrument panel, not just the accumulators."""
+        p = perf.PerfAttribution(refresh_every=0, max_keys=2)
+        for i in range(40):
+            p.observe_breakdown(_bd(model_name=f"m{i}"))
+        models = {
+            e["labels"].get("model")
+            for e in telemetry.registry().snapshot()
+            if e["name"] == "sbt_perf_stage_seconds"
+        }
+        assert len(models) == 2  # the capped key set, nothing more
+
+    def test_stage_histograms_exported_with_labels(self):
+        p = perf.PerfAttribution(refresh_every=0)
+        p.observe_breakdown(_bd(path="direct"), trace_id="tr-1")
+        snap = {(e["name"], tuple(sorted(e["labels"].items())))
+                for e in telemetry.registry().snapshot()}
+        for stage in ("queue", "forward", "scatter"):
+            assert ("sbt_perf_stage_seconds",
+                    (("path", "direct"), ("stage", stage))) in snap
+
+    def test_share_gauges_exported_on_refresh_cadence(self):
+        p = perf.PerfAttribution(refresh_every=2)
+        p.observe_breakdown(_bd())
+        names = {e["name"] for e in telemetry.registry().snapshot()}
+        assert "sbt_perf_stage_share" not in names
+        p.observe_breakdown(_bd())  # 2nd observation: cadence fires
+        entries = {
+            e["labels"]["stage"]: e["value"]
+            for e in telemetry.registry().snapshot()
+            if e["name"] == "sbt_perf_stage_share"
+        }
+        assert set(entries) == {"queue", "forward", "scatter"}
+        assert sum(entries.values()) == pytest.approx(1.0)
+
+
+class TestSlowReservoir:
+    def test_retains_top_k_by_duration_deterministically(self):
+        p = perf.PerfAttribution(refresh_every=0, slow_k=3)
+        for i, total in enumerate([5, 50, 1, 30, 2, 40, 7]):
+            p.observe_breakdown(_bd(total=total), trace_id=f"t{i}")
+        slow = p.slow_records()
+        assert [r["total_ms"] for r in slow] == [50, 40, 30]
+        # ties keep the incumbent: a second 30ms request does not evict
+        p.observe_breakdown(_bd(total=30), trace_id="late-tie")
+        assert {r["trace_id"] for r in p.slow_records()} == \
+            {"t1", "t5", "t3"}
+
+    def test_record_carries_the_breakdown_facts(self):
+        p = perf.PerfAttribution(refresh_every=0)
+        p.observe_breakdown(
+            _bd(total=9, path="direct", model_version=3,
+                error="RuntimeError('x')"),
+            trace_id="tr-err",
+        )
+        (r,) = p.slow_records()
+        assert r["trace_id"] == "tr-err"
+        assert r["path"] == "direct"
+        assert r["model_version"] == 3
+        assert r["error"].startswith("RuntimeError")
+        assert r["ts"] > 0
+
+
+# -- the measured cost model -------------------------------------------
+
+class TestCostModel:
+    def test_joins_measured_seconds_with_compiled_cost(self):
+        p = perf.PerfAttribution(refresh_every=0)
+        cost = {"flops": 1e6, "bytes": 2e5}
+        p.observe_forward(32, 32, 0.010, cost)
+        p.observe_forward(32, 16, 0.006, cost)
+        cm = p.cost_model()["32"]
+        assert cm["forwards"] == 2 and cm["rows"] == 48
+        assert cm["seconds_per_row"] == pytest.approx(0.016 / 48)
+        assert cm["achieved_flops"] == pytest.approx(2e6 / 0.016)
+        assert cm["flops_per_forward"] == 1e6
+        assert cm["bytes_per_forward"] == 2e5
+        # CPU host: no published peak, MFU honestly None
+        assert cm["mfu"] is None
+        assert p.summary()["peak_tflops_bf16"] is None
+
+    def test_mfu_against_a_known_peak(self):
+        p = perf.PerfAttribution(refresh_every=0)
+        p._peak_tflops, p._peak_resolved = 100.0, True  # fake a chip
+        p.observe_forward(8, 8, 0.001, {"flops": 5e9, "bytes": None})
+        cm = p.cost_model()["8"]
+        assert cm["achieved_flops"] == pytest.approx(5e12)
+        assert cm["mfu"] == pytest.approx(0.05)
+        s = p.summary()
+        assert s["mfu"] == pytest.approx(0.05)
+        p.export()
+        reg = telemetry.registry()
+        assert reg.gauge("sbt_perf_mfu").value == pytest.approx(0.05)
+        assert reg.gauge("sbt_perf_bucket_seconds_per_row",
+                         labels={"bucket": "8"}).value == \
+            pytest.approx(0.001 / 8)
+
+    def test_executor_probe_feeds_installed_plane_only(self, warmed_ex,
+                                                      clf):
+        X = np.random.default_rng(1).normal(size=(8, 6)).astype(
+            np.float32)
+        warmed_ex.forward(X)  # no plane installed: nothing recorded
+        plane = perf.enable(refresh_every=0)
+        warmed_ex.forward(X)
+        warmed_ex.forward(X[:4])
+        cm = plane.cost_model()
+        assert cm["8"]["forwards"] == 2
+        assert cm["8"]["rows"] == 12
+        assert cm["8"]["seconds"] > 0
+        # CPU XLA reports cost analysis: the join is live
+        assert cm["8"]["flops_per_forward"] is not None
+        assert cm["8"]["achieved_flops"] is not None
+
+    def test_batcher_probe_rides_the_breakdown(self, warmed_ex):
+        X = np.random.default_rng(2).normal(size=(4, 6)).astype(
+            np.float32)
+        plane = perf.enable(refresh_every=0)
+        with MicroBatcher(warmed_ex, max_delay_ms=1,
+                          direct_dispatch=False) as b:
+            futs = [b.submit(X) for _ in range(6)]
+            for f in futs:
+                f.result(30)
+        s = plane.summary()
+        assert s["requests"] == 6
+        assert s["stages"]["forward"]["seconds"] > 0
+        assert any(e["path"] == "coalesced" for e in s["by_key"])
+
+    def test_disabled_probe_is_one_attribute_read(self):
+        """PR-1-style micro-benchmark: the uninstalled plane's probe
+        (exactly what _forward_piece and _finish_breakdown run) must
+        stay far under a microsecond."""
+        perf.disable()
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ap = perf.ACTIVE
+            if ap is not None:  # pragma: no cover — disabled
+                raise AssertionError
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 2e-6, f"{per_call * 1e9:.0f}ns per probe"
+
+
+# -- the tail explainer ------------------------------------------------
+
+class TestCorrelateTail:
+    def test_verdict_priority_ladder(self):
+        base = {"ts": 100.0, "total_ms": 50.0, "queue_ms": 40.0}
+        ev = lambda kind, t=100.0: {"kind": kind, "ts": t}  # noqa: E731
+        cases = [
+            ({"error": "boom"}, [ev("serving_retry")], "failed"),
+            ({}, [ev("serving_shard_failed")], "degraded-path"),
+            ({}, [ev("serving_retry")], "retry-inflated"),
+            ({}, [ev("model_swapped")], "compile-absorbed"),
+            ({}, [], "queue-dominated"),          # 40/50 >= 0.5
+            ({"queue_ms": 1.0}, [], "genuinely-slow-forward"),
+        ]
+        for patch, events, want in cases:
+            (out,) = perf.correlate_tail([{**base, **patch}], events)
+            assert out["verdict"] == want, (patch, events)
+
+    def test_compile_span_events_join(self):
+        rec = {"ts": 10.0, "total_ms": 5.0, "queue_ms": 0.0}
+        (out,) = perf.correlate_tail(
+            [rec],
+            [{"kind": "span", "name": "serving_compile", "ts": 10.2}],
+        )
+        assert out["verdict"] == "compile-absorbed"
+        assert out["evidence"] == [{"t": 10.2,
+                                    "kind": "serving_compile"}]
+        # a non-compile span is not evidence
+        (out,) = perf.correlate_tail(
+            [rec], [{"kind": "span", "name": "serving_batch", "ts": 10.2}]
+        )
+        assert out["verdict"] == "genuinely-slow-forward"
+
+    def test_window_bounds_the_join(self):
+        rec = {"ts": 100.0, "total_ms": 5.0, "queue_ms": 0.0}
+        far = [{"kind": "serving_retry", "ts": 200.0}]
+        (out,) = perf.correlate_tail([rec], far, window_s=1.0)
+        assert out["verdict"] == "genuinely-slow-forward"
+        assert out["events_in_window"] == 0
+        (out,) = perf.correlate_tail([rec], far, window_s=150.0)
+        assert out["verdict"] == "retry-inflated"
+
+    def test_queue_threshold_rule_for_totals_unknown(self):
+        recs = [{"ts": 1.0, "queue_ms": 3.0},
+                {"ts": 2.0, "queue_ms": 0.5}]
+        out = perf.correlate_tail(recs, [], queue_threshold_ms=1.0)
+        assert [o["verdict"] for o in out] == [
+            "queue-dominated", "genuinely-slow-forward",
+        ]
+
+    def test_overload_burst_is_a_queue_factor(self):
+        (out,) = perf.correlate_tail(
+            [{"ts": 5.0, "total_ms": 4.0, "queue_ms": 0.1}],
+            [{"kind": "serving_overloaded", "ts": 5.1}],
+        )
+        assert out["verdict"] == "queue-dominated"
+        assert "overload-burst" in out["factors"]
+
+    def test_tail_report_joins_reservoir_with_flight_ring(self,
+                                                         warmed_ex):
+        X = np.random.default_rng(3).normal(size=(4, 6)).astype(
+            np.float32)
+        plane = perf.enable(refresh_every=0)
+        rec = recorder.FlightRecorder(capacity=64)
+        rec.arm()
+        try:
+            with MicroBatcher(warmed_ex, max_delay_ms=1) as b:
+                for _ in range(4):
+                    b.submit(X).result(30)
+            report = perf.tail_report(limit=4, window_s=5.0)
+        finally:
+            rec.disarm()
+        assert report["source"] == "perf-reservoir"
+        assert report["perf_plane_active"] is True
+        assert len(report["tail"]) == 4
+        assert all(r["verdict"] in perf.VERDICTS
+                   for r in report["tail"])
+        # slowest first, and the stage rollup rides along
+        totals = [r["total_ms"] for r in report["tail"]]
+        assert totals == sorted(totals, reverse=True)
+        assert set(report["stages"]) == {"queue", "forward", "scatter"}
+        assert plane.summary()["requests"] == 4
+
+    def test_tail_report_falls_back_to_latency_exemplars(self):
+        perf.disable()
+        telemetry.observe("sbt_serving_latency_seconds", 0.05,
+                          exemplar="tr-fast")
+        telemetry.observe("sbt_serving_latency_seconds", 4.0,
+                          exemplar="tr-slow")
+        report = perf.tail_report(limit=4)
+        assert report["source"] == "latency-exemplars"
+        assert report["perf_plane_active"] is False
+        ids = [r["trace_id"] for r in report["tail"]]
+        assert ids[0] == "tr-slow"  # slowest first
+
+    def test_tail_report_empty_carries_a_note(self):
+        perf.disable()
+        report = perf.tail_report()
+        assert report["tail"] == []
+        assert "note" in report
+
+
+# -- the latency-histogram slow-exemplar reservoir ---------------------
+
+class TestSlowExemplarReservoir:
+    def test_top_k_survive_newest_wins_eviction(self):
+        h = Histogram()
+        h.observe(3.0, exemplar="tr-slowest")
+        # a stream of fast requests in the same bucket as each other:
+        # newest-wins per bucket forgets everything but the last one
+        for i in range(50):
+            h.observe(0.01 + i * 1e-6, exemplar=f"tr-{i}")
+        fast_bucket_exemplars = {
+            ex["trace_id"] for ex in h.exemplars.values()
+        }
+        reservoir = {ex["trace_id"] for ex in h.slow_exemplars}
+        assert "tr-slowest" in reservoir
+        assert len(h.slow_exemplars) == Histogram.RESERVOIR_K
+        # the reservoir keeps the K largest, not the K newest
+        assert "tr-0" not in reservoir or "tr-slowest" in reservoir
+        assert "tr-slowest" in fast_bucket_exemplars | reservoir
+
+    def test_ties_keep_the_incumbent(self):
+        h = Histogram()
+        for i in range(Histogram.RESERVOIR_K):
+            h.observe(1.0, exemplar=f"first-{i}")
+        h.observe(1.0, exemplar="tie-later")
+        assert {e["trace_id"] for e in h.slow_exemplars} == {
+            f"first-{i}" for i in range(Histogram.RESERVOIR_K)
+        }
+
+    def test_merge_takes_the_fleet_wide_k_largest(self):
+        a, b = Histogram(), Histogram()
+        for v in (1.0, 2.0, 3.0, 4.0):
+            a.observe(v, exemplar=f"a-{v}")
+        for v in (10.0, 0.5, 5.0, 0.1):
+            b.observe(v, exemplar=f"b-{v}")
+        a.merge(b)
+        got = sorted(e["value"] for e in a.slow_exemplars)
+        assert got == [3.0, 4.0, 5.0, 10.0]
+
+    def test_entry_round_trip_preserves_reservoir(self):
+        r = Registry()
+        r.observe("sbt_lat_seconds", 2.0, exemplar="tr-big")
+        r.observe("sbt_lat_seconds", 0.01, exemplar="tr-small")
+        (entry,) = r.snapshot()
+        assert entry["slow_exemplars"][0]["trace_id"] == "tr-big"
+        h2 = histogram_from_entry(entry)
+        assert {e["trace_id"] for e in h2.slow_exemplars} == \
+            {"tr-big", "tr-small"}
+        # and re-serializing is stable
+        assert histogram_entry(
+            "sbt_lat_seconds", {}, h2
+        )["slow_exemplars"] == entry["slow_exemplars"]
+
+    def test_fleet_digest_strips_the_reservoir(self):
+        from spark_bagging_tpu.telemetry.fleet import merged_digest
+
+        r = Registry()
+        r.observe("sbt_serving_latency_seconds", 1.0, exemplar="tr-1")
+        (entry,) = r.snapshot()
+        bare = {k: v for k, v in entry.items()
+                if k not in ("exemplars", "slow_exemplars")}
+        assert merged_digest([entry], series=None) == \
+            merged_digest([bare], series=None)
+
+
+# -- on-demand live device profiling -----------------------------------
+
+class _FakeProfiler:
+    """Stand-in for jax.profiler so the single-flight/auto-stop
+    contract tests don't pay the ~4s real-profiler spin-up (the real
+    artifact is covered once by the budgeted route test below)."""
+
+    def __init__(self):
+        self.started: list[str] = []
+        self.stopped = 0
+
+    def start_trace(self, d):
+        self.started.append(d)
+
+    def stop_trace(self):
+        self.stopped += 1
+
+
+@pytest.fixture()
+def fake_profiler(monkeypatch):
+    fake = _FakeProfiler()
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+class TestProfileSingleFlight:
+    def test_second_capture_rejected_cleanly(self, fake_profiler,
+                                             tmp_path):
+        info = profiling.start_profile(str(tmp_path / "p1"))
+        assert profiling.profile_active()["dir"] == info["dir"]
+        with pytest.raises(profiling.ProfilerBusy):
+            profiling.start_profile(str(tmp_path / "p2"))
+        reg = telemetry.registry()
+        assert reg.counter("sbt_profile_rejected_total").value == 1
+        out = profiling.stop_profile()
+        assert out["dir"] == info["dir"] and out["seconds"] >= 0
+        assert profiling.profile_active() is None
+        assert fake_profiler.started == [str(tmp_path / "p1")]
+        assert fake_profiler.stopped == 1
+        assert profiling.stop_profile() is None  # idempotent
+
+    def test_trace_cm_shares_the_guard(self, fake_profiler, tmp_path):
+        with profiling.trace(str(tmp_path / "t")):
+            with pytest.raises(profiling.ProfilerBusy):
+                with profiling.trace(str(tmp_path / "nested")):
+                    pass  # pragma: no cover
+        assert profiling.profile_active() is None
+        assert fake_profiler.stopped == 1  # the outer one, exactly once
+
+    def test_default_dir_under_telemetry_profiles(self, fake_profiler,
+                                                  tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("SBT_TELEMETRY_DIR", str(tmp_path))
+        info = profiling.start_profile()
+        profiling.stop_profile()
+        assert info["dir"].startswith(
+            os.path.join(str(tmp_path), "profiles")
+        )
+
+    def test_auto_stop_at_max_duration(self, fake_profiler, tmp_path):
+        info = profiling.start_profile(str(tmp_path / "a"),
+                                       max_seconds=0.2)
+        assert info["stops_at"] is not None
+        deadline = time.monotonic() + 5.0
+        while (profiling.profile_active() is not None
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert profiling.profile_active() is None
+        assert fake_profiler.stopped == 1
+        # max_seconds is clamped to the hard ceiling
+        info = profiling.start_profile(str(tmp_path / "b"),
+                                       max_seconds=1e9)
+        assert info["max_seconds"] == profiling.PROFILE_MAX_SECONDS
+        profiling.stop_profile()
+
+    def test_bad_durations_rejected(self, fake_profiler):
+        with pytest.raises(ValueError):
+            profiling.start_profile(max_seconds=0)
+        assert profiling.profile_active() is None
+
+    def test_stale_auto_stop_cannot_kill_the_next_capture(
+        self, fake_profiler, tmp_path
+    ):
+        """The lost-cancel race: capture 1's auto-stop timer fires
+        AFTER capture 1 was stopped manually and capture 2 began —
+        its generation check must make it a no-op instead of stopping
+        capture 2 milliseconds in."""
+        profiling.start_profile(str(tmp_path / "c1"),
+                                max_seconds=30.0)
+        stale_gen = profiling._profile["seq"]
+        assert profiling.stop_profile() is not None  # manual stop
+        profiling.start_profile(str(tmp_path / "c2"),
+                                max_seconds=30.0)
+        # the stale timer callback, replayed by hand
+        assert profiling.stop_profile(_gen=stale_gen) is None
+        active = profiling.profile_active()
+        assert active is not None and active["dir"].endswith("c2")
+        # capture 2's OWN generation still stops it
+        assert profiling.stop_profile(
+            _gen=profiling._profile["seq"]
+        ) is not None
+        assert profiling.profile_active() is None
+
+
+class TestProfileRouteAndCLI:
+    @pytest.fixture()
+    def server_port(self):
+        port = telemetry.start_server(0)
+        yield port
+        telemetry.stop_server()
+        recorder.disarm()
+
+    def _get(self, port, path):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_route_contract_busy_stop_and_validation(
+        self, server_port, fake_profiler, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("SBT_TELEMETRY_DIR", str(tmp_path))
+        code, body = self._get(server_port,
+                               "/debug/profile?seconds=30")
+        assert code == 200 and body["started"] is True
+        # single-flight: a second concurrent capture is a 409
+        code, body2 = self._get(server_port,
+                                "/debug/profile?seconds=1")
+        assert code == 409
+        assert body2["active"]["dir"] == body["dir"]
+        code, stopped = self._get(server_port,
+                                  "/debug/profile?action=stop")
+        assert code == 200 and stopped["stopped"] is True
+        code, _ = self._get(server_port,
+                            "/debug/profile?action=stop")
+        assert code == 200  # idempotent
+        code, err = self._get(server_port,
+                              "/debug/profile?seconds=bogus")
+        assert code == 400
+        code, err = self._get(server_port,
+                              "/debug/profile?seconds=-1")
+        assert code == 400
+
+    def test_cli_drives_a_remote_process(self, server_port,
+                                         fake_profiler, tmp_path,
+                                         monkeypatch, capsys):
+        from spark_bagging_tpu.telemetry.__main__ import main
+
+        monkeypatch.setenv("SBT_TELEMETRY_DIR", str(tmp_path))
+        rc = main(["profile", "--seconds", "30",
+                   "--port", str(server_port)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["started"] is True
+        # busy process: CLI exits 1 with the 409 body on stderr
+        rc = main(["profile", "--seconds", "1",
+                   "--port", str(server_port)])
+        assert rc == 1
+        rc = main(["profile", "--stop", "--port", str(server_port)])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["stopped"] is True
+
+    def test_real_capture_produces_viewable_artifact(
+        self, server_port, tmp_path, monkeypatch
+    ):
+        """THE acceptance drill, real profiler: /debug/profile starts
+        a capture, the auto-stop timer ends it at the requested max
+        duration, and a trace artifact lands under
+        telemetry_dir()/profiles/. Budget-asserted (~5s: one-time
+        profiler spin-up dominates)."""
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("SBT_TELEMETRY_DIR", str(tmp_path))
+        t0 = time.perf_counter()
+        code, body = self._get(server_port,
+                               "/debug/profile?seconds=0.8")
+        assert code == 200 and body["started"] is True
+        assert body["dir"].startswith(
+            os.path.join(str(tmp_path), "profiles")
+        )
+        jnp.sum(jnp.arange(512.0)).block_until_ready()  # traced work
+        deadline = time.monotonic() + 15.0
+        while (profiling.profile_active() is not None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert profiling.profile_active() is None, \
+            "auto-stop never fired"
+        found = []
+        for root, _, files in os.walk(body["dir"]):
+            found.extend(files)
+        assert found, "no trace artifact written"
+        reg = telemetry.registry()
+        assert reg.counter("sbt_profile_captures_total").value >= 1
+        assert reg.gauge("sbt_profile_active").value == 0.0
+        assert time.perf_counter() - t0 < 20.0
+
+
+# -- SLO stage-share ceilings ------------------------------------------
+
+class TestStageShareSLO:
+    def test_spec_validation(self):
+        from spark_bagging_tpu.telemetry import slo
+
+        with pytest.raises(ValueError, match="unknown stages"):
+            slo.SLOSpec(max_stage_share={"gpu": 0.5})
+        with pytest.raises(ValueError, match="0, 1"):
+            slo.SLOSpec(max_stage_share={"queue": 1.5})
+        spec = slo.SLOSpec.from_dict(
+            {"max_stage_share": {"queue": 0.5}}
+        )
+        assert spec.max_stage_share == {"queue": 0.5}
+        assert spec.to_dict()["max_stage_share"] == {"queue": 0.5}
+
+    def test_evaluate_reads_the_attribution_section(self):
+        from spark_bagging_tpu.telemetry import slo
+
+        report = {
+            "post_warmup_compiles": 0,
+            "attribution": {"stages": {
+                "queue": {"seconds": 0.06, "share": 0.6},
+                "forward": {"seconds": 0.03, "share": 0.3},
+                "scatter": {"seconds": 0.01, "share": 0.1},
+            }},
+        }
+        ok = slo.evaluate(
+            slo.SLOSpec(max_stage_share={"forward": 0.9}), report
+        )
+        assert ok.ok, ok.render()
+        bad = slo.evaluate(
+            slo.SLOSpec(max_stage_share={"queue": 0.5}), report
+        )
+        assert not bad.ok
+        assert bad.failures[0]["name"] == "stage_share_queue"
+        # a report with no attribution fails loudly, not silently
+        missing = slo.evaluate(
+            slo.SLOSpec(max_stage_share={"queue": 0.5}),
+            {"post_warmup_compiles": 0},
+        )
+        assert not missing.ok
+
+
+# -- serving-bench MFU -------------------------------------------------
+
+class TestServingBenchMFU:
+    def test_mfu_math_and_warn_once_none_path(self):
+        import warnings
+
+        from benchmarks import serving_latency as SL
+
+        SL._mfu_warned[0] = False
+        assert SL._serving_mfu(1000.0, 1e9, 100.0) == \
+            pytest.approx(1000.0 * 1e9 / 1e14)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert SL._serving_mfu(1000.0, 1e9, None) is None
+            assert SL._serving_mfu(1000.0, None, 100.0) is None
+        mfu_warnings = [x for x in w if "MFU" in str(x.message)]
+        assert len(mfu_warnings) == 1  # warn ONCE, then quiet
+        assert SL._serving_mfu(None, 1e9, 100.0) is None
+
+
+# -- series help completeness (the new sbt_perf_*/sbt_profile_*) -------
+
+def test_new_series_have_help_entries():
+    for name in (
+        "sbt_perf_stage_seconds", "sbt_perf_stage_share",
+        "sbt_perf_bucket_seconds_per_row",
+        "sbt_perf_bucket_achieved_flops", "sbt_perf_mfu",
+        "sbt_perf_dropped_total", "sbt_profile_captures_total",
+        "sbt_profile_rejected_total", "sbt_profile_active",
+    ):
+        assert name in SERIES_HELP, name
+
+
+def test_zz_perf_suite_under_budget(_module_clock):
+    """Tier-1 allowance for this module (the PR-11 ratchet
+    discipline): everything here is unit-sized except the one real
+    profiler drill, whose one-time spin-up dominates."""
+    elapsed = time.perf_counter() - _module_clock
+    assert elapsed < 20.0, (
+        f"tests/test_perf.py took {elapsed:.1f}s; move the offender "
+        "to -m slow or shrink it"
+    )

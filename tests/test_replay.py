@@ -298,6 +298,94 @@ def test_plain_replay_carries_no_drift_section(executor, wl):
     assert not any(n.startswith("drift_") for n in names)
 
 
+# -- the attribution section (ISSUE 13) --------------------------------
+
+def test_attribution_section_deterministic(executor, wl):
+    """The report gains an `attribution` section whose digest — the
+    deterministic projection: per-path counts, per-bucket forward
+    counts + compile-time costs, virtual-clock tail verdicts — is
+    byte-identical across replay_median repeats (replay_median raises
+    otherwise), while the wall-clock surfaces (stage seconds/shares,
+    measured seconds-per-row) ride alongside undigested."""
+    m = R.replay_median(wl, repeats=3, executor=executor, seed=3)
+    a = m["attribution"]
+    assert a is not None and a["clock"] == "virtual"
+    single = R.replay(wl, executor=executor, seed=3)
+    assert a["digest"] == single["attribution"]["digest"]
+    # the wall-clock decomposition partitions the request life
+    shares = [v["share"] for v in a["stages"].values()]
+    assert all(s is not None for s in shares)
+    assert sum(shares) == pytest.approx(1.0)
+    # the measured cost model joined compile-time FLOPs (CPU XLA
+    # reports cost analysis) with real seconds
+    assert a["cost_model"]
+    for c in a["cost_model"].values():
+        assert c["forwards"] > 0 and c["seconds_per_row"] > 0
+        assert c["flops_per_forward"] is not None
+        assert c["achieved_flops"] is not None
+    assert a["mfu"] is None  # no published peak for CPU — honest None
+    # every request got a verdict; a clean drill fails nothing
+    assert sum(a["verdicts"].values()) == wl.n_requests
+    assert "failed" not in a["verdicts"]
+    assert len(a["tail"]) > 0
+    # a different seed is a different workload payload but the SAME
+    # schedule: verdicts (a pure function of the schedule) hold
+    r2 = R.replay(wl, executor=executor, seed=4)
+    assert r2["attribution"]["verdicts"] == a["verdicts"]
+
+
+def test_attribution_stage_share_gate(executor, wl):
+    from spark_bagging_tpu.telemetry import slo
+
+    r = R.replay(wl, executor=executor, seed=3)
+    ok = R.check_report(
+        r, spec=slo.SLOSpec(max_stage_share={"queue": 1.0,
+                                             "forward": 1.0})
+    )
+    assert ok.ok, ok.render()
+    bad = R.check_report(
+        r, spec=slo.SLOSpec(max_stage_share={"forward": 0.0})
+    )
+    assert not bad.ok
+    assert {c["name"] for c in bad.failures} == {"stage_share_forward"}
+
+
+def test_attribution_chaos_verdicts_deterministic(executor, wl):
+    """Under a chaos plan the tail explainer must attribute the
+    injected incidents: transient blips absorbed by retries show up
+    as retry-inflated verdicts in exactly the windows the plan fired
+    in — and the whole thing stays byte-identical across repeats
+    (replay_median asserts the attribution digest)."""
+    from spark_bagging_tpu import faults
+
+    spec = faults.builtin_plan_spec("blips", seed=3)
+    m = R.replay_median(wl, repeats=2, executor=executor, seed=3,
+                        chaos=spec, retries=2)
+    a = m["attribution"]
+    assert m["chaos"]["retries"] > 0
+    assert a["verdicts"].get("retry-inflated", 0) > 0
+    assert m["errors"] == 0  # the retries absorbed every blip
+
+
+def test_attribution_swap_windows_absorb_compiles(clf, wl):
+    """A swap drill's scripted model_swapped events are the
+    deterministic carrier of compile absorption: requests riding the
+    swap windows verdict compile-absorbed (cache-dependent compile
+    counters deliberately do NOT feed the digest)."""
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", clf, warmup=True)
+    r = R.replay(wl, registry=reg, model_name="m", seed=3, swaps=2)
+    a = r["attribution"]
+    assert a["verdicts"].get("compile-absorbed", 0) > 0
+    assert R.check_report(r).ok
+
+
+def test_fleet_report_carries_no_attribution(clf, wl):
+    r = R.replay_fleet(wl, model=clf, fleet=2, seed=3,
+                       min_bucket_rows=8, bucket_max_rows=32)
+    assert r["attribution"] is None
+
+
 # -- tier-1 CLI smoke (budgeted like the lint gate) --------------------
 
 def test_fleet_drill_deterministic_and_bitwise(clf, wl):
@@ -443,6 +531,12 @@ def test_cli_smoke_replay_check_under_budget(tmp_path):
     report = json.loads(open(out).read())
     assert report["slo"]["ok"] is True
     assert report["post_warmup_compiles"] == 0
+    # the attribution section rides the gate run (its digest was
+    # asserted byte-identical across the repeats by replay_median)
+    attr = report["attribution"]
+    assert attr["clock"] == "virtual" and attr["digest"]
+    assert sum(attr["verdicts"].values()) == report["n_requests"]
+    assert attr["cost_model"]
     # the acceptance exit-code contract end to end, driven through the
     # --workload file path: the same gate with an injected
     # forward-path slowdown must exit nonzero (and the throttle only

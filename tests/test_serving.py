@@ -170,6 +170,10 @@ def test_regressor_forward_matches_predict(data):
         ex.predict_proba(X[:4])
 
 
+@pytest.mark.slow  # ~7s: fits a forest AND a GBT just to re-prove the
+# serving parity the logistic-bag tests already gate every run; the
+# model-specific aggregated_forward closures are also jaxpr-audited in
+# test_analysis [ISSUE 13 tier-1 budget offset]
 def test_forest_and_gbt_models_serve(data):
     """The tentpole covers forest/gbt models too: tree-based ensembles
     go through the same aggregated_forward seam, bitwise-equal."""

@@ -14,8 +14,15 @@ fix is the PR-9/PR-11 discipline — move an equivalent amount of
 existing heavyweight tests to ``slow`` (with per-test reason comments)
 or restructure the tier — never raising the allocation to make the
 light turn green.
+
+The ratchet also WRITES what it measured: a per-module wall-clock
+artifact (``telemetry_dir()/tier1_timings.json``, modules sorted
+heaviest first) — test-suite observability for ROADMAP item 5, so the
+tier-restructuring PR starts from data this run already paid for.
 """
 
+import json
+import os
 import time
 
 import pytest
@@ -30,14 +37,49 @@ TIER1_ALLOCATION_S = 0.9 * TIER1_CEILING_S
 FULL_TIER_MIN_ITEMS = 600
 
 
+def _write_timings_artifact(config, collected: int,
+                            elapsed: float) -> None:
+    """Write the per-module wall-clock JSON artifact. Best-effort:
+    measurement must never fail the tier it measures."""
+    modules = getattr(config, "_sbt_module_times", None)
+    if not modules:
+        return
+    try:
+        from spark_bagging_tpu.telemetry import telemetry_dir
+
+        path = os.path.join(telemetry_dir(), "tier1_timings.json")
+        ordered = dict(sorted(modules.items(),
+                              key=lambda kv: -kv[1]))
+        with open(path, "w") as f:
+            json.dump({
+                "ts": time.time(),
+                "collected": collected,
+                "full_tier": collected >= FULL_TIER_MIN_ITEMS,
+                "elapsed_s": round(elapsed, 3),
+                "allocation_s": TIER1_ALLOCATION_S,
+                "ceiling_s": TIER1_CEILING_S,
+                "modules": {m: round(s, 3)
+                            for m, s in ordered.items()},
+            }, f, indent=2)
+            f.write("\n")
+    except Exception as e:  # noqa: BLE001 — observability only
+        import warnings
+
+        warnings.warn(f"tier1_timings.json not written: {e!r}",
+                      RuntimeWarning)
+
+
 def test_tier1_wall_clock_within_allocation(request):
     collected = request.session.testscollected
+    elapsed = time.monotonic() - request.config._sbt_tier_t0
+    # write the artifact BEFORE any skip/assert: partial sessions
+    # still record what they measured (flagged full_tier=false)
+    _write_timings_artifact(request.config, collected, elapsed)
     if collected < FULL_TIER_MIN_ITEMS:
         pytest.skip(
             f"partial session ({collected} items): the budget ratchet "
             "gates only full tier-1 runs"
         )
-    elapsed = time.monotonic() - request.config._sbt_tier_t0
     assert elapsed < TIER1_ALLOCATION_S, (
         f"tier-1 measured {elapsed:.0f}s against its "
         f"{TIER1_ALLOCATION_S:.0f}s allocation ({TIER1_CEILING_S:.0f}s "
