@@ -442,6 +442,7 @@ def replay(
     drift_scale: float = 1.0,
     psi_threshold: float = 0.5,
     disagreement_every: int = 8,
+    deadline_ms: float | None = None,
     max_delay_ms: float = 2.0,
     idle_flush_ms: float = 1.0,
     max_batch_rows: int = 256,
@@ -594,6 +595,20 @@ def replay(
         registry.swap(model_name, registry.model(model_name))
         swap_compiles += counter("sbt_serving_compiles_total") - before
         swaps_done += 1
+    # deadline scenario: in virtual mode the batcher's deadline clock
+    # is driven from the RECORDED schedule (arrival time at submit,
+    # window close at claim), so which requests expire in queue is a
+    # pure function of (workload, deadline) — the deadline-shed drill
+    # stays byte-deterministic. Timed mode keeps the real clock.
+    vclock = [0.0]
+    batcher_kw: dict = {}
+    if deadline_ms is not None:
+        if deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}"
+            )
+        if mode == "virtual":
+            batcher_kw["clock"] = lambda: vclock[0]
     batcher = MicroBatcher(
         ex_provider,
         max_delay_ms=max_delay_ms,
@@ -603,6 +618,7 @@ def replay(
         threaded=(mode == "timed"),
         retries=retries,
         retry_backoff_ms=retry_backoff_ms,
+        **batcher_kw,
     )
     shed_reasons = ("overload", "deadline", "degraded")
 
@@ -663,15 +679,20 @@ def replay(
                         {"kind": "model_swapped", "t": close_t}
                     )
                 for idx in window:
+                    vclock[0] = requests[idx].t
                     try:
                         futs[idx] = batcher.submit(
                             payload(idx, requests[idx].rows,
-                                    idx in drifted)
+                                    idx in drifted),
+                            deadline_ms=deadline_ms,
                         )
                     except Overloaded:
                         overloads += 1
                         continue
                     virtual_times[idx] = (requests[idx].t, close_t)
+                # claims happen at the window's virtual service time:
+                # deadline expiry (if armed) reads this clock value
+                vclock[0] = close_t
                 batcher.run_pending()
                 for name, kind in _ATTR_EVENT_COUNTERS.items():
                     cur = counter(name)
@@ -699,7 +720,8 @@ def replay(
                     time.sleep(delay)
                 try:
                     futs[idx] = batcher.submit(
-                        payload(idx, r.rows, idx in drifted)
+                        payload(idx, r.rows, idx in drifted),
+                        deadline_ms=deadline_ms,
                     )
                 except Overloaded:
                     overloads += 1
@@ -734,6 +756,8 @@ def replay(
     errors = collected["errors"]
     served = collected["served"]
 
+    shed_after = shed_counts()
+    deadline_sheds = int(shed_after["deadline"] - shed0["deadline"])
     c1 = {name: counter(name) for name in c0}
     rows_d = c1["sbt_serving_rows_total"] - c0["sbt_serving_rows_total"]
     pad_d = (c1["sbt_serving_padding_rows_total"]
@@ -835,6 +859,8 @@ def replay(
         "served": served,
         "errors": errors,
         "overloads": overloads,
+        "deadline_ms": deadline_ms,
+        "deadline_sheds": deadline_sheds,
         "batches": int(c1["sbt_serving_batches_total"]
                        - c0["sbt_serving_batches_total"]),
         "post_warmup_compiles": int(
@@ -1191,6 +1217,8 @@ def replay_fleet(
         "served": collected["served"],
         "errors": collected["errors"],
         "overloads": overloads,
+        "deadline_ms": None,
+        "deadline_sheds": 0,
         "batches": int(fleet_counter("sbt_serving_batches_total")),
         "post_warmup_compiles": int(
             fleet_counter("sbt_serving_compiles_total")
@@ -1247,7 +1275,7 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
         for r in runs[1:]:
             for key in ("composition_digest", "output_digest",
                         "post_warmup_compiles", "served", "overloads",
-                        "errors", "batches"):
+                        "errors", "batches", "deadline_sheds"):
                 if r[key] != head[key]:
                     raise AssertionError(
                         f"determinism violation across repeats: {key} "
@@ -1526,6 +1554,12 @@ def main(argv: list[str] | None = None) -> int:
                           "segment's payload pool")
     drv.add_argument("--psi-threshold", type=float, default=0.5,
                      help="PSI threshold of the drift alert rule")
+    drv.add_argument("--deadline-ms", type=float, default=None,
+                     help="stamp every request with this in-queue "
+                          "deadline; in virtual mode expiry is driven "
+                          "off the recorded schedule, so the "
+                          "deadline-shed drill is deterministic "
+                          "(sheds reported as deadline_sheds)")
     drv.add_argument("--max-delay-ms", type=float, default=2.0)
     drv.add_argument("--idle-flush-ms", type=float, default=1.0)
     drv.add_argument("--max-batch-rows", type=int, default=256)
@@ -1670,6 +1704,7 @@ def main(argv: list[str] | None = None) -> int:
                           ("--swaps", args.swaps),
                           ("--burst", args.burst),
                           ("--throttle-ms", args.throttle_ms),
+                          ("--deadline-ms", args.deadline_ms),
                           ("--devices", args.devices)):
             if val:
                 ap.error(f"{flag} does not combine with --fleet (the "
@@ -1738,6 +1773,7 @@ def main(argv: list[str] | None = None) -> int:
             drift=args.drift, drift_at=args.drift_at,
             drift_shift=args.drift_shift, drift_scale=args.drift_scale,
             psi_threshold=args.psi_threshold,
+            deadline_ms=args.deadline_ms,
             max_delay_ms=args.max_delay_ms,
             idle_flush_ms=args.idle_flush_ms,
             max_batch_rows=args.max_batch_rows,
@@ -1812,7 +1848,10 @@ def main(argv: list[str] | None = None) -> int:
     print(f"report: {out}")
     if result is not None:
         print(result.render())
-        return 0 if result.ok else 2
+        # the shared gate exit-code contract (slo.exit_code, documented
+        # in benchmarks/BUDGETS.md): 0 pass, 2 hard breach, 3 when only
+        # host-conditional performance bands failed
+        return slo_mod.exit_code(result)
     return 0
 
 

@@ -1,0 +1,272 @@
+"""Declarative scenario registry — the verification observatory's
+regression surface [ROADMAP item 5].
+
+A **scenario** is a seeded, fully-declarative capture spec — which
+synthetic workload to generate, how to drive it through the
+``benchmarks/replay.py`` machinery (burst, swaps, chaos plan, drift
+onset, deadline, fleet, replica-sharded mesh) — bound to an
+:class:`~spark_bagging_tpu.telemetry.slo.SLOSpec` and a COMMITTED
+digest baseline under ``benchmarks/baselines/scenarios/<name>.json``.
+Because the replay harness makes every drive a byte-deterministic
+function of ``(workload, seed, plan)``, a scenario's output /
+composition / attribution / drift / chaos / fleet digests are exact
+identities: regression coverage grows by registering a new scenario
+(cheap, data) instead of writing a new heavyweight suite (expensive,
+wall-clock) — the pyramid restructure's whole point.
+
+The runner (``python -m benchmarks.scenarios run|record|check|list|
+history``) lives in :mod:`benchmarks.scenarios.runner`; ``check``
+emits a machine-readable conformance report, exports ``sbt_scenario_*``
+series, and appends every run to the longitudinal trend store
+(``telemetry/history.py``). Exit codes follow the shared gate
+contract (``telemetry.slo``, documented in benchmarks/BUDGETS.md):
+0 pass / 2 digest-or-SLO breach / 3 host-conditional band.
+
+This module is import-light on purpose: registering scenarios touches
+no jax — ``list`` must not pay a backend init, and the CLI needs to
+force the scenario device environment BEFORE jax loads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+SCENARIO_SCHEMA_VERSION = 1
+
+#: every digest baseline is recorded (and re-checked) under this forced
+#: CPU device count — the tests' conftest environment. Fit bits depend
+#: on the device count (PR 9: a different forced count changes the
+#: model the workload serves), so conformance is only byte-comparable
+#: when the environments match; the CLI forces this before jax imports
+#: and a mismatched pre-initialized jax downgrades digest checks to the
+#: host-conditional band (exit 3), never a false breach.
+SCENARIO_DEVICES = 8
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One registered verification scenario (see module doc).
+
+    ``workload`` is :func:`~spark_bagging_tpu.telemetry.workload.
+    synthetic_workload` kwargs (including ``kind`` and the seed that
+    is also the payload seed); ``drive`` is extra ``replay()`` kwargs
+    (``burst``, ``swaps``, ``drift``, ``deadline_ms``, ``max_queue``,
+    ``retries`` …) with ``chaos`` naming a builtin fault plan;
+    ``slo`` is an ``SLOSpec`` dict (validated at registration,
+    round-tripped through the committed baseline file); ``devices``
+    serves through a replica-sharded ``(1, N)`` mesh; ``fleet`` drives
+    the N-virtual-peer drill; ``parity_with`` additionally asserts
+    this scenario's output digest equals ANOTHER scenario's committed
+    output digest (the sharded-parity contract).
+    """
+
+    name: str
+    description: str
+    workload: dict[str, Any]
+    slo: dict[str, Any] = field(default_factory=dict)
+    drive: dict[str, Any] = field(default_factory=dict)
+    model: dict[str, Any] = field(default_factory=dict)
+    serving: dict[str, Any] = field(default_factory=dict)
+    repeats: int = 2
+    devices: int | None = None
+    fleet: int = 0
+    parity_with: str | None = None
+    tags: tuple[str, ...] = ()
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def register(scenario: Scenario) -> Scenario:
+    """Register (structural checks only — this module must stay
+    import-light so the CLI can force the device environment BEFORE
+    jax loads; :func:`validate_registry` does the SLO-grammar pass
+    once the heavy imports are paid for)."""
+    if scenario.name in SCENARIOS:
+        raise ValueError(f"scenario {scenario.name!r} already registered")
+    if "kind" not in scenario.workload or "seed" not in scenario.workload:
+        raise ValueError(
+            f"scenario {scenario.name!r} workload needs explicit "
+            "'kind' and 'seed' (the determinism contract's inputs)"
+        )
+    if scenario.parity_with is not None \
+            and scenario.parity_with not in SCENARIOS:
+        raise ValueError(
+            f"scenario {scenario.name!r}: parity_with "
+            f"{scenario.parity_with!r} is not registered (register "
+            "the reference scenario first)"
+        )
+    SCENARIOS[scenario.name] = scenario
+    return scenario
+
+
+def validate_registry() -> None:
+    """The deferred validation pass: every registered scenario's SLO
+    dict must round-trip ``SLOSpec`` (unknown fields loud) — a
+    scenario with an unenforceable spec is a gate that silently tests
+    nothing. Runner entry points call this first; the registry test
+    pins it."""
+    from spark_bagging_tpu.telemetry.slo import SLOSpec
+
+    for sc in SCENARIOS.values():
+        try:
+            SLOSpec.from_dict(sc.slo)
+        except ValueError as e:
+            raise ValueError(
+                f"scenario {sc.name!r} has an invalid SLO spec: {e}"
+            ) from e
+
+
+def get(name: str) -> Scenario:
+    if name not in SCENARIOS:
+        raise KeyError(
+            f"unknown scenario {name!r}; registered: {names()}"
+        )
+    return SCENARIOS[name]
+
+
+def names() -> list[str]:
+    return sorted(SCENARIOS)
+
+
+def select(only: list[str] | None = None) -> list[Scenario]:
+    """The scenarios a runner invocation covers, registry order.
+    ``only`` filters by name (unknown names are loud)."""
+    if not only:
+        return [SCENARIOS[n] for n in names()]
+    return [get(n) for n in only]
+
+
+# -- the builtin scenario library ---------------------------------------
+# Shared shape conventions: width-8 feature space, 8/32 bucket ladder,
+# logistic bags small enough that a full `check` stays interactive.
+# Each scenario's seed is deliberately distinct so no two scenarios
+# can accidentally share (and silently co-vary) a payload stream —
+# except sharded-parity, whose ENTIRE point is sharing steady-poisson's
+# (workload, seed, model) so the mesh path must reproduce its bytes.
+
+_SERVING = {"min_bucket_rows": 8, "max_batch_rows": 32}
+
+register(Scenario(
+    name="steady-poisson",
+    description="steady open-loop Poisson traffic through the "
+                "coalescing batcher — the baseline serving contract "
+                "(zero post-warmup compiles, no sheds) and the "
+                "reference bytes for sharded-parity",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 101, "width": 8, "bucket_bounds": (8, 32)},
+    model={"n_estimators": 8, "seed": 0},
+    serving=dict(_SERVING),
+    slo={"p95_ms": 2000.0, "max_overloads": 0,
+         "max_post_warmup_compiles": 0,
+         "max_stage_share": {"queue": 1.0}},
+    tags=("serving", "smoke"),
+))
+
+register(Scenario(
+    name="burst-shed",
+    description="overload drill: a 64-request burst into a 16-deep "
+                "queue must shed with Overloaded backpressure — "
+                "deterministically, never fatally",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 102, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"burst": 64, "max_queue": 16},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    slo={"max_post_warmup_compiles": 0},
+    tags=("serving", "overload", "smoke"),
+))
+
+register(Scenario(
+    name="swap-under-fire",
+    description="two registry hot-swaps mid-replay: the full swap "
+                "machinery under live traffic with outputs staying "
+                "bitwise-identical and swap pre-compiles excluded "
+                "from the zero-recompile gate",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 103, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"swaps": 2},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("serving", "swap"),
+))
+
+register(Scenario(
+    name="chaos-mixed",
+    description="the default chaos drill: seeded transient blips "
+                "(absorbed by bounded retries) plus poisoned requests "
+                "(bisected down to failing alone) — the whole fault/"
+                "retry/shed transcript is part of the digest identity",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 104, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"chaos": "mixed", "retries": 2},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    slo={"max_post_warmup_compiles": 0},
+    tags=("chaos",),
+))
+
+register(Scenario(
+    name="drift-onset",
+    description="the model-quality incident: covariate-shifted "
+                "payloads from the midpoint on — exactly one "
+                "alert_fired, one flight dump, byte-identical drift "
+                "scores (the quality plane's scripted regression)",
+    workload={"kind": "poisson", "rate_rps": 150.0, "duration_s": 0.6,
+              "seed": 105, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"drift": True, "drift_shift": 4.0},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("quality",),
+))
+
+register(Scenario(
+    name="deadline-shed",
+    description="deadline drill: every request carries a 0.6 ms "
+                "in-queue deadline driven off the virtual clock — "
+                "requests coalesced too long expire as DeadlineExceeded "
+                "(a deterministic shed set), batch-mates serve normally",
+    workload={"kind": "poisson", "rate_rps": 500.0, "duration_s": 0.4,
+              "seed": 106, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"deadline_ms": 0.6},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("serving", "deadline", "smoke"),
+))
+
+register(Scenario(
+    name="fleet-peer-loss",
+    description="fleet drill under chaos: 3 virtual peers, a rolling "
+                "version swap (skew rises and converges) while one "
+                "peer's scrapes fail for a scripted stretch — quorum "
+                "degrades, recovers, and the peer-lost alert fires "
+                "exactly once",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 107, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"chaos": "peer-loss", "retries": 2},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    fleet=3,
+    slo={"max_post_warmup_compiles": 0},
+    tags=("fleet", "chaos"),
+))
+
+register(Scenario(
+    name="sharded-parity",
+    description="replica-sharded serving parity: steady-poisson's "
+                "exact (workload, seed, model) served through a "
+                "(1, 8)-mesh executor must reproduce the single-device "
+                "output digest bitwise (gather-then-reduce contract)",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 101, "width": 8, "bucket_bounds": (8, 32)},
+    model={"n_estimators": 8, "seed": 0},
+    serving=dict(_SERVING),
+    devices=SCENARIO_DEVICES,
+    parity_with="steady-poisson",
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("serving", "sharded"),
+))

@@ -1,0 +1,126 @@
+"""CLI: ``python -m benchmarks.scenarios <command>``.
+
+Commands::
+
+    list                 registered scenarios (no jax, no execution)
+    run    [--only a,b]  execute, print + write the conformance report
+    record [--only a,b]  execute and (re)write the committed baselines
+    check  [--only a,b]  execute and gate against the baselines
+    history [--limit N]  the longitudinal trend store + verdicts
+
+Exit codes (the shared gate contract, see benchmarks/BUDGETS.md):
+0 pass, 2 digest/SLO breach (or missing baseline), 3 host-conditional
+band only. ``history`` exits 0/2 on trend OK / digest flip.
+
+Digest determinism is environment-bound: baselines are recorded under
+``SCENARIO_DEVICES`` forced CPU devices (the test conftest's exact
+setup), so when jax is not yet initialized the CLI forces the same
+environment — a stock ``python -m benchmarks.scenarios check``
+byte-matches the committed baselines with no flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from benchmarks.scenarios import SCENARIO_DEVICES, SCENARIOS, names  # noqa: E402
+
+
+def _force_scenario_env() -> None:
+    """Match the recording environment before jax initializes (the
+    replay.py --devices precedent): forced host CPU devices so fit
+    bits — and therefore every committed digest — reproduce. A jax
+    imported earlier (tests, embedding processes) is left alone; the
+    runner downgrades un-comparable digests to the band exit."""
+    if "jax" in sys.modules:
+        return
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count"
+        f"={SCENARIO_DEVICES}"
+    ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m benchmarks.scenarios",
+        description="deterministic scenario-conformance runner",
+    )
+    ap.add_argument("command",
+                    choices=("list", "run", "record", "check",
+                             "history"))
+    ap.add_argument("--only", default=None,
+                    help="comma-separated scenario names (default: "
+                         "all registered)")
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="override per-scenario replay_median repeats")
+    ap.add_argument("--out", default=None,
+                    help="conformance report JSON path (default: "
+                         "scenario_report.json in $SBT_TELEMETRY_DIR)")
+    ap.add_argument("--baselines", default=None,
+                    help="baseline directory override (default: the "
+                         "committed benchmarks/baselines/scenarios/)")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append this run to the longitudinal "
+                         "history store")
+    ap.add_argument("--limit", type=int, default=32,
+                    help="history: newest records to render")
+    args = ap.parse_args(argv)
+
+    only = ([s.strip() for s in args.only.split(",") if s.strip()]
+            if args.only else None)
+    if only:
+        unknown = [n for n in only if n not in SCENARIOS]
+        if unknown:
+            ap.error(f"unknown scenario(s) {unknown}; "
+                     f"registered: {names()}")
+
+    if args.command == "list":
+        for n in names():
+            sc = SCENARIOS[n]
+            kind = ("fleet" if sc.fleet
+                    else f"mesh({sc.devices})" if sc.devices
+                    else "single")
+            print(f"{n:>16}  [{kind}]  {sc.description}")
+        print(f"{len(SCENARIOS)} scenarios registered; baselines in "
+              "benchmarks/baselines/scenarios/")
+        return 0
+
+    _force_scenario_env()
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.telemetry import history as history_mod
+
+    if args.command == "history":
+        report = history_mod.history_report(limit=args.limit)
+        print(history_mod.render_history(report))
+        return 0 if report["trend"]["ok"] else 2
+
+    from benchmarks.scenarios import runner
+
+    report = runner.run_conformance(
+        args.command, only,
+        repeats=args.repeats,
+        baselines_root=args.baselines,
+        append_history=not args.no_history,
+    )
+    out = args.out or os.path.join(
+        telemetry.telemetry_dir(), "scenario_report.json"
+    )
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+    print(runner.render_conformance(report))
+    print(f"report: {out}")
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,470 @@
+"""Scenario runner: execute, record, and check the registry.
+
+Execution reuses ``benchmarks/replay.py`` wholesale — every scenario
+is a :func:`~benchmarks.replay.replay_median` drive (repeats asserted
+byte-identical) over a seeded synthetic workload against a freshly
+registered model. ``record`` commits the resulting digest identity +
+the scenario's SLO spec as the baseline JSON; ``check`` re-runs and
+compares:
+
+- **digests / counts** — exact (a flip is a hard breach, exit 2),
+  comparable only when the environment matches the recording
+  (backend + forced device count; a mismatch downgrades the scenario
+  to the host-conditional band, exit 3, never a false breach);
+- **SLO** — the BASELINE file's spec (round-tripped through
+  ``SLOSpec.from_dict``, unknown fields loud) evaluated via
+  ``replay.check_report`` so drift/fleet transcript checks ride along;
+  failed host-band checks (rps/latency/stage-share) band to exit 3,
+  anything else is a breach;
+- **parity** — ``parity_with`` scenarios must reproduce the reference
+  scenario's committed output digest bitwise.
+
+Every run appends a compact record to the longitudinal trend store
+(``telemetry/history.py``) and exports ``sbt_scenario_*`` series, so
+the conformance plane is itself observable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any
+
+from benchmarks.scenarios import (
+    SCENARIO_DEVICES,
+    SCENARIO_SCHEMA_VERSION,
+    Scenario,
+    select,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BASELINE_SCHEMA_VERSION = 1
+
+
+def baselines_dir() -> str:
+    """The committed scenario baselines — the ONLY scenario artifacts
+    under version control (run reports and history live in
+    ``telemetry_dir()``)."""
+    return os.path.join(REPO, "benchmarks", "baselines", "scenarios")
+
+
+def baseline_path(name: str, root: str | None = None) -> str:
+    return os.path.join(root or baselines_dir(), f"{name}.json")
+
+
+def load_baseline(name: str,
+                  root: str | None = None) -> dict[str, Any] | None:
+    path = baseline_path(name, root)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def environment() -> dict[str, Any]:
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "jax": jax.__version__,
+    }
+
+
+def env_comparable(env: dict[str, Any],
+                   recorded: dict[str, Any] | None) -> bool:
+    """Digests are byte-comparable only when backend and device count
+    match the recording (fit bits depend on both). The jax version is
+    recorded for forensics but not gated — the container pins it."""
+    if not recorded:
+        return False
+    return (env.get("backend") == recorded.get("backend")
+            and env.get("device_count") == recorded.get("device_count"))
+
+
+# one fitted model per (width, n_estimators, seed): scenarios sharing a
+# shape share the fit (and the parity pair MUST — same model is part of
+# its contract), which keeps a full `check` interactive
+_MODEL_CACHE: dict[tuple, Any] = {}
+
+
+def _model_for(sc: Scenario):
+    width = int(sc.workload.get("width", 16))
+    n_est = int(sc.model.get("n_estimators", 8))
+    seed = int(sc.model.get("seed", 0))
+    key = (width, n_est, seed)
+    if key not in _MODEL_CACHE:
+        from benchmarks.replay import _default_model
+
+        _MODEL_CACHE[key] = _default_model(width, n_est, seed=seed)
+    return _MODEL_CACHE[key]
+
+
+def run_scenario(sc: Scenario,
+                 repeats: int | None = None) -> dict[str, Any]:
+    """One scenario through the replay machinery; returns the
+    ``replay_median`` report (cross-repeat byte identity already
+    asserted by it)."""
+    from spark_bagging_tpu.telemetry import workload as workload_mod
+    from benchmarks import replay as R
+
+    wl = workload_mod.synthetic_workload(**sc.workload)
+    seed = int(sc.workload["seed"])
+    model = _model_for(sc)
+    drive = dict(sc.drive)
+    chaos_name = drive.pop("chaos", None)
+    chaos_spec = None
+    if chaos_name is not None:
+        from spark_bagging_tpu import faults as faults_mod
+
+        chaos_spec = faults_mod.builtin_plan_spec(chaos_name, seed=seed)
+        drive.setdefault("retries", 2)
+    reps = repeats if repeats is not None else sc.repeats
+    min_rows = int(sc.serving.get("min_bucket_rows", 8))
+    max_rows = int(sc.serving.get("max_batch_rows", 32))
+    if sc.fleet:
+        return R.replay_median(
+            wl, repeats=reps, fleet=sc.fleet, model=model,
+            chaos=chaos_spec, seed=seed,
+            min_bucket_rows=min_rows, bucket_max_rows=max_rows,
+            **drive,
+        )
+    from spark_bagging_tpu.serving import ModelRegistry
+
+    reg_opts: dict[str, Any] = dict(
+        min_bucket_rows=min_rows, max_batch_rows=max_rows,
+    )
+    if sc.devices:
+        from spark_bagging_tpu.parallel import make_mesh
+
+        reg_opts["mesh"] = make_mesh(data=1, replica=sc.devices)
+    reg = ModelRegistry(**reg_opts)
+    reg.register("scenario", model, warmup=True)
+    return R.replay_median(
+        wl, repeats=reps, registry=reg, model_name="scenario",
+        chaos=chaos_spec, seed=seed, **drive,
+    )
+
+
+def digests_of(report: dict[str, Any]) -> dict[str, str]:
+    """The scenario's exact identity: every digest the replay plane
+    asserts byte-identical across repeats, flattened for the baseline
+    file and the history store."""
+    d = {
+        "workload": report["workload_digest"],
+        "composition": report["composition_digest"],
+        "output": report["output_digest"],
+    }
+    attr = report.get("attribution")
+    if attr is not None:
+        d["attribution"] = attr["digest"]
+    drift = report.get("drift")
+    if drift is not None:
+        d["drift"] = drift["digest"]
+    chaos = report.get("chaos")
+    if chaos is not None:
+        d["chaos_plan"] = chaos["plan_digest"]
+        d["chaos_sites"] = hashlib.sha256(
+            json.dumps(chaos["sites"], sort_keys=True).encode()
+        ).hexdigest()
+    fleet = report.get("fleet")
+    if fleet is not None:
+        d["fleet_merged"] = fleet["merged_digest"]
+        d["fleet_skew"] = fleet["skew_digest"]
+        d["fleet_incidents"] = fleet["incident_digest"]
+    return d
+
+
+def counts_of(report: dict[str, Any]) -> dict[str, int]:
+    """The exact integer surface checked alongside digests (all of
+    these are inside replay_median's cross-repeat assertion set)."""
+    return {
+        "served": report["served"],
+        "overloads": report["overloads"],
+        "errors": report["errors"],
+        "deadline_sheds": report.get("deadline_sheds", 0),
+        "batches": report["batches"],
+        "swaps": report["swaps"],
+    }
+
+
+def record_baseline(sc: Scenario, report: dict[str, Any],
+                    root: str | None = None) -> str:
+    """Commit the scenario's identity: digests + exact counts + the
+    SLO spec (round-tripped so `check` gates on what was recorded) +
+    the recording environment."""
+    from spark_bagging_tpu.telemetry.slo import SLOSpec
+
+    baseline = {
+        "schema": BASELINE_SCHEMA_VERSION,
+        "scenario": sc.name,
+        "description": sc.description,
+        "recorded_ts": time.time(),
+        "environment": environment(),
+        "repeats": report.get("repeats"),
+        "slo": SLOSpec.from_dict(sc.slo).to_dict(),
+        "digests": digests_of(report),
+        "counts": counts_of(report),
+    }
+    root = root or baselines_dir()
+    os.makedirs(root, exist_ok=True)
+    path = baseline_path(sc.name, root)
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_scenario(
+    sc: Scenario,
+    report: dict[str, Any],
+    baseline: dict[str, Any] | None,
+    *,
+    baselines_root: str | None = None,
+) -> dict[str, Any]:
+    """Conformance verdict for one already-run scenario. Returns a
+    dict with ``status`` in ``pass | digest-breach | slo-breach |
+    band | no-baseline`` plus full detail (mismatch list, SLO checks,
+    band notes)."""
+    from spark_bagging_tpu.telemetry import slo as slo_mod
+    from benchmarks.replay import check_report
+
+    out: dict[str, Any] = {"scenario": sc.name}
+    if baseline is None:
+        out["status"] = "no-baseline"
+        out["note"] = (
+            f"no committed baseline for {sc.name!r}: run "
+            f"`python -m benchmarks.scenarios record --only {sc.name}`"
+        )
+        return out
+
+    env = environment()
+    comparable = env_comparable(env, baseline.get("environment"))
+    mismatches: list[dict[str, Any]] = []
+    have = digests_of(report)
+    for name, want in sorted((baseline.get("digests") or {}).items()):
+        got = have.get(name)
+        if got != want:
+            mismatches.append({"field": f"digest.{name}",
+                               "expected": want, "actual": got})
+    counts = counts_of(report)
+    for name, want in sorted((baseline.get("counts") or {}).items()):
+        got = counts.get(name)
+        if got != want:
+            mismatches.append({"field": f"count.{name}",
+                               "expected": want, "actual": got})
+    if sc.parity_with is not None:
+        ref = load_baseline(sc.parity_with, baselines_root)
+        ref_digest = ((ref or {}).get("digests") or {}).get("output")
+        out["parity_with"] = sc.parity_with
+        if ref_digest is None:
+            mismatches.append({
+                "field": "parity.output",
+                "expected": f"<{sc.parity_with} baseline missing>",
+                "actual": have.get("output"),
+            })
+        elif have.get("output") != ref_digest:
+            mismatches.append({"field": "parity.output",
+                               "expected": ref_digest,
+                               "actual": have.get("output")})
+
+    spec = slo_mod.SLOSpec.from_dict(baseline.get("slo") or {})
+    result = check_report(report, spec=spec)
+    # a band-named check that measured NOTHING (actual None) is a
+    # broken report, never host noise — same rule as slo.exit_code
+    band_slo = [c for c in result.failures
+                if slo_mod.is_host_band_check(c["name"])
+                and c.get("actual") is not None]
+    hard_slo = [c for c in result.failures if c not in band_slo]
+
+    out["digest_match"] = not mismatches
+    out["mismatches"] = mismatches
+    out["slo"] = result.to_dict()
+    out["env_comparable"] = comparable
+    if mismatches and not comparable:
+        # digests legitimately differ on a foreign environment: the
+        # scenario cannot be byte-checked here — band, not breach
+        out["status"] = "band"
+        out["note"] = (
+            f"environment {env} does not match the recording "
+            f"{baseline.get('environment')}: digest identity is "
+            "host-conditional on this host"
+        )
+    elif mismatches:
+        out["status"] = "digest-breach"
+    elif hard_slo:
+        out["status"] = "slo-breach"
+    elif band_slo:
+        out["status"] = "band"
+        out["note"] = ("only host-conditional performance bands "
+                       "failed: " +
+                       ", ".join(c["name"] for c in band_slo))
+    else:
+        out["status"] = "pass"
+    return out
+
+
+#: status -> the shared exit-code contract (telemetry.slo / BUDGETS.md)
+_STATUS_EXIT = {
+    "pass": 0,
+    "band": 3,
+    "skipped": 3,
+    "no-baseline": 2,
+    "digest-breach": 2,
+    "slo-breach": 2,
+}
+
+
+def _scenario_metrics(name: str, status: str, wall_s: float) -> None:
+    from spark_bagging_tpu import telemetry
+
+    labels = {"scenario": name}
+    telemetry.inc("sbt_scenario_runs_total", labels=labels)
+    telemetry.set_gauge("sbt_scenario_wall_seconds", wall_s,
+                        labels=labels)
+    # digest_match is a CHECK verdict: run/record modes (status
+    # ran/recorded) compared nothing and must not export a green light
+    if status in ("pass", "band", "slo-breach", "digest-breach"):
+        telemetry.set_gauge("sbt_scenario_digest_match",
+                            0.0 if status == "digest-breach" else 1.0,
+                            labels=labels)
+    if status == "digest-breach":
+        telemetry.inc("sbt_scenario_failures_total",
+                      labels={"scenario": name, "kind": "digest"})
+    elif status == "slo-breach":
+        telemetry.inc("sbt_scenario_failures_total",
+                      labels={"scenario": name, "kind": "slo"})
+    elif status == "no-baseline":
+        telemetry.inc("sbt_scenario_failures_total",
+                      labels={"scenario": name,
+                              "kind": "baseline-missing"})
+
+
+def run_conformance(
+    mode: str,
+    only: list[str] | None = None,
+    *,
+    repeats: int | None = None,
+    baselines_root: str | None = None,
+    history_path: str | None = None,
+    append_history: bool = True,
+) -> dict[str, Any]:
+    """The runner's core: execute the selected scenarios and build the
+    machine-readable conformance report. ``mode``:
+
+    - ``run`` — execute + report digests/sections, no baseline gate;
+    - ``record`` — execute + (re)write the committed baselines;
+    - ``check`` — execute + gate against the committed baselines.
+
+    A scenario whose declared ``devices`` exceed what this process's
+    jax can see is reported ``skipped`` (host-conditional, exit 3) —
+    never silently green. Every executed scenario appends one record
+    to the longitudinal history store.
+    """
+    import jax
+
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.telemetry import history as history_mod
+
+    if mode not in ("run", "record", "check"):
+        raise ValueError(f"unknown conformance mode {mode!r}")
+    from benchmarks.scenarios import validate_registry
+
+    validate_registry()
+    telemetry.enable()
+    scenarios = select(only)
+    rows: list[dict[str, Any]] = []
+    for sc in scenarios:
+        if sc.devices and jax.device_count() < sc.devices:
+            rows.append({
+                "scenario": sc.name, "status": "skipped",
+                "note": (
+                    f"needs {sc.devices} devices, jax sees "
+                    f"{jax.device_count()} (host-conditional: run "
+                    f"under --xla_force_host_platform_device_count="
+                    f"{SCENARIO_DEVICES})"
+                ),
+            })
+            continue
+        t0 = time.perf_counter()
+        report = run_scenario(sc, repeats=repeats)
+        wall = time.perf_counter() - t0
+        if mode == "record":
+            path = record_baseline(sc, report, baselines_root)
+            row: dict[str, Any] = {"scenario": sc.name,
+                                   "status": "recorded",
+                                   "baseline": path}
+        elif mode == "check":
+            row = check_scenario(
+                sc, report, load_baseline(sc.name, baselines_root),
+                baselines_root=baselines_root,
+            )
+        else:
+            row = {"scenario": sc.name, "status": "ran"}
+        row["wall_seconds"] = round(wall, 3)
+        row["digests"] = digests_of(report)
+        row["counts"] = counts_of(report)
+        # scenario-class sections ride the report verbatim so the
+        # conformance JSON is a one-stop incident view
+        for section in ("attribution", "chaos", "fleet", "drift"):
+            if report.get(section) is not None:
+                row[section] = report[section]
+        rows.append(row)
+        slo_ok = (row.get("slo") or {}).get("ok")
+        _scenario_metrics(sc.name, row["status"], wall)
+        if append_history:
+            numbers = {"wall_seconds": wall}
+            if report.get("rps"):
+                numbers["rps"] = float(report["rps"])
+            history_mod.append_record(
+                "scenario", sc.name,
+                digests=row["digests"],
+                numbers=numbers,
+                slo_ok=slo_ok if mode == "check" else None,
+                detail={"mode": mode, "status": row["status"],
+                        "counts": row["counts"]},
+                path=history_path,
+            )
+
+    codes = [_STATUS_EXIT.get(r["status"], 0) for r in rows]
+    exit_code = 2 if 2 in codes else (3 if 3 in codes else 0)
+    return {
+        "metric": "scenario_conformance",
+        "schema": SCENARIO_SCHEMA_VERSION,
+        "mode": mode,
+        "ts": time.time(),
+        "environment": environment(),
+        "registered": len(select(None)),
+        "scenarios": rows,
+        "ok": exit_code == 0,
+        "exit_code": exit_code,
+    }
+
+
+def render_conformance(report: dict[str, Any]) -> str:
+    """One line per scenario for the CLI."""
+    lines = [f"scenario conformance ({report['mode']}): "
+             f"{len(report['scenarios'])} of "
+             f"{report['registered']} scenarios"]
+    for r in report["scenarios"]:
+        status = r["status"].upper() if r["status"].endswith("breach") \
+            else r["status"]
+        wall = r.get("wall_seconds")
+        extra = ""
+        if r.get("mismatches"):
+            fields = ", ".join(m["field"] for m in r["mismatches"])
+            extra = f" [{fields}]"
+        elif r.get("note"):
+            extra = f" [{r['note']}]"
+        lines.append(
+            f"  [{status:>13}] {r['scenario']}"
+            + (f" ({wall:.1f}s)" if wall is not None else "")
+            + extra
+        )
+    lines.append("conformance OK" if report["ok"]
+                 else f"conformance exit {report['exit_code']}")
+    return "\n".join(lines)

@@ -305,6 +305,12 @@ def _sharded_main(args) -> int:
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
+    _append_bench_history("serving_sharded", {
+        "speedup": speedup,
+        "single_rows_per_s": single_rps,
+        "sharded_rows_per_s": sharded_rps,
+    }, detail={"devices": args.devices, "parity": parity,
+               "compiles_post_warmup": compiles_post})
     print(json.dumps(result))
     print(
         f"sharded-vs-single: {speedup}x on {args.devices} devices "
@@ -523,8 +529,36 @@ def main() -> int:
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
+    numbers = {
+        "compiles_post_warmup": float(result["compiles_post_warmup"]),
+    }
+    if best_rps is not None:
+        numbers["best_served_rps"] = float(best_rps)
+    if conc1 is not None:
+        numbers["c1_speedup"] = float(conc1["speedup_rps"])
+    _append_bench_history(
+        "serving_latency", numbers,
+        detail={"levels": [lvl["concurrency"]
+                           for lvl in result["levels"]],
+                "smoke": bool(args.smoke)},
+    )
     print(json.dumps(result))
     return 0
+
+
+def _append_bench_history(key: str, numbers: dict,
+                          detail: dict | None = None) -> None:
+    """One longitudinal record per bench invocation (the trend store's
+    `bench` kind): headline numbers only, judged against the CI-noise
+    band by `compare_trend`. Best-effort — the bench result file, not
+    the history append, is the deliverable."""
+    try:
+        from spark_bagging_tpu.telemetry import history
+
+        history.append_record("bench", key, numbers=numbers,
+                              detail=detail)
+    except Exception as e:  # noqa: BLE001 — observability only
+        print(f"history append skipped: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -86,6 +86,7 @@ from spark_bagging_tpu.telemetry.state import STATE as _state
 from spark_bagging_tpu.telemetry import (
     alerts,
     fleet,
+    history,
     perf,
     quality,
     recorder,
@@ -108,7 +109,7 @@ __all__ = [
     "read_events", "last_metrics_snapshot", "runs",
     "record_fit_report", "Registry", "reset", "telemetry_dir",
     "default_log_path", "tracing", "recorder", "workload", "slo",
-    "quality", "alerts", "fleet", "perf",
+    "quality", "alerts", "fleet", "perf", "history",
     "sinks_active", "arrival_events_wanted", "start_server",
     "stop_server", "server_address",
 ]

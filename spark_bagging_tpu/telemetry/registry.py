@@ -148,6 +148,15 @@ SERIES_HELP: dict[str, str] = {
     "sbt_profile_captures_total": "On-demand jax.profiler captures started (/debug/profile, trace(), the CLI)",
     "sbt_profile_rejected_total": "Profile captures rejected by the single-flight guard (one capture per process)",
     "sbt_profile_active": "A device-profile capture is currently running (gauge, 0/1)",
+    "sbt_scenario_runs_total": "Registered verification scenarios executed (benchmarks/scenarios; label scenario)",
+    "sbt_scenario_failures_total": "Scenario conformance failures by class (labels scenario + kind=digest/slo/baseline-missing)",
+    "sbt_scenario_digest_match": "Latest scenario digest verdict vs its committed baseline (gauge, label scenario; 1 match / 0 mismatch)",
+    "sbt_scenario_wall_seconds": "Wall-clock of the latest run of one scenario, repeats included (gauge, label scenario)",
+    "sbt_history_appends_total": "Records appended to the longitudinal history store (telemetry_dir()/history/history.jsonl)",
+    "sbt_history_records": "Records seen by the latest history trend scan (gauge)",
+    "sbt_history_groups": "Distinct (kind, key) groups in the latest history trend scan (gauge)",
+    "sbt_history_digest_flips": "Digest/SLO flips found by the latest history trend scan (gauge; any nonzero is a regression finding)",
+    "sbt_history_numeric_drift": "Numeric fields outside the CI-noise band in the latest history trend scan (gauge, advisory)",
 }
 
 

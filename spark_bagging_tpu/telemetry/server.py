@@ -34,6 +34,11 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
   against the flight recorder's concurrent events into a verdict
   (queue-dominated / compile-absorbed / retry-inflated /
   degraded-path / genuinely-slow-forward);
+- ``GET /debug/history`` — the longitudinal verification history
+  (``telemetry/history.py``): the newest trend-store records
+  (scenario/bench/tier runs) plus the ``compare_trend`` verdict over
+  the full store — digest flips are findings, noise-band numeric
+  wobble is not;
 - ``GET /debug/profile?seconds=N`` — on-demand live device profiling:
   starts a single-flight ``jax.profiler`` capture that auto-stops
   after N seconds (hard-capped) into ``telemetry_dir()/profiles/``;
@@ -241,6 +246,16 @@ def _debug_drift() -> dict[str, Any]:
     return quality.debug_summary()
 
 
+def _debug_history(query: dict[str, list[str]]) -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import history
+
+    try:
+        limit = max(0, int((query.get("limit") or ["32"])[0]))
+    except ValueError:
+        limit = 32
+    return history.history_report(limit=limit)
+
+
 def _debug_tail(query: dict[str, list[str]]) -> dict[str, Any]:
     from spark_bagging_tpu.telemetry import perf
 
@@ -388,6 +403,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _debug_drift())
             elif url.path == "/debug/tail":
                 self._send_json(200, _debug_tail(query))
+            elif url.path == "/debug/history":
+                self._send_json(200, _debug_history(query))
             elif url.path == "/debug/profile":
                 code, body = _debug_profile(query)
                 self._send_json(code, body)
@@ -403,7 +420,8 @@ class _Handler(BaseHTTPRequestHandler):
                         "/metrics", "/healthz", "/varz", "/alerts",
                         "/debug/spans", "/debug/runs",
                         "/debug/workload", "/debug/drift",
-                        "/debug/tail", "/debug/profile",
+                        "/debug/tail", "/debug/history",
+                        "/debug/profile",
                         "/fleet/metrics", "/fleet/varz",
                         "/fleet/healthz", "/fleet/incidents",
                     ],

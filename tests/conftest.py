@@ -32,6 +32,18 @@ import pytest
 # rediscovering where the seconds go with --durations runs
 _MODULE_TIMES: dict[str, float] = {}
 
+# per-module ran/skipped counts plus the `slow`-deselected split: the
+# pyramid's shape per module, so a future move-to-slow decision reads
+# the artifact instead of grepping markers. A test counts once — at
+# its call phase, or at setup when a skip/xfail kept call from running
+_MODULE_STATS: dict[str, dict[str, int]] = {}
+
+
+def _module_stats(mod: str) -> dict[str, int]:
+    return _MODULE_STATS.setdefault(
+        mod, {"tests": 0, "skipped": 0, "slow_deselected": 0}
+    )
+
 
 def pytest_configure(config):
     # session wall-clock anchor for the tier-1 budget ratchet
@@ -41,6 +53,7 @@ def pytest_configure(config):
     # the tier's -p no:randomly ordering)
     config._sbt_tier_t0 = time.monotonic()
     config._sbt_module_times = _MODULE_TIMES
+    config._sbt_module_stats = _MODULE_STATS
 
 
 def pytest_runtest_logreport(report):
@@ -48,6 +61,22 @@ def pytest_runtest_logreport(report):
     _MODULE_TIMES[mod] = (
         _MODULE_TIMES.get(mod, 0.0) + getattr(report, "duration", 0.0)
     )
+    stats = _module_stats(mod)
+    if report.when == "call" or (report.when == "setup"
+                                 and report.skipped):
+        stats["tests"] += 1
+    if report.skipped:
+        stats["skipped"] += 1
+
+
+def pytest_deselected(items):
+    # `-m 'not slow'` lands here: count the slow-marked weight each
+    # module keeps OUT of the tier (other deselection reasons — -k
+    # filters — are not slow weight and stay uncounted)
+    for item in items:
+        if item.get_closest_marker("slow") is not None:
+            mod = item.nodeid.split("::", 1)[0]
+            _module_stats(mod)["slow_deselected"] += 1
 
 
 @pytest.fixture(scope="session")

@@ -129,6 +129,7 @@ def test_aux_shape_validated():
         reg.fit(X, y, aux=delta[:-5])
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.8s mesh twin; replica-mesh parity stays tier-1 generic
 def test_bagged_aft_replica_mesh_matches_unsharded():
     """Replica-sharded aux fit ≡ unsharded (the test_sharded.py:53
     equality contract, now with the aux channel in the program)."""
@@ -154,6 +155,7 @@ def test_bagged_aft_data_mesh_runs():
     assert np.isfinite(pred).all() and (pred > 0).all()
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.7s quantile API quality soak; AFT fit invariants stay tier-1
 def test_bagged_aft_predict_quantiles():
     X, y, delta = _weibull_data(n=400, censor_frac=0.2, seed=4)
     reg = BaggingRegressor(
@@ -183,6 +185,7 @@ def test_aft_checkpoint_roundtrip(tmp_path):
     )
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.3s AFT stream soak; aux-col convention guard stays tier-1
 def test_streamed_aft_aux_col():
     """AFT streams out-of-core with the censor indicator carried as a
     designated column (Spark's censorCol-as-a-column convention):

@@ -37,6 +37,7 @@ def diabetes():
 
 
 class TestBaggingClassifier:
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.1s accuracy soak; aggregation correctness stays tier-1 via exact tests
     def test_accuracy_close_to_single_learner(self, breast_cancer):
         """Bagged accuracy ≈/≥ single base learner [SURVEY §4]."""
         X, y = breast_cancer
@@ -79,6 +80,7 @@ class TestBaggingClassifier:
         b = BaggingClassifier(n_estimators=4, max_features=0.5, seed=1).fit(X, y)
         assert not np.array_equal(np.asarray(a.subspaces_), np.asarray(b.subspaces_))
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.9s normalization soak; covered by the fuzz score-shape invariants tier-1
     def test_predict_proba_normalized(self, iris):
         X, y = iris
         for voting in ("soft", "hard"):
@@ -181,6 +183,7 @@ class TestBaggingClassifier:
 
 
 class TestBaggingRegressor:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.7s regressor quality soak; mean-aggregation exactness stays tier-1
     def test_r2_and_oob(self, diabetes):
         X, y = diabetes
         reg = BaggingRegressor(n_estimators=20, oob_score=True, seed=1).fit(X, y)
@@ -270,6 +273,7 @@ class TestSampleWeight:
             atol=1e-4,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.8s zero-weight soak; the property stays tier-1 via the fuzz representative
     def test_zero_weight_rows_ignored(self, breast_cancer):
         X, y = breast_cancer
         n = len(y)
@@ -284,6 +288,7 @@ class TestSampleWeight:
             b.score(X[n // 4:], y[n // 4:]), abs=0.02
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.9s mesh twin; single-device weighted-fit exactness stays tier-1
     def test_mesh_weighted_fit(self, breast_cancer):
         from spark_bagging_tpu.parallel import make_mesh
 
@@ -321,6 +326,7 @@ class TestSampleWeight:
             )
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.5s API-surface soak; predict/proba parity is continuously gated by the serving bitwise suites
 def test_predict_log_proba_and_decision_function(breast_cancer):
     X, y = breast_cancer
     clf = BaggingClassifier(n_estimators=4, seed=0).fit(X, y)
@@ -387,6 +393,7 @@ class TestWarmStart:
             rtol=1e-5, atol=1e-6,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.5s mesh twin of the warm-start parity kept tier-1 single-device
     def test_equals_cold_fit_on_mesh(self, breast_cancer):
         from spark_bagging_tpu.parallel import make_mesh
 
@@ -402,6 +409,7 @@ class TestWarmStart:
             rtol=1e-5, atol=1e-6,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2s warm-start regressor soak; classifier warm-start parity stays tier-1
     def test_regressor_and_oob(self, diabetes):
         X, y = diabetes
         cold = BaggingRegressor(n_estimators=12, seed=1, oob_score=True).fit(X, y)
@@ -445,6 +453,7 @@ class TestWarmStart:
             warm.set_params(n_estimators=8).fit(X, y)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.8s max_samples API variant soak; fractional path stays tier-1
 def test_int_max_samples(breast_cancer):
     """sklearn semantics: int max_samples = absolute expected sample
     count, equivalent to the float ratio count/n."""
@@ -721,6 +730,7 @@ class TestLinearCollapseInference:
             atol=2e-4,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.2s collapse-decision variant; the ridge collapse parity stays tier-1
     def test_glm_identity_collapses_log_does_not(self):
         from spark_bagging_tpu.models import GeneralizedLinearRegression
 

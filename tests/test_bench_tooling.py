@@ -322,6 +322,7 @@ class TestConfigResumePersist:
         # error, not silent skip — and the file is untouched
         assert out.read_text() == original
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~4.2s bench resume-policy drill; artifact-guard contracts stay tier-1 in the faster siblings
     def test_cpu_rows_never_resume(self, tmp_path):
         """A rehearsal file's own CPU rows re-measure on --resume —
         only TPU rows are capture progress worth carrying."""
@@ -444,6 +445,7 @@ class TestConfigResumePersist:
 
 
 class TestCellChild:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.8s bench child-process error drill
     def test_bad_impl_reports_error_not_crash(self):
         import subprocess
         proc = subprocess.run(

@@ -98,6 +98,7 @@ def test_future_format_version_rejected(tmp_path, iris):
         load_model(str(tmp_path / "m"))
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.7s OOB-through-checkpoint soak; the round-trip + OOB contracts each stay tier-1 separately
 def test_loaded_model_oob_reproducible(tmp_path):
     """The fit key is persisted, so OOB weights can be regenerated after
     load (shard-local regeneration property)."""

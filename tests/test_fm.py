@@ -29,6 +29,7 @@ def _xor_interaction(n=1200, seed=0):
 
 
 class TestFMClassifier:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.5s interaction-recovery quality soak
     def test_learns_pairwise_interaction(self):
         X, y = _xor_interaction()
         fm = FMClassifier(factor_size=4, max_iter=300, lr=0.1)
@@ -54,6 +55,7 @@ class TestFMClassifier:
                == y).mean()
         assert acc < 0.65
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.1s real-data quality soak
     def test_real_data_accuracy(self):
         X, y = load_breast_cancer(return_X_y=True)
         X = StandardScaler().fit_transform(X).astype(np.float32)
@@ -85,6 +87,7 @@ class TestFMClassifier:
             np.asarray(pw["W"]), np.asarray(pd["W"]), rtol=1e-3, atol=1e-4
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.4s FM integration soak; FM fit invariants stay tier-1 via the fuzz battery
     def test_in_bagging_and_mesh(self):
         X, y = _xor_interaction()
         clf = BaggingClassifier(
@@ -113,6 +116,7 @@ class TestFMClassifier:
 
 
 class TestFMRegressor:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.4s interaction-recovery quality soak
     def test_learns_interaction_regression(self):
         rng = np.random.default_rng(1)
         X = rng.normal(size=(1000, 5)).astype(np.float32)
@@ -126,6 +130,7 @@ class TestFMRegressor:
         r2 = 1 - np.var(pred - y) / np.var(y)
         assert r2 > 0.8
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.7s FM stream integration soak; stream engine parity stays tier-1 generic
     def test_bagged_and_streaming(self):
         from spark_bagging_tpu import ArrayChunks
 
@@ -146,6 +151,7 @@ class TestFMRegressor:
         ).fit_stream(src, n_epochs=60, lr=0.05)
         assert np.isfinite(rs.predict(X)).all()
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2s per-model checkpoint twin; generic round-trip stays tier-1 in test_checkpoint
     def test_checkpoint_roundtrip(self, tmp_path):
         from spark_bagging_tpu import load_model, save_model
 

@@ -77,6 +77,7 @@ class TestPerSplitSampling:
 
 
 class TestRandomForestClassifier:
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.6s accuracy/OOB quality soak; forest API + mesh parity stay tier-1
     def test_accuracy_and_oob(self):
         X, y = _breast_cancer()
         rf = RandomForestClassifier(
@@ -97,6 +98,7 @@ class TestRandomForestClassifier:
         rf.fit(X, y)
         assert rf.score(X, y) > 0.9
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.6s per-model checkpoint twin; the generic round-trip contract stays tier-1 in test_checkpoint
     def test_checkpoint_roundtrip(self, tmp_path):
         from spark_bagging_tpu import load_model, save_model
 
@@ -135,6 +137,7 @@ class TestRandomForestRegressor:
         assert rf.score(X, y) > 0.7
         assert np.isfinite(rf.oob_score_)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~4.3s stream-vs-memory subset soak; forest subset determinism stays tier-1
     def test_stream_matches_memory_with_feature_subset(self):
         """The streamed forest must replay the in-memory per-split
         masks exactly — identical trees from chunked data."""

@@ -53,6 +53,7 @@ class TestGBTRegressor:
         curve = np.asarray(aux["loss_curve"])
         assert np.all(np.diff(curve) <= 1e-5)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.4s sklearn-quality soak; boosting-step exactness stays tier-1
     def test_matches_sklearn_quality(self):
         from sklearn.ensemble import GradientBoostingRegressor
 
@@ -109,6 +110,7 @@ class TestGBTRegressor:
 
 
 class TestGBTClassifier:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.5s layout sweep soak; param-layout contracts stay tier-1
     def test_accuracy_and_param_layouts(self):
         X, y = load_breast_cancer(return_X_y=True)
         X = StandardScaler().fit_transform(X).astype(np.float32)
@@ -126,6 +128,7 @@ class TestGBTClassifier:
         p3 = gbt.init_params(KEY, 5, 3)
         assert p3["leaf"].shape == (30, 3, 8)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.9s bagged integration soak; GBT fit invariants stay tier-1
     def test_bagged_gbt_and_importances(self):
         X, y = load_breast_cancer(return_X_y=True)
         X = StandardScaler().fit_transform(X).astype(np.float32)
@@ -139,6 +142,7 @@ class TestGBTClassifier:
         assert imp.shape == (X.shape[1],)
         assert imp.sum() == pytest.approx(1.0, abs=1e-5)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2s mesh twin; replica-mesh parity stays tier-1 generic
     def test_mesh_fit_close_to_single_device(self):
         """Sharded prepare averages per-shard quantile edges (the
         documented tree semantic), so boosted splits can differ from
@@ -160,6 +164,7 @@ class TestGBTClassifier:
         agree = (a.predict(X) == b.predict(X)).mean()
         assert agree > 0.95
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.4s per-model checkpoint twin; generic round-trip stays tier-1 in test_checkpoint
     def test_checkpoint_roundtrip(self, tmp_path):
         from spark_bagging_tpu import load_model, save_model
 
@@ -204,6 +209,7 @@ def test_n_rounds_validation():
         GBTClassifier(n_rounds=-1)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.4s stochastic-round soak; subsample determinism stays tier-1
 def test_subsample_stochastic_rounds():
     """subsample<1 draws an independent Bernoulli row subset per round:
     the fit must differ from the deterministic one, stay finite, and
@@ -237,6 +243,7 @@ def test_subsample_keyless_fit_rejected():
                 None)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.3s sharded subsample soak
 def test_subsample_sharded_decorrelated():
     """Each data shard must draw its own keep mask (sharded fit would
     otherwise bias the round subsets by local row position)."""
@@ -255,6 +262,7 @@ def test_subsample_sharded_decorrelated():
 
 
 class TestGBTMulticlass:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.7s accuracy quality soak
     def test_iris_accuracy_and_loss(self):
         from sklearn.datasets import load_iris
 
@@ -271,6 +279,7 @@ class TestGBTMulticlass:
         curve = np.asarray(aux["loss_curve"])
         assert np.all(np.diff(curve) <= 1e-5)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2s multiclass integration soak
     def test_bagged_multiclass_with_importances(self):
         from sklearn.datasets import load_iris
 
@@ -288,6 +297,7 @@ class TestGBTMulticlass:
         # petal features dominate iris
         assert imp[2] + imp[3] > 0.5
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.9s multiclass checkpoint soak; generic round-trip stays tier-1 in test_checkpoint
     def test_multiclass_subsample_and_checkpoint(self, tmp_path):
         from sklearn.datasets import load_iris
 
@@ -324,6 +334,7 @@ def test_multiclass_guards():
                jnp.ones(30), None)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.2s subset decorrelation soak
 def test_multiclass_feature_subset_trees_differ():
     """With a real key, per-class trees draw DIFFERENT feature masks."""
     from sklearn.datasets import load_iris

@@ -163,6 +163,7 @@ class TestSolverProperties:
 
 
 class TestIntegration:
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.3s GLM integration soak; solver exactness stays tier-1
     def test_bagged_poisson_and_mesh(self):
         X, y = _poisson_data()
         reg = BaggingRegressor(
@@ -199,6 +200,7 @@ class TestIntegration:
         # learned something: correlation with targets
         assert np.corrcoef(mu, y)[0, 1] > 0.3
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1s per-model checkpoint twin; generic round-trip stays tier-1 in test_checkpoint
     def test_checkpoint_roundtrip(self, tmp_path):
         from spark_bagging_tpu import load_model, save_model
 

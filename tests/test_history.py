@@ -1,0 +1,207 @@
+"""Longitudinal history store + trend verdicts [ISSUE 14]:
+
+- append/read round-trip (torn lines tolerated, never fatal);
+- ``compare_trend``: a digest flip FIRES (exact, no noise band), an
+  SLO ok->failed transition fires, numeric wobble inside the CI-noise
+  band does NOT, movement beyond it is advisory drift;
+- the surfaces: ``/debug/history`` on the scrape server and the
+  ``python -m benchmarks.scenarios history`` CLI both render appended
+  runs with the correct flip verdict.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.telemetry import history
+from spark_bagging_tpu.telemetry import server as tserver
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    # every test gets its own telemetry dir: the history store under
+    # test must never read the repo's real run artifacts
+    monkeypatch.setenv("SBT_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    tserver.stop_server()
+    telemetry.recorder.disarm()
+    telemetry.reset()
+    telemetry.enable()
+
+
+def test_append_and_read_roundtrip(tmp_path):
+    r1 = history.append_record(
+        "scenario", "steady", digests={"output": "aa"},
+        numbers={"rps": 100.0}, slo_ok=True, ts=1000.0,
+    )
+    r2 = history.append_record(
+        "scenario", "steady", digests={"output": "aa"},
+        numbers={"rps": 101.0}, slo_ok=True, ts=1001.0,
+        run_id="explicit-id",
+    )
+    assert r1["schema"] == history.HISTORY_SCHEMA_VERSION
+    assert r2["run_id"] == "explicit-id"
+    path = history.history_path()
+    assert path.startswith(str(tmp_path))
+    back = history.read_history()
+    assert [r["ts"] for r in back] == [1000.0, 1001.0]
+    assert back[0]["digests"] == {"output": "aa"}
+    assert back[1]["numbers"] == {"rps": 101.0}
+    # limit keeps the newest; limit=0 means NONE (not records[-0:],
+    # which would slice the whole store)
+    assert [r["ts"] for r in history.read_history(limit=1)] == [1001.0]
+    assert history.read_history(limit=0) == []
+    assert history.history_report(limit=0)["records"] == []
+    # the append counter moved
+    assert telemetry.registry().counter(
+        "sbt_history_appends_total").value == 2
+
+
+def test_torn_and_garbage_lines_are_skipped():
+    history.append_record("tier", "tier1", numbers={"elapsed_s": 400.0})
+    with open(history.history_path(), "a") as f:
+        f.write("not json at all\n")
+        f.write('{"kind": "tier", "key": "tier1", "truncat')  # torn
+    history.append_record("tier", "tier1", numbers={"elapsed_s": 410.0})
+    back = history.read_history()
+    assert len(back) == 2
+    assert all(r["kind"] == "tier" for r in back)
+
+
+def _rec(key, ts, digest=None, rps=None, slo_ok=None, kind="scenario"):
+    r = {"schema": 1, "ts": ts, "run_id": f"{key}-{ts}", "kind": kind,
+         "key": key}
+    if digest is not None:
+        r["digests"] = {"output": digest}
+    if rps is not None:
+        r["numbers"] = {"rps": rps}
+    if slo_ok is not None:
+        r["slo_ok"] = slo_ok
+    return r
+
+
+def test_digest_flip_fires_exactly():
+    trend = history.compare_trend([
+        _rec("a", 1, digest="X", rps=100.0),
+        _rec("a", 2, digest="X", rps=99.0),
+        _rec("a", 3, digest="Y", rps=101.0),
+    ])
+    assert trend["ok"] is False
+    (flip,) = trend["flips"]
+    assert flip["class"] == "digest"
+    assert flip["field"] == "output"
+    assert (flip["from"], flip["to"]) == ("X", "Y")
+    assert flip["run_to"] == "a-3"
+    assert trend["groups"]["scenario:a"]["flips"] == 1
+    # the noise-band rps wobble (±1%) raised no drift
+    assert trend["drift"] == []
+    # and the gauges mirror the verdict
+    reg = telemetry.registry()
+    assert reg.gauge("sbt_history_digest_flips").value == 1.0
+    assert reg.gauge("sbt_history_records").value == 3.0
+
+
+def test_noise_band_wobble_does_not_fire():
+    recs = [_rec("a", t, digest="X", rps=rps)
+            for t, rps in ((1, 100.0), (2, 108.0), (3, 95.0),
+                           (4, 103.0))]
+    trend = history.compare_trend(recs)
+    assert trend["ok"] is True
+    assert trend["flips"] == [] and trend["drift"] == []
+    # beyond the band: the latest run collapses to 30 rps (-70%)
+    trend = history.compare_trend(recs + [_rec("a", 5, digest="X",
+                                               rps=30.0)])
+    assert trend["ok"] is True  # drift is advisory, not a flip
+    (d,) = trend["drift"]
+    assert d["field"] == "rps" and d["relative"] < -history.NOISE_TOLERANCE
+    # a single run has no trend to judge
+    assert history.compare_trend([_rec("b", 1, rps=1.0)])["drift"] == []
+
+
+def test_slo_regression_is_a_flip():
+    trend = history.compare_trend([
+        _rec("a", 1, digest="X", slo_ok=True),
+        _rec("a", 2, digest="X", slo_ok=False),
+    ])
+    assert trend["ok"] is False
+    (flip,) = trend["flips"]
+    assert flip["class"] == "slo" and flip["field"] == "slo_ok"
+    # flips compare against the LAST-KNOWN value: a record carrying no
+    # slo_ok (a `record`/`run` append) or omitting a digest field
+    # interleaved between two checks must not mask the regression
+    trend = history.compare_trend([
+        _rec("a", 1, digest="X", slo_ok=True),
+        _rec("a", 2, rps=1.0),  # no slo_ok, no digests
+        _rec("a", 3, digest="Y", slo_ok=False),
+    ])
+    assert {f["class"] for f in trend["flips"]} == {"digest", "slo"}
+    assert all(f["run_from"] == "a-1" and f["run_to"] == "a-3"
+               for f in trend["flips"])
+    # groups are independent: a flip in one never marks another
+    trend2 = history.compare_trend([
+        _rec("a", 1, digest="X"), _rec("a", 2, digest="Y"),
+        _rec("b", 1, digest="Z"), _rec("b", 2, digest="Z"),
+    ])
+    assert trend2["groups"]["scenario:b"]["flips"] == 0
+    assert trend2["groups"]["scenario:a"]["flips"] == 1
+
+
+def test_history_report_and_render():
+    history.append_record("scenario", "s", digests={"output": "A"},
+                          ts=1.0)
+    history.append_record("scenario", "s", digests={"output": "B"},
+                          ts=2.0)
+    report = history.history_report(limit=1)
+    assert report["runs"] == 2
+    assert len(report["records"]) == 1  # limit trims the listing...
+    assert len(report["trend"]["flips"]) == 1  # ...but not the scan
+    text = history.render_history(report)
+    assert "FLIP" in text and "scenario:s" in text
+    assert "DIGEST FLIP" in text
+
+
+def test_debug_history_route_renders_appended_runs():
+    """ISSUE 14 acceptance: /debug/history renders >= 2 appended runs
+    with a correct digest-flip verdict."""
+    history.append_record("scenario", "steady",
+                          digests={"output": "aaa"}, ts=10.0)
+    history.append_record("scenario", "steady",
+                          digests={"output": "bbb"}, ts=11.0)
+    port = tserver.start_server(0)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/history", timeout=10
+    ) as resp:
+        assert resp.status == 200
+        body = json.loads(resp.read().decode())
+    assert body["runs"] == 2
+    assert len(body["records"]) == 2
+    assert body["trend"]["ok"] is False
+    (flip,) = body["trend"]["flips"]
+    assert flip["field"] == "output"
+    assert (flip["from"], flip["to"]) == ("aaa", "bbb")
+    # the route is on the index
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=10
+    ) as resp:
+        assert "/debug/history" in json.loads(resp.read().decode())[
+            "endpoints"]
+
+
+def test_history_cli_renders_and_exits_on_flip(capsys):
+    """`python -m benchmarks.scenarios history` (in-process): renders
+    the appended runs and exits 2 on a digest flip, 0 when stable."""
+    from benchmarks.scenarios.__main__ import main
+
+    history.append_record("scenario", "s", digests={"output": "A"},
+                          ts=1.0)
+    assert main(["history"]) == 0
+    history.append_record("scenario", "s", digests={"output": "B"},
+                          ts=2.0)
+    rc = main(["history"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "FLIP" in out and "2 runs" in out

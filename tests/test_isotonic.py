@@ -95,6 +95,7 @@ class TestExactness:
 
 
 class TestIntegration:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.8s isotonic integration soak; PAV exactness stays tier-1
     def test_bagged_isotonic(self):
         rng = np.random.default_rng(4)
         X = rng.normal(size=(600, 1)).astype(np.float32)
@@ -122,6 +123,7 @@ class TestIntegration:
         assert vals.shape == (4, 32)
         assert np.isfinite(np.asarray(vals)).all()
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.1s per-model checkpoint twin; generic round-trip stays tier-1 in test_checkpoint
     def test_checkpoint_roundtrip(self, tmp_path):
         from spark_bagging_tpu import load_model, save_model
 

@@ -190,6 +190,7 @@ def test_newton_row_tile_matches_single_pass():
     np.testing.assert_allclose(ab["loss"], at["loss"], rtol=1e-5)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.4s tiling integration soak; row-tile kernel correctness stays tier-1 direct
 def test_row_tile_in_ensemble():
     from spark_bagging_tpu import BaggingClassifier
 
@@ -249,6 +250,7 @@ def test_fused_hessian_matches_blocked():
         )
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.7s wide-class sweep; fused-vs-blocked parity stays tier-1
 def test_fused_hessian_many_classes():
     """auto resolves to fused past C=8; a 12-class fit must train and
     match the blocked assembly."""
@@ -319,6 +321,7 @@ class TestGaussianNB:
             atol=1e-6,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.9s GaussianNB bagging+mesh integration soak; NB fit invariants stay tier-1 via the fuzz battery
     def test_in_bagging_ensemble_and_mesh(self):
         from spark_bagging_tpu import BaggingClassifier, make_mesh
         from spark_bagging_tpu.models import GaussianNB
@@ -424,6 +427,7 @@ class TestLinearSVC:
             curve = np.asarray(aux["loss_curve"])
             assert np.all(np.diff(curve) <= 1e-5), (trial, curve)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.5s SVC weight-duplication soak; the weighted==duplicated property stays tier-1 via cheaper reps
     def test_poisson_weights_equal_duplicated_rows(self):
         from spark_bagging_tpu.models import LinearSVC
 
@@ -458,6 +462,7 @@ class TestLinearSVC:
         assert W.shape == (4, Xj.shape[1] + 1, 3)
         assert np.isfinite(np.asarray(W)).all()
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~4.9s SVC bagging+mesh integration soak; SVC kernel correctness stays tier-1 direct
     def test_in_bagging_ensemble_and_mesh(self):
         from spark_bagging_tpu import BaggingClassifier, make_mesh
         from spark_bagging_tpu.models import LinearSVC
@@ -550,6 +555,7 @@ class TestMultinomialNB:
             rtol=1e-4, atol=1e-5,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.3s NB mesh integration soak; NB fit invariants stay tier-1 via the fuzz battery
     def test_in_bagging_and_mesh(self):
         from spark_bagging_tpu import BaggingClassifier, make_mesh
         from spark_bagging_tpu.models import MultinomialNB
@@ -617,6 +623,7 @@ class TestBernoulliNB:
             rtol=1e-4, atol=1e-5,
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.4s NB integration soak; NB fit invariants stay tier-1 via the fuzz battery
     def test_in_bagging_and_checkpoint(self, tmp_path):
         from spark_bagging_tpu import BaggingClassifier, load_model, save_model
         from spark_bagging_tpu.models import BernoulliNB
@@ -694,6 +701,7 @@ def test_packed_hessian_matches_blocked():
         )
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.1s packed-impl integration soak; packed-vs-blocked parity stays tier-1
 def test_packed_hessian_in_ensemble_and_sharded():
     from spark_bagging_tpu import BaggingClassifier, make_mesh
 

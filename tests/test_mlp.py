@@ -34,6 +34,7 @@ def _two_moons(n=400, seed=0):
 
 
 class TestMLPClassifier:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.6s convergence quality soak; forward/param contracts stay tier-1
     def test_solves_xor(self):
         X, y = _two_moons()
         mlp = MLPClassifier(hidden=32, max_iter=400, lr=3e-3)
@@ -47,6 +48,7 @@ class TestMLPClassifier:
         ).mean()
         assert acc > 0.95  # a linear model caps at ~0.5 here
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1s real-data quality soak
     def test_breast_cancer(self):
         Xj, yj, X, y = _breast_cancer()
         mlp = MLPClassifier(hidden=32, max_iter=300, lr=3e-3)
@@ -108,6 +110,7 @@ class TestMLPClassifier:
 
 
 class TestMLPRegressor:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.3s convergence quality soak; regressor contracts stay tier-1
     def test_fits_nonlinear_function(self):
         rng = np.random.default_rng(0)
         X = rng.uniform(-2, 2, size=(500, 1)).astype(np.float32)
@@ -120,6 +123,7 @@ class TestMLPRegressor:
         mse = ((pred - y) ** 2).mean()
         assert mse < 0.05  # var(y) ≈ 0.5 ⇒ this is a real fit
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1s real-data quality soak
     def test_diabetes(self):
         X, y = load_diabetes(return_X_y=True)
         X = StandardScaler().fit_transform(X).astype(np.float32)
@@ -134,6 +138,7 @@ class TestMLPRegressor:
 
 
 class TestMLPBagging:
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.7s real-data quality soak; MLP fit invariants stay tier-1 via xor-free fast tests
     def test_bagged_mlps_breast_cancer(self):
         Xj, yj, X, y = _breast_cancer()
         clf = BaggingClassifier(
@@ -146,6 +151,7 @@ class TestMLPBagging:
         proba = clf.predict_proba(X)
         np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-4)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~1.5s mesh integration soak; replica-mesh parity stays tier-1 generic
     def test_bagged_mlp_regressor_on_mesh(self):
         rng = np.random.default_rng(0)
         X = rng.normal(size=(300, 4)).astype(np.float32)
@@ -161,6 +167,7 @@ class TestMLPBagging:
         assert reg.score(X, y) > 0.5
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.8s batch-size degenerate sweep; minibatch engine contracts stay tier-1
 def test_full_batch_size_degenerates_to_exact_path():
     """batch_size >= n must use the exact full-batch branch, not
     with-replacement draws of n rows."""

@@ -95,6 +95,7 @@ class TestEngineEquivalence:
             a.predict_proba(X), b.predict_proba(X), rtol=1e-6
         )
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.4s prefetch engine-equivalence soak; the classifier prefetch parity stays tier-1
     def test_regressor_and_tree_stream_with_prefetch(self):
         from spark_bagging_tpu.models import DecisionTreeRegressor
 

@@ -30,33 +30,44 @@ from spark_bagging_tpu.models import (
 
 KEY = jax.random.key(42)
 
+
+def _soak(learner):
+    """[PR 14 pyramid] the heavyweight zoo entries (1.5-5s per fuzz
+    test each) carry the slow mark: the INVARIANTS stay continuously
+    enforced in tier-1 by the cheap representatives below (plain
+    logistic, the NBs, linear/GLM/isotonic/tree regressors), and the
+    heavy families keep full fuzz coverage in the slow tier plus
+    their own dedicated suites."""
+    return pytest.param(learner, marks=pytest.mark.slow)
+
+
 CLASSIFIERS = [
     LogisticRegression(max_iter=4),
-    LogisticRegression(max_iter=1, init="pooled"),
-    LinearSVC(max_iter=4),
-    LinearSVC(max_iter=2, init="pooled"),
-    DecisionTreeClassifier(max_depth=3, n_bins=8),
-    MLPClassifier(hidden=8, max_iter=30),
+    _soak(LogisticRegression(max_iter=1, init="pooled")),
+    _soak(LinearSVC(max_iter=4)),
+    _soak(LinearSVC(max_iter=2, init="pooled")),
+    _soak(DecisionTreeClassifier(max_depth=3, n_bins=8)),
+    _soak(MLPClassifier(hidden=8, max_iter=30)),
     GaussianNB(),
     MultinomialNB(),
     BernoulliNB(),
-    FMClassifier(factor_size=2, max_iter=30),
-    GBTClassifier(n_rounds=4, max_depth=2, n_bins=8),
+    _soak(FMClassifier(factor_size=2, max_iter=30)),
+    _soak(GBTClassifier(n_rounds=4, max_depth=2, n_bins=8)),
 ]
 REGRESSORS = [
     # aux=None ⇒ fully-observed Weibull regression (positive y required
     # — _reg_data guarantees it)
-    AFTSurvivalRegression(max_iter=30),
+    _soak(AFTSurvivalRegression(max_iter=30)),
     LinearRegression(),
     GeneralizedLinearRegression(family="gaussian"),
-    GeneralizedLinearRegression(family="poisson", max_iter=5),
-    GeneralizedLinearRegression(family="poisson", max_iter=2,
-                                init="pooled"),
+    _soak(GeneralizedLinearRegression(family="poisson", max_iter=5)),
+    _soak(GeneralizedLinearRegression(family="poisson", max_iter=2,
+                                      init="pooled")),
     DecisionTreeRegressor(max_depth=3, n_bins=8),
     IsotonicRegression(n_bins=16),
-    MLPRegressor(hidden=8, max_iter=30),
-    FMRegressor(factor_size=2, max_iter=30),
-    GBTRegressor(n_rounds=4, max_depth=2, n_bins=8),
+    _soak(MLPRegressor(hidden=8, max_iter=30)),
+    _soak(FMRegressor(factor_size=2, max_iter=30)),
+    _soak(GBTRegressor(n_rounds=4, max_depth=2, n_bins=8)),
 ]
 
 

@@ -151,6 +151,37 @@ def test_burst_sheds_with_backpressure_not_failure(executor, wl):
     assert r2["output_digest"] == r["output_digest"]
 
 
+def test_deadline_sheds_deterministically(executor):
+    """ISSUE 14: the deadline drill — every request carries a tight
+    in-queue deadline driven off the VIRTUAL clock, so which requests
+    expire while coalescing is a pure function of (workload,
+    deadline): same sheds, same survivors, same output bytes, run
+    after run."""
+    dense = workload.synthetic_workload(
+        "poisson", rate_rps=500, duration_s=0.4, seed=6, width=8,
+        bucket_bounds=(8, 32),
+    )
+    r1 = R.replay(dense, executor=executor, seed=3, deadline_ms=0.6)
+    r2 = R.replay(dense, executor=executor, seed=3, deadline_ms=0.6)
+    assert r1["deadline_sheds"] > 0
+    assert r1["deadline_sheds"] == r2["deadline_sheds"]
+    assert r1["served"] == r2["served"]
+    assert r1["served"] + r1["deadline_sheds"] == r1["n_requests"]
+    # shed futures surface as DeadlineExceeded, counted as errors
+    assert r1["errors"] == r1["deadline_sheds"]
+    assert r1["output_digest"] == r2["output_digest"]
+    # replay_median's determinism assertion covers the shed count
+    m = R.replay_median(dense, repeats=2, executor=executor, seed=3,
+                        deadline_ms=0.6)
+    assert m["deadline_sheds"] == r1["deadline_sheds"]
+    # a generous deadline sheds nothing and changes no bytes
+    loose = R.replay(dense, executor=executor, seed=3,
+                     deadline_ms=5000.0)
+    assert loose["deadline_sheds"] == 0 and loose["errors"] == 0
+    with pytest.raises(ValueError, match="deadline_ms"):
+        R.replay(dense, executor=executor, seed=3, deadline_ms=-1.0)
+
+
 def test_swap_under_fire_keeps_outputs_bitwise(clf, wl):
     reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
     reg.register("m", clf, warmup=True)
@@ -234,6 +265,32 @@ def test_absolute_spec_gate(executor, wl):
     assert ok.ok, ok.render()
     bad = R.check_report(r, spec=slo.SLOSpec(min_rps=1e12))
     assert not bad.ok
+
+
+def test_exit_code_contract_classification():
+    """The shared 0/2/3 contract (benchmarks/BUDGETS.md): band-named
+    failures exit 3, anything hard exits 2 — and a band-named check
+    that MEASURED NOTHING (actual None, a broken report) is a hard
+    breach, never host noise."""
+    from spark_bagging_tpu.telemetry import slo
+
+    def res(*checks):
+        return slo.SLOResult(list(checks))
+
+    ok = {"name": "rps", "actual": 5.0, "limit": 1.0, "op": ">=",
+          "ok": True}
+    band = {"name": "latency_p50_vs_baseline", "actual": 9.0,
+            "limit": 1.0, "op": "<=", "ok": False}
+    hard = {"name": "output_digest_vs_baseline", "actual": "a",
+            "limit": "b", "op": "==", "ok": False}
+    missing = {"name": "stage_share_queue", "actual": None,
+               "limit": 0.5, "op": "<=", "ok": False}
+    assert slo.exit_code(res(ok)) == slo.EXIT_OK == 0
+    assert slo.exit_code(res(ok, band)) == slo.EXIT_HOST_BAND == 3
+    assert slo.exit_code(res(band, hard)) == slo.EXIT_BREACH == 2
+    assert slo.exit_code(res(missing)) == slo.EXIT_BREACH
+    assert slo.is_host_band_check("rps_vs_baseline")
+    assert not slo.is_host_band_check("post_warmup_compiles")
 
 
 # -- the drift scenario (the model-quality plane's scripted incident) --
@@ -537,11 +594,12 @@ def test_cli_smoke_replay_check_under_budget(tmp_path):
     assert attr["clock"] == "virtual" and attr["digest"]
     assert sum(attr["verdicts"].values()) == report["n_requests"]
     assert attr["cost_model"]
-    # the acceptance exit-code contract end to end, driven through the
-    # --workload file path: the same gate with an injected
-    # forward-path slowdown must exit nonzero (and the throttle only
-    # bends timing — the report must still reproduce the baseline's
-    # output bytes from the saved schedule)
+    # the shared exit-code contract end to end (benchmarks/BUDGETS.md),
+    # driven through the --workload file path: an injected forward-path
+    # slowdown fails ONLY the host-conditional performance bands, so
+    # the gate exits 3 (band), not 2 — and the throttle only bends
+    # timing, so the report still reproduces the baseline's output
+    # bytes from the saved schedule
     rc2 = R.main([
         "--workload", wl_path, "--n-estimators", "4",
         "--bucket-max-rows", "32", "--width", "6",
@@ -549,12 +607,29 @@ def test_cli_smoke_replay_check_under_budget(tmp_path):
         "--check", "--baseline", out,
         "--out", str(tmp_path / "throttled.json"),
     ])
-    assert rc2 == 2
+    assert rc2 == 3
     throttled = json.loads(open(str(tmp_path / "throttled.json")).read())
     assert throttled["output_digest"] == report["output_digest"]
     failed = {c["name"] for c in throttled["slo"]["checks"]
               if not c["ok"]}
     assert "latency_p50_vs_baseline" in failed
+    from spark_bagging_tpu.telemetry import slo as slo_mod
+
+    assert all(slo_mod.is_host_band_check(n) for n in failed)
+    # a HARD breach — the baseline's output digest corrupted — must
+    # still exit 2: digest identity is never a band
+    baseline = json.loads(open(out).read())
+    baseline["output_digest"] = "0" * 64
+    corrupt = str(tmp_path / "corrupt_baseline.json")
+    with open(corrupt, "w") as f:
+        json.dump(baseline, f)
+    rc3 = R.main([
+        "--workload", wl_path, "--n-estimators", "4",
+        "--bucket-max-rows", "32", "--width", "6",
+        "--repeats", "1", "--check", "--baseline", corrupt,
+        "--out", str(tmp_path / "breach.json"),
+    ])
+    assert rc3 == 2
 
 
 def test_cli_drift_gate_under_budget(tmp_path):

@@ -1,0 +1,255 @@
+"""Scenario-conformance plane [ISSUE 14 acceptance]:
+
+- registry shape: >= 6 scenarios, each with a COMMITTED digest
+  baseline whose SLO spec round-trips ``SLOSpec`` exactly (unknown
+  fields loud, ``max_stage_share`` included);
+- THE tier-1 smoke: the full ``check`` pass in-process — every
+  registered scenario re-runs through the replay machinery and
+  byte-matches its committed baseline, under an asserted budget;
+- breach detection: a corrupted baseline digest is a hard breach
+  (exit 2), a missing baseline is loud, a scenario whose device needs
+  this host cannot meet is the host-conditional band (exit 3).
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.telemetry.slo import SLOSpec
+
+from benchmarks import scenarios as S
+from benchmarks.scenarios import runner
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    # runs append to the history store: keep it off the repo's dir
+    monkeypatch.setenv("SBT_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.reset()
+    telemetry.enable()
+
+
+def test_registry_shape_and_committed_baselines():
+    assert len(S.SCENARIOS) >= 6
+    S.validate_registry()  # every SLO dict round-trips SLOSpec
+    root = runner.baselines_dir()
+    for name, sc in S.SCENARIOS.items():
+        assert sc.name == name
+        assert sc.workload["kind"] and "seed" in sc.workload
+        b = runner.load_baseline(name)
+        assert b is not None, (
+            f"scenario {name!r} has no committed baseline under "
+            f"{root}; run `python -m benchmarks.scenarios record "
+            f"--only {name}`"
+        )
+        assert b["schema"] == runner.BASELINE_SCHEMA_VERSION
+        assert b["scenario"] == name
+        assert b["digests"]["output"]
+        assert b["environment"]["device_count"] == S.SCENARIO_DEVICES
+    # the parity pair shares (workload, model) by construction — the
+    # whole contract is "same bytes through a different executor"
+    sp = S.get("sharded-parity")
+    ref = S.get(sp.parity_with)
+    assert sp.workload == ref.workload
+    assert sp.model == ref.model
+    # committed artifacts: baselines are the ONLY scenario files in
+    # the tree (reports/history live in telemetry_dir())
+    assert sorted(os.listdir(root)) == sorted(
+        f"{n}.json" for n in S.SCENARIOS
+    )
+
+
+def test_slo_spec_roundtrips_through_baseline_files():
+    """Satellite [ISSUE 14]: the committed baseline JSON carries the
+    spec verbatim — SLOSpec.from_dict(file) -> to_dict() is the
+    identity, unknown-field rejection is preserved, and
+    max_stage_share survives the trip."""
+    saw_stage_share = False
+    for name in S.names():
+        b = runner.load_baseline(name)
+        spec = SLOSpec.from_dict(b["slo"])
+        assert spec.to_dict() == b["slo"], name
+        if b["slo"].get("max_stage_share"):
+            saw_stage_share = True
+            assert spec.max_stage_share == b["slo"]["max_stage_share"]
+        bogus = dict(b["slo"])
+        bogus["max_warp_factor"] = 9
+        with pytest.raises(ValueError, match="unknown SLO spec"):
+            SLOSpec.from_dict(bogus)
+    assert saw_stage_share, (
+        "at least one committed scenario SLO must exercise "
+        "max_stage_share (the round-trip this test exists to pin)"
+    )
+
+
+def test_registration_validation():
+    with pytest.raises(ValueError, match="already registered"):
+        S.register(S.get("steady-poisson"))
+    with pytest.raises(ValueError, match="kind"):
+        S.register(S.Scenario(name="x", description="d",
+                              workload={"seed": 1}))
+    with pytest.raises(ValueError, match="not registered"):
+        S.register(S.Scenario(
+            name="y", description="d",
+            workload={"kind": "poisson", "seed": 1},
+            parity_with="no-such-scenario",
+        ))
+    with pytest.raises(KeyError, match="unknown scenario"):
+        S.get("no-such-scenario")
+
+
+@pytest.mark.scenario
+def test_scenario_conformance_check_smoke(tmp_path):
+    """THE tier-1 scenario-conformance smoke [ISSUE 14 acceptance]:
+    the full `check` over every registered scenario, in-process —
+    each digest byte-identical to its committed baseline (cross-repeat
+    identity already asserted inside replay_median), every SLO green,
+    exit 0 — under an asserted budget (the point of the pyramid: all
+    eight incident drills cost less than two of the old soak tests)."""
+    from benchmarks.scenarios.__main__ import main
+
+    t0 = time.monotonic()
+    out = str(tmp_path / "conformance.json")
+    rc = main(["check", "--out", out])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 60.0, f"scenario check took {elapsed:.1f}s"
+    report = json.loads(open(out).read())
+    assert report["ok"] is True
+    assert report["registered"] >= 6
+    by_name = {r["scenario"]: r for r in report["scenarios"]}
+    assert len(by_name) == report["registered"]
+    assert all(r["status"] == "pass" for r in by_name.values())
+    # the incident sections ride the conformance report
+    assert by_name["chaos-mixed"]["chaos"]["retries"] > 0
+    assert by_name["drift-onset"]["drift"]["alerts_fired"] == 1
+    assert by_name["fleet-peer-loss"]["fleet"]["converged"] is True
+    assert by_name["deadline-shed"]["counts"]["deadline_sheds"] > 0
+    assert by_name["sharded-parity"]["digests"]["output"] == \
+        by_name["steady-poisson"]["digests"]["output"]
+    # the conformance plane is itself observable
+    reg = telemetry.registry()
+    assert reg.counter("sbt_scenario_runs_total",
+                       labels={"scenario": "steady-poisson"}).value >= 1
+    assert reg.gauge("sbt_scenario_digest_match",
+                     labels={"scenario": "steady-poisson"}).value == 1.0
+    # and every run landed in the history store with its digests
+    from spark_bagging_tpu.telemetry import history
+
+    recs = history.read_history()
+    assert {r["key"] for r in recs} == set(S.names())
+    assert all(r["digests"]["output"] for r in recs)
+    assert all(r["slo_ok"] is True for r in recs)
+
+
+def test_digest_breach_is_hard_exit_2(tmp_path):
+    root = str(tmp_path / "baselines")
+    os.makedirs(root)
+    shutil.copy(runner.baseline_path("steady-poisson"),
+                runner.baseline_path("steady-poisson", root))
+    b = runner.load_baseline("steady-poisson", root)
+    b["digests"]["output"] = "0" * 64
+    with open(runner.baseline_path("steady-poisson", root), "w") as f:
+        json.dump(b, f)
+    report = runner.run_conformance(
+        "check", ["steady-poisson"], baselines_root=root,
+        history_path=str(tmp_path / "h.jsonl"),
+    )
+    (row,) = report["scenarios"]
+    assert row["status"] == "digest-breach"
+    assert report["exit_code"] == 2 and report["ok"] is False
+    (mm,) = [m for m in row["mismatches"]
+             if m["field"] == "digest.output"]
+    assert mm["expected"] == "0" * 64
+    # the failure is counted and the match gauge drops
+    reg = telemetry.registry()
+    assert reg.counter(
+        "sbt_scenario_failures_total",
+        labels={"scenario": "steady-poisson", "kind": "digest"},
+    ).value == 1
+    assert reg.gauge("sbt_scenario_digest_match",
+                     labels={"scenario": "steady-poisson"}).value == 0.0
+    # and the history record carries the breach run's digests so the
+    # trend store flags the flip on the next scan
+    from spark_bagging_tpu.telemetry import history
+
+    recs = history.read_history(str(tmp_path / "h.jsonl"))
+    assert len(recs) == 1
+    assert recs[0]["digests"]["output"] != "0" * 64
+    assert recs[0]["detail"]["status"] == "digest-breach"
+
+
+def test_missing_baseline_is_loud(tmp_path):
+    report = runner.run_conformance(
+        "check", ["burst-shed"],
+        baselines_root=str(tmp_path / "empty"),
+        history_path=str(tmp_path / "h.jsonl"),
+    )
+    (row,) = report["scenarios"]
+    assert row["status"] == "no-baseline"
+    assert "record" in row["note"]
+    assert report["exit_code"] == 2
+    # counted under its own failure kind (not masquerading as an SLO
+    # breach), and NO digest_match verdict was exported — nothing was
+    # compared
+    reg = telemetry.registry()
+    assert reg.counter(
+        "sbt_scenario_failures_total",
+        labels={"scenario": "burst-shed", "kind": "baseline-missing"},
+    ).value == 1
+    assert reg.peek("sbt_scenario_digest_match",
+                    {"scenario": "burst-shed"}) is None
+
+
+def test_run_and_record_export_no_digest_verdict(tmp_path):
+    """`run`/`record` compare nothing: sbt_scenario_digest_match must
+    not light up green without a check having happened."""
+    runner.run_conformance(
+        "run", ["deadline-shed"],
+        history_path=str(tmp_path / "h.jsonl"),
+    )
+    reg = telemetry.registry()
+    assert reg.peek("sbt_scenario_digest_match",
+                    {"scenario": "deadline-shed"}) is None
+    assert reg.counter("sbt_scenario_runs_total",
+                       labels={"scenario": "deadline-shed"}).value == 1
+
+
+def test_unmeetable_device_need_is_host_band(tmp_path):
+    sc = S.Scenario(
+        name="needs-64-devices", description="d",
+        workload={"kind": "poisson", "rate_rps": 100.0,
+                  "duration_s": 0.1, "seed": 1, "width": 4},
+        devices=64,
+    )
+    S.register(sc)
+    try:
+        report = runner.run_conformance(
+            "check", ["needs-64-devices"],
+            baselines_root=str(tmp_path),
+            history_path=str(tmp_path / "h.jsonl"),
+        )
+    finally:
+        del S.SCENARIOS["needs-64-devices"]
+    (row,) = report["scenarios"]
+    assert row["status"] == "skipped"
+    assert "host-conditional" in row["note"]
+    assert report["exit_code"] == 3
+
+
+def test_cli_list_is_light(capsys):
+    from benchmarks.scenarios.__main__ import main
+
+    assert main(["list"]) == 0
+    out = capsys.readouterr().out
+    for name in S.names():
+        assert name in out
+    with pytest.raises(SystemExit):
+        main(["check", "--only", "no-such-scenario"])

@@ -123,6 +123,7 @@ def test_2d_mesh_fit_and_predict(breast_cancer):
     np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.9s 2d-mesh regressor twin; 2d-mesh fit+predict classifier stays tier-1
 def test_2d_mesh_regressor(diabetes):
     X, y = diabetes
     mesh = make_mesh(data=2)
@@ -171,6 +172,7 @@ def test_oob_data_sharded_deterministic(breast_cancer):
     assert a.oob_score_ == b.oob_score_
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~3.2s data-sharded OOB regressor twin; replica-mesh OOB parity stays tier-1
 def test_oob_regressor_on_data_sharded_mesh(diabetes):
     X, y = diabetes
     ref = BaggingRegressor(n_estimators=32, oob_score=True, seed=3).fit(X, y)

@@ -156,6 +156,7 @@ def test_libsvm_and_csv_chunks_match_full_parse(tmp_path):
 # ---------------------------------------------------------------------
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.9s accuracy band soak; chunked==unchunked exactness stays tier-1 in test_bagging
 def test_stream_classifier_accuracy_close_to_inmemory(cancer):
     X, y = cancer
     clf = BaggingClassifier(
@@ -174,6 +175,7 @@ def test_stream_classifier_accuracy_close_to_inmemory(cancer):
     assert np.isfinite(sclf.fit_report_["loss_mean"])
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.1s accounting soak; FLOPs counters are continuously gated by the serving cost gauges
 def test_stream_sgd_flops_accounting(cancer):
     """SGD streams report analytic FLOPs: per-step matmul model × steps
     actually executed [VERDICT r2 ask#6]. Exact bookkeeping check."""
@@ -243,6 +245,7 @@ def test_stream_regressor():
     assert reg.score(X, y) > 0.7
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.6s convergence-quality soak; steps_per_chunk knob plumbing stays tier-1
 def test_stream_steps_per_chunk_speeds_convergence(cancer):
     X, y = cancer
     few = BaggingClassifier(n_estimators=4, seed=0).fit_stream(
@@ -255,6 +258,7 @@ def test_stream_steps_per_chunk_speeds_convergence(cancer):
     assert many.score(X, y) > 0.9
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.3s SGD-learner stream soak; stream engine contracts stay tier-1
 def test_stream_mlp(cancer):
     X, y = cancer
     sclf = BaggingClassifier(
@@ -341,6 +345,7 @@ def test_stream_tree_deterministic(cancer):
         )
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~5.6s stream-tree fit soak; stream-tree parity contracts stay tier-1
 def test_stream_tree_regressor():
     from spark_bagging_tpu.models import DecisionTreeRegressor
 
@@ -376,6 +381,7 @@ def test_stream_tree_rejects_sgd_knobs(cancer):
         ).fit_stream((X, y), classes=[0, 1], chunk_rows=128, n_epochs=3)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.6s mesh twin; unsharded stream OOB stays tier-1
 def test_stream_oob_on_mesh_matches_unsharded(cancer):
     """SGD streams never fold the shard index into draws, so streamed
     OOB under a mesh replays the exact fit membership."""
@@ -423,6 +429,7 @@ def test_stream_subspaces(cancer):
     assert sclf.score(X, y) > 0.85
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.7s mesh twin; single-device stream parity stays tier-1
 def test_stream_sharded_mesh_matches_unsharded(cancer):
     X, y = cancer
     # chunk_rows divisible by data axis; n_estimators by replica axis
@@ -654,6 +661,7 @@ def test_chunks_from_seeks_equal_suffix():
                 assert na == nb
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.2s mesh twin of the resume contract kept tier-1 single-device
 def test_stream_checkpoint_resume_on_mesh(cancer, tmp_path):
     """Snapshots gather sharded state to host; resume re-shards onto the
     mesh — the sharded resumed fit must equal the sharded straight-through
@@ -701,6 +709,7 @@ def test_stream_oob_classifier(cancer):
     np.testing.assert_allclose(df[voted].sum(axis=1), 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.3s OOB regressor twin; the classifier representative stays tier-1
 def test_stream_oob_regressor():
     X, y = make_regression(600, 6, seed=2)
     mu, s = X.mean(0), X.std(0) + 1e-8
@@ -713,6 +722,7 @@ def test_stream_oob_regressor():
     assert reg.oob_prediction_.shape == (len(y),)
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~3.9s stream-OOB tree soak; stream OOB classifier representative stays tier-1
 def test_stream_oob_tree(cancer):
     from spark_bagging_tpu.models import DecisionTreeClassifier
 

@@ -57,6 +57,7 @@ class TestClassifierTree:
         ).mean()
         assert acc > 0.97  # binned threshold ⇒ not always exactly 0.0
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.5s sklearn-quality soak; split/vmap parity contracts stay tier-1
     def test_iris_accuracy_matches_sklearn_depth3(self):
         Xj, yj, X, y = _iris()
         tree = DecisionTreeClassifier(max_depth=3, n_bins=32,
@@ -161,6 +162,7 @@ class TestRegressorTree:
         pred = np.asarray(tree.predict_scores(params, jnp.asarray(X)))
         assert np.corrcoef(pred, y)[0, 1] > 0.95
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.6s sklearn-quality soak; regressor split correctness stays tier-1
     def test_diabetes_r2_near_sklearn(self):
         Xj, yj, X, y = _diabetes()
         tree = DecisionTreeRegressor(max_depth=4, hist_dtype="float32")
@@ -171,6 +173,7 @@ class TestRegressorTree:
         assert r2 > 0.4
         assert r2 >= sk_r2 - 0.1
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~4s deep-fit numeric-edge soak; cheap empty-leaf NaN guard stays tier-1
     def test_empty_leaf_fallback_is_finite(self):
         # depth 6 on 50 rows guarantees empty leaves
         rng = np.random.default_rng(0)
@@ -184,6 +187,7 @@ class TestRegressorTree:
 
 
 class TestTreeBagging:
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.3s held-out accuracy soak; bagged-vs-vmap parity stays tier-1
     def test_bagged_trees_match_single_tree_heldout_iris(self):
         Xj, yj, X, y = _iris()
         rng = np.random.default_rng(0)
@@ -208,6 +212,7 @@ class TestTreeBagging:
         assert bag_acc >= single_acc - 0.04  # ensemble ≈/≥ single [SURVEY §4]
         assert clf.predict_proba(X[te]).shape == (len(te), 3)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.1s real-data subspace soak; subspace draw correctness stays tier-1 in faster tests
     def test_bagged_trees_with_subspaces_breast_cancer(self):
         Xj, yj, X, y = _breast_cancer()
         clf = BaggingClassifier(
@@ -220,6 +225,7 @@ class TestTreeBagging:
         clf.fit(X, y)
         assert clf.score(X, y) > 0.94
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2s OOB quality soak; OOB computation contracts stay tier-1 in test_bagging
     def test_bagged_regressor_oob(self):
         Xj, yj, X, y = _diabetes()
         reg = BaggingRegressor(
@@ -291,6 +297,7 @@ class TestTreeBagging:
 # ---------------------------------------------------------------------
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.6s statistical-recovery soak; importances API contract stays tier-1
 def test_feature_importances_find_informative_features():
     from spark_bagging_tpu import BaggingClassifier
     from spark_bagging_tpu.models import DecisionTreeClassifier
@@ -362,6 +369,7 @@ class TestPrePruning:
         y = (X[:, 0] > 0).astype(np.int64)
         return X, y
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~2.5s alternate-criterion fit soak; gini path is the tier-1 representative
     def test_entropy_criterion_trains(self):
         X, y = self._data()
         a = BaggingClassifier(
@@ -425,6 +433,7 @@ class TestPrePruning:
         with pytest.raises(ValueError, match="min_info_gain"):
             DecisionTreeClassifier(min_info_gain=-0.1)
 
+    @pytest.mark.slow  # [PR 14 pyramid] ~3.2s stream-integration soak; pruning knobs + stream parity each stay tier-1 separately
     def test_streamed_fit_inherits_pruning(self):
         from spark_bagging_tpu import ArrayChunks, BaggingClassifier
 
@@ -448,6 +457,7 @@ class TestPrePruning:
         assert rf.get_params()["criterion"] == "entropy"
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~2.2s weight-gate variant soak; the default gate contract stays tier-1
 def test_fractional_weights_unaffected_by_default_gate():
     """The instance gate defaults OFF: normalized fractional
     sample_weight (mass << 1 per side) must fit normal trees, and GBTs
@@ -478,6 +488,7 @@ def test_fractional_weights_unaffected_by_default_gate():
     assert np.isfinite(late[0])
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.7s render-vs-predict sweep; debug-string split-count check stays tier-1
 def test_to_debug_string_matches_predictions():
     """Spark toDebugString analog: the printed rules route a probe row
     to the same prediction predict_scores gives, and the planted split
@@ -531,6 +542,7 @@ def test_debug_string_split_count_matches_rendered_tree():
     assert header_count == rendered
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~3.8s all-zero-weight GBT edge soak; zero-weight neutrality stays tier-1 via the fuzz representative
 def test_gbt_all_zero_bootstrap_weights_stay_finite():
     """A replica whose Poisson draw is all zeros (probability e^-λ at
     small max_samples) must not NaN-poison the bagged mean vote
@@ -576,6 +588,7 @@ def test_tree_workset_model_scales_with_features():
     assert g >= (1 + 2) * 100_000 * 50 * 32  # T int8 + bf16 Tf copy
 
 
+@pytest.mark.slow  # [PR 14 pyramid] ~1.7s render sweep twin; single-tree render check stays tier-1
 def test_gbt_debug_string_binary_and_multiclass():
     from spark_bagging_tpu import GBTClassifier
 

@@ -1,4 +1,4 @@
-"""Tier-1 wall-clock budget ratchet [ROADMAP item 5, ISSUE 11].
+"""Tier-1 wall-clock budget ratchet [ROADMAP item 5, ISSUE 11/14].
 
 The tier-1 ceiling (the 870 s ``timeout`` in the verify command) used
 to be rediscovered the hard way: the tree grew until a run hit RC 124.
@@ -8,17 +8,22 @@ order), measures the session's own elapsed wall-clock against the
 allocation, and fails with an actionable message while the run still
 finishes under the hard timeout.
 
-The allocation is deliberately BELOW the ceiling (90%): the ratchet
-must fire before the cliff, not be killed by it. When it trips, the
-fix is the PR-9/PR-11 discipline — move an equivalent amount of
-existing heavyweight tests to ``slow`` (with per-test reason comments)
-or restructure the tier — never raising the allocation to make the
-light turn green.
+Since the ISSUE 14 pyramid restructure the allocation is the tier's
+own budget — tier-1 is a sub-450 s set of contract/parity/gate tests
+plus the scenario-conformance smoke, with heavyweight soaks living in
+``slow`` — not a fraction of the driver timeout. When the ratchet
+trips, the fix is the standing discipline: move an equivalent amount
+of existing heavyweight tests to ``slow`` (with per-test reason
+comments), or turn suite weight into a registered scenario
+(``benchmarks/scenarios``) whose digests carry the coverage for
+pennies — never raising the allocation to make the light turn green.
 
-The ratchet also WRITES what it measured: a per-module wall-clock
-artifact (``telemetry_dir()/tier1_timings.json``, modules sorted
-heaviest first) — test-suite observability for ROADMAP item 5, so the
-tier-restructuring PR starts from data this run already paid for.
+The ratchet also WRITES what it measured: a per-module artifact
+(``telemetry_dir()/tier1_timings.json`` — wall-clock seconds plus the
+ran/skipped/``slow``-deselected split per module, heaviest first) and,
+for full-tier sessions, one longitudinal record in the history store
+(``telemetry/history.py``) so tier wall-clock is a trended series,
+not a rediscovery.
 """
 
 import json
@@ -29,39 +34,115 @@ import pytest
 
 #: the tier-1 verify command's hard timeout (ROADMAP)
 TIER1_CEILING_S = 870.0
-#: the ratchet fires at 90% — early warning, not post-mortem
-TIER1_ALLOCATION_S = 0.9 * TIER1_CEILING_S
+#: the tier's own budget since the ISSUE 14 pyramid restructure:
+#: tier-1 is a sub-450 s set BY CONSTRUCTION, and the ratchet enforces
+#: that construction continuously (the 870 s driver timeout is the
+#: cliff far behind it)
+TIER1_ALLOCATION_S = 450.0
 
 #: a session smaller than this is a targeted run (-k, one file), not
 #: the tier — the ratchet only means something over the full suite
 FULL_TIER_MIN_ITEMS = 600
 
+TIMINGS_SCHEMA_VERSION = 2
+
+#: per-module artifact entry fields (the round-trip test pins these)
+MODULE_FIELDS = ("seconds", "tests", "skipped", "slow_deselected")
+
+
+def build_timings_artifact(
+    module_times: dict[str, float],
+    module_stats: dict[str, dict],
+    collected: int,
+    elapsed: float,
+) -> dict:
+    """The artifact dict, pure (testable without a pytest session):
+    per-module wall-clock seconds joined with the ran/skipped/slow
+    split, heaviest module first."""
+    modules = {}
+    for mod in sorted(module_times, key=lambda m: -module_times[m]):
+        stats = module_stats.get(mod, {})
+        modules[mod] = {
+            "seconds": round(module_times[mod], 3),
+            "tests": int(stats.get("tests", 0)),
+            "skipped": int(stats.get("skipped", 0)),
+            "slow_deselected": int(stats.get("slow_deselected", 0)),
+        }
+    return {
+        "schema": TIMINGS_SCHEMA_VERSION,
+        "ts": time.time(),
+        "collected": collected,
+        "full_tier": collected >= FULL_TIER_MIN_ITEMS,
+        "elapsed_s": round(elapsed, 3),
+        "allocation_s": TIER1_ALLOCATION_S,
+        "ceiling_s": TIER1_CEILING_S,
+        "modules": modules,
+    }
+
+
+def validate_timings_artifact(artifact: dict) -> None:
+    """Loud schema check for the artifact (used by the round-trip test
+    and by any future consumer that wants to fail fast on drift)."""
+    for key, typ in (("schema", int), ("ts", float),
+                     ("collected", int), ("full_tier", bool),
+                     ("elapsed_s", float), ("allocation_s", float),
+                     ("ceiling_s", float), ("modules", dict)):
+        if not isinstance(artifact.get(key), typ):
+            raise ValueError(
+                f"timings artifact field {key!r} missing or not "
+                f"{typ.__name__}: {artifact.get(key)!r}"
+            )
+    if artifact["schema"] != TIMINGS_SCHEMA_VERSION:
+        raise ValueError(
+            f"timings artifact schema {artifact['schema']} != "
+            f"{TIMINGS_SCHEMA_VERSION}"
+        )
+    for mod, entry in artifact["modules"].items():
+        if not isinstance(entry, dict):
+            raise ValueError(f"module entry {mod!r} is not a dict")
+        for f in MODULE_FIELDS:
+            if not isinstance(entry.get(f), (int, float)):
+                raise ValueError(
+                    f"module entry {mod!r} field {f!r} missing or "
+                    f"non-numeric: {entry.get(f)!r}"
+                )
+
 
 def _write_timings_artifact(config, collected: int,
                             elapsed: float) -> None:
-    """Write the per-module wall-clock JSON artifact. Best-effort:
-    measurement must never fail the tier it measures."""
+    """Write the artifact + (full sessions only) the longitudinal
+    history record. Best-effort: measurement must never fail the tier
+    it measures."""
     modules = getattr(config, "_sbt_module_times", None)
     if not modules:
         return
+    stats = getattr(config, "_sbt_module_stats", None) or {}
     try:
         from spark_bagging_tpu.telemetry import telemetry_dir
 
+        artifact = build_timings_artifact(modules, stats, collected,
+                                          elapsed)
         path = os.path.join(telemetry_dir(), "tier1_timings.json")
-        ordered = dict(sorted(modules.items(),
-                              key=lambda kv: -kv[1]))
         with open(path, "w") as f:
-            json.dump({
-                "ts": time.time(),
-                "collected": collected,
-                "full_tier": collected >= FULL_TIER_MIN_ITEMS,
-                "elapsed_s": round(elapsed, 3),
-                "allocation_s": TIER1_ALLOCATION_S,
-                "ceiling_s": TIER1_CEILING_S,
-                "modules": {m: round(s, 3)
-                            for m, s in ordered.items()},
-            }, f, indent=2)
+            json.dump(artifact, f, indent=2)
             f.write("\n")
+        if artifact["full_tier"]:
+            # one trended record per FULL tier session (partial -k
+            # runs would pollute the elapsed_s series with noise)
+            from spark_bagging_tpu import telemetry
+            from spark_bagging_tpu.telemetry import history
+
+            telemetry.enable()
+            history.append_record(
+                "tier", "tier1",
+                numbers={"elapsed_s": artifact["elapsed_s"],
+                         "collected": float(collected)},
+                detail={
+                    "allocation_s": TIER1_ALLOCATION_S,
+                    "modules": {m: e["seconds"]
+                                for m, e in artifact["modules"].items()},
+                },
+            )
     except Exception as e:  # noqa: BLE001 — observability only
         import warnings
 
@@ -69,7 +150,61 @@ def _write_timings_artifact(config, collected: int,
                       RuntimeWarning)
 
 
-def test_tier1_wall_clock_within_allocation(request):
+def test_timings_artifact_roundtrip(tmp_path):
+    """Satellite [ISSUE 14]: the artifact schema round-trips — what
+    the builder writes, a JSON reader gets back with the per-module
+    seconds AND the ran/skipped/slow split intact, and the validator
+    accepts it (and rejects the schema-less v1 shape)."""
+    times = {"tests/test_a.py": 12.345678, "tests/test_b.py": 0.5}
+    stats = {
+        "tests/test_a.py": {"tests": 10, "skipped": 2,
+                            "slow_deselected": 3},
+        # test_b deliberately absent: modules with no stats entry
+        # must degrade to zeros, not KeyError
+    }
+    artifact = build_timings_artifact(times, stats, collected=700,
+                                      elapsed=123.456789)
+    path = tmp_path / "tier1_timings.json"
+    path.write_text(json.dumps(artifact, indent=2))
+    back = json.loads(path.read_text())
+    validate_timings_artifact(back)
+    assert back["schema"] == TIMINGS_SCHEMA_VERSION
+    assert back["full_tier"] is True
+    assert back["elapsed_s"] == 123.457
+    # heaviest first, split preserved
+    assert list(back["modules"]) == ["tests/test_a.py",
+                                     "tests/test_b.py"]
+    a = back["modules"]["tests/test_a.py"]
+    assert a == {"seconds": 12.346, "tests": 10, "skipped": 2,
+                 "slow_deselected": 3}
+    b = back["modules"]["tests/test_b.py"]
+    assert b == {"seconds": 0.5, "tests": 0, "skipped": 0,
+                 "slow_deselected": 0}
+    # the v1 shape (flat seconds map) is rejected, loudly
+    v1 = dict(back)
+    v1["modules"] = {"tests/test_a.py": 12.3}
+    with pytest.raises(ValueError, match="not a dict"):
+        validate_timings_artifact(v1)
+    v1 = dict(back)
+    v1.pop("schema")
+    with pytest.raises(ValueError, match="schema"):
+        validate_timings_artifact(v1)
+
+
+def test_conftest_accumulators_are_live(request):
+    """The conftest hooks really feed the artifact's inputs: this very
+    session has module times for this module, and the stats dict
+    carries the counter keys the artifact schema expects."""
+    times = getattr(request.config, "_sbt_module_times", None)
+    stats = getattr(request.config, "_sbt_module_stats", None)
+    assert times is not None and stats is not None
+    mod = "tests/test_zz_tier_budget.py"
+    assert mod in times  # the round-trip test above already reported
+    assert set(stats[mod]) == {"tests", "skipped", "slow_deselected"}
+    assert stats[mod]["tests"] >= 1
+
+
+def test_zz_tier1_wall_clock_within_allocation(request):
     collected = request.session.testscollected
     elapsed = time.monotonic() - request.config._sbt_tier_t0
     # write the artifact BEFORE any skip/assert: partial sessions
@@ -84,6 +219,7 @@ def test_tier1_wall_clock_within_allocation(request):
         f"tier-1 measured {elapsed:.0f}s against its "
         f"{TIER1_ALLOCATION_S:.0f}s allocation ({TIER1_CEILING_S:.0f}s "
         "hard ceiling): move heavyweight tests to -m slow (with "
-        "per-test reason comments) or split the tier — do NOT raise "
+        "per-test reason comments) or turn the weight into a "
+        "registered benchmarks/scenarios scenario — do NOT raise "
         "the allocation"
     )
