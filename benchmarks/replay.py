@@ -1250,6 +1250,360 @@ def replay_fleet(
     }
 
 
+def replay_online(
+    workload,
+    *,
+    model,
+    label_fn,
+    seed: int = 0,
+    drift_at: float = 0.3,
+    drift_shift: float = 4.0,
+    drift_scale: float = 1.0,
+    psi_threshold: float = 0.5,
+    alert_fast_frac: float = 0.03,
+    alert_slow_frac: float = 0.1,
+    disagreement_every: int = 8,
+    refit_epochs: int = 2,
+    refit_batch_rows: int = 256,
+    min_refit_rows: int = 16,
+    refit_margin: float = 0.05,
+    buffer_rows: int = 128,
+    max_delay_ms: float = 2.0,
+    idle_flush_ms: float = 1.0,
+    max_batch_rows: int = 256,
+    max_queue: int = 1024,
+    min_bucket_rows: int = 8,
+    bucket_max_rows: int = 256,
+    warmup: bool = True,
+    timeout_s: float = 120.0,
+) -> dict:
+    """The closed-loop drill: drift-triggered online refit end to end
+    (``--drift --online``). One FRESH serving stack per run — registry
+    at version 1, sticky quality monitor, burn-rate alert rule — plus
+    the continuous-learning plane: every arrival's payload and its
+    ``label_fn`` label feed an ``online.LabeledBuffer``, a stepped
+    ``online.OnlineTrainer`` subscribes to the alert engine's trigger
+    bus, and on the ONE scripted drift alert it drains the recent
+    window, refits with streaming Poisson weights, validates against
+    the incumbent, and publishes through ``registry.swap()`` +
+    ``registry.save()`` (the fleet-convergence manifest). The
+    post-swap sticky monitor scores the still-drifted traffic against
+    the candidate's window-fitted reference, so the drift gauge
+    RECOVERS and the alert resolves — exactly one alert → one refit →
+    one fleet-converged swap → recovery, all a pure function of
+    ``(workload, seed)`` and asserted across ``replay_median``
+    repeats. A fresh stack per run is what keeps repeats
+    byte-identical: unlike the ``--swaps`` drill (same fitted
+    estimator re-installed), a refit CHANGES the model, so the run
+    must not inherit its predecessor's candidate.
+
+    The default onset (0.3, earlier than ``--drift``'s 0.5), the
+    snappier alert windows, and the 128-row post-change collection
+    window are load-bearing: the gate's recovery check refuses to
+    pass on an un-warmed monitor (no evidence is not recovery), so
+    the post-onset traffic must cover alerting, collecting a PURE
+    post-change window (the candidate's reference profile must land
+    in the new regime, not between regimes), and a tail long enough
+    for the re-attached monitor to warm."""
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.online import LabeledBuffer, OnlineTrainer
+    from spark_bagging_tpu.serving import ModelRegistry
+    from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
+    from spark_bagging_tpu.telemetry import alerts
+    from spark_bagging_tpu.telemetry import workload as workload_mod
+    from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+
+    telemetry.enable()
+    requests = workload.requests
+    if not requests:
+        raise ValueError("empty workload")
+    dur = workload.duration_s or 1.0
+    if getattr(model, "quality_profile_", None) is None:
+        raise ValueError(
+            "--online needs a model with a fit-time quality_profile_ "
+            "(refit with this build)"
+        )
+
+    registry = ModelRegistry(
+        min_bucket_rows=min_bucket_rows, max_batch_rows=bucket_max_rows,
+    )
+    registry.register("replay", model, warmup=warmup, version=1)
+    # sticky monitoring: the trainer's swap re-attaches a FRESH monitor
+    # to the candidate (new model => new reference => fresh sketches) —
+    # the recovery half of the drill rides on exactly that
+    monitor = registry.enable_quality(
+        "replay", refresh_every=1,
+        disagreement_every=disagreement_every,
+    )
+    # snappier burn-rate windows than the pure --drift drill (0.05 /
+    # 0.2): the closed loop spends its post-alert traffic TWICE —
+    # collecting the post-change window and then warming the recovery
+    # monitor — so the trigger must come early; the slow-window
+    # re-fire-suppression proof stays with --drift
+    alert_engine = alerts.AlertEngine([alerts.AlertRule(
+        "replay-feature-drift", "sbt_quality_psi_max",
+        labels=monitor.labels,
+        threshold=psi_threshold, kind="value", op=">",
+        fast_window_s=dur * alert_fast_frac,
+        slow_window_s=dur * alert_slow_frac,
+        cooldown_s=dur * 10,
+    )])
+
+    payload = _payloads(workload, registry.executor("replay").n_features,
+                        seed, drift_shift=drift_shift,
+                        drift_scale=drift_scale)
+    drift_t = dur * drift_at
+    drifted = {i for i, r in enumerate(requests) if r.t >= drift_t}
+
+    buffer = LabeledBuffer(capacity_rows=buffer_rows,
+                           labels={"model": "replay"})
+    wrec = workload_mod.WorkloadRecorder()
+    wrec.start()
+    publish_dir = os.path.join(telemetry.telemetry_dir(),
+                               "online_publish")
+    trainer = OnlineTrainer(
+        registry, "replay", buffer,
+        workload_recorder=wrec,
+        epochs=refit_epochs, batch_rows=refit_batch_rows,
+        min_refit_rows=min_refit_rows,
+        # post-change collection sized to the window: the alert is the
+        # change-point, so the refit waits for buffer_rows FRESH rows
+        # and drains exactly the post-onset regime (a window mixing
+        # pre-drift rows would plant the candidate's reference profile
+        # between the regimes and the drift gauge would never recover)
+        collect_rows=buffer_rows,
+        margin=refit_margin,
+        seed=seed, publish_dir=publish_dir,
+        trigger_rules=("replay-feature-drift",),
+    )
+    # the at-alert evidence snapshot must see the INCUMBENT monitor's
+    # sketches, so it subscribes BEFORE the trainer whose swap replaces
+    # them (listeners run in subscription order)
+    alert_snapshot: dict = {}
+
+    def _snap(event: dict) -> None:
+        if event.get("kind") != "alert_fired" or alert_snapshot:
+            return
+        live = registry.executor("replay")
+        mon = getattr(live, "quality", None)
+        if mon is not None:
+            alert_snapshot["scores"] = mon.drift()
+
+    alert_engine.subscribe(_snap)
+    alert_engine.subscribe(trainer.on_alert)
+
+    flight = FlightRecorder(cooldown_s=dur * 10)
+    flight.arm()
+
+    reg_counters = telemetry.registry()
+
+    def counter(name: str) -> float:
+        return reg_counters.counter(name).value
+
+    c0 = {
+        name: counter(name)
+        for name in (
+            "sbt_serving_compiles_total",
+            "sbt_serving_batches_total",
+        )
+    }
+    batcher = MicroBatcher(
+        lambda: registry.executor("replay"),
+        max_delay_ms=max_delay_ms,
+        idle_flush_ms=idle_flush_ms,
+        max_batch_rows=max_batch_rows,
+        max_queue=max_queue,
+        threaded=False,
+    )
+
+    n = len(requests)
+    futs: dict[int, object] = {}
+    overloads = 0
+    swap_compiles = 0.0
+    t_wall0 = time.perf_counter()
+    try:
+        windows = plan_windows(
+            requests,
+            max_delay_s=max_delay_ms / 1e3,
+            idle_flush_s=idle_flush_ms / 1e3,
+        )
+        for window in windows:
+            for idx in window:
+                block = payload(idx, requests[idx].rows, idx in drifted)
+                try:
+                    futs[idx] = batcher.submit(block)
+                except Overloaded:
+                    overloads += 1
+                    continue
+                # the labeled feed: every ADMITTED arrival's payload +
+                # its (application-delayed in production, immediate in
+                # the drill) label — what a refit drains
+                buffer.add(block, label_fn(block))
+            batcher.run_pending()
+            vt = requests[window[0]].t
+            alert_engine.evaluate(now=vt)
+            if trainer.pending:
+                # the refit's swap warm pre-compiles the candidate on
+                # the live bucket profile — deliberate publish cost,
+                # measured and excluded from post_warmup_compiles
+                # exactly like the --swaps drill's
+                before = counter("sbt_serving_compiles_total")
+                trainer.run_pending(now=vt)
+                swap_compiles += (
+                    counter("sbt_serving_compiles_total") - before
+                )
+        wall = time.perf_counter() - t_wall0
+        # the recovery evidence: the POST-SWAP monitor's view of the
+        # tail traffic, read before the finally detaches monitoring
+        live_mon = getattr(registry.executor("replay"), "quality", None)
+        final_drift = live_mon.drift() if live_mon is not None else None
+    finally:
+        batcher.close()
+        flight.disarm()
+        wrec.stop()
+        try:
+            registry.disable_quality("replay")
+        except KeyError:
+            pass
+
+    collected = _collect_futures(futs, timeout_s)
+    latencies = collected["latencies"]
+
+    (rule_state,) = alert_engine.state()["rules"]
+    scores = alert_snapshot.get("scores")
+    drift_report = {
+        "onset_s": round(drift_t, 6),
+        "shift": drift_shift,
+        "scale": drift_scale,
+        "psi_threshold": psi_threshold,
+        # the at-alert evidence (the incumbent monitor's sketches the
+        # moment the rule tripped) — the byte-identity handle; the
+        # post-swap recovery lives in the online section
+        "scores": scores,
+        "digest": (hashlib.sha256(
+            json.dumps(scores, sort_keys=True).encode()
+        ).hexdigest() if scores is not None else None),
+        "alerts_fired": rule_state["fired"],
+        "alerts_resolved": rule_state["resolved"],
+        "alerts_suppressed": rule_state["suppressed"],
+        "alert_active": rule_state["active"],
+        "flight_dumps": len(flight.dumps),
+    }
+
+    summary = trainer.summary()
+    # the deterministic transcript: wall seconds stripped (everything
+    # else — virtual times, counts, scores — is a pure function of
+    # (workload, seed))
+    transcript = [
+        {k: v for k, v in rec.items() if k != "seconds"}
+        for rec in summary["transcript"]
+    ]
+    published = [r for r in transcript if r.get("action") == "published"]
+    online_report = {
+        "refits": {
+            "triggered": summary["triggered"],
+            "published": summary["published"],
+            "rejected": summary["rejected"],
+            "skipped": summary["skipped"],
+            "errors": summary["errors"],
+        },
+        "updates": sum(r.get("updates", 0) for r in transcript),
+        "examples": sum(r.get("drained_rows", 0) for r in transcript),
+        "oob_estimate": (published[-1].get("oob_estimate")
+                         if published else None),
+        "version_initial": 1,
+        "version_final": registry.version("replay"),
+        "manifest_version": (published[-1].get("manifest_version")
+                             if published else None),
+        "transcript": transcript,
+        "transcript_digest": hashlib.sha256(
+            json.dumps(transcript, sort_keys=True).encode()
+        ).hexdigest(),
+        "recovery": {
+            "alert_resolved": rule_state["resolved"] >= 1,
+            "alert_active": rule_state["active"],
+            # what the alert engine actually pages on: the exported
+            # gauge, which reads 0.0 below the monitor's evidence
+            # floor (raw small-sample PSI over a handful of post-swap
+            # rows is sampling noise, not drift — the same floor that
+            # keeps fresh monitors from paging keeps this honest)
+            "final_psi_gauge": (
+                (final_drift["psi_max"] if final_drift["warmed"]
+                 else 0.0)
+                if final_drift is not None else None
+            ),
+            "final_psi_raw": (final_drift["psi_max"]
+                              if final_drift is not None else None),
+            "final_warmed": (final_drift["warmed"]
+                             if final_drift is not None else None),
+            "monitor_rows": (final_drift["rows"]
+                             if final_drift is not None else 0),
+        },
+        "refit_seconds_total": round(sum(
+            rec.get("seconds", 0.0)
+            for rec in summary["transcript"]
+        ), 6),
+    }
+
+    import jax
+
+    return {
+        "metric": "workload_replay",
+        "schema": REPLAY_SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "mode": "virtual",
+        "speed": 1.0,
+        "seed": seed,
+        "workload": workload.summary(),
+        "workload_digest": workload_digest(workload),
+        "batcher": {
+            "max_delay_ms": max_delay_ms,
+            "idle_flush_ms": idle_flush_ms,
+            "max_batch_rows": max_batch_rows,
+            "max_queue": max_queue,
+        },
+        "burst": 0,
+        "swaps": summary["published"],
+        "n_requests": n,
+        "served": collected["served"],
+        "errors": collected["errors"],
+        "overloads": overloads,
+        "deadline_ms": None,
+        "deadline_sheds": 0,
+        "batches": int(counter("sbt_serving_batches_total")
+                       - c0["sbt_serving_batches_total"]),
+        "post_warmup_compiles": int(
+            counter("sbt_serving_compiles_total")
+            - c0["sbt_serving_compiles_total"]
+            - swap_compiles
+        ),
+        "swap_compiles": int(swap_compiles),
+        "wall_seconds": round(wall, 6),
+        "rps": (round(collected["served"] / wall, 2)
+                if wall > 0 else None),
+        "latency_ms": {
+            "p50": _percentile(latencies, 0.50),
+            "p95": _percentile(latencies, 0.95),
+            "p99": _percentile(latencies, 0.99),
+            "max": latencies[-1] if latencies else None,
+        },
+        "forward_ms_total": round(collected["forward_ms"], 3),
+        "padding": {"rows": None},
+        "model": {
+            "name": "replay",
+            "version": registry.version("replay"),
+        },
+        "composition_digest": collected["comp_h"].hexdigest(),
+        "output_digest": collected["out_h"].hexdigest(),
+        "drift": drift_report,
+        "chaos": None,
+        # per-request attribution is the single-target replay's story;
+        # the closed-loop drill digests its own online section instead
+        "attribution": None,
+        "online": online_report,
+    }
+
+
 def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     """Median-of-``repeats`` replay (the BENCH protocol: thread noise
     on small hosts swings single runs; the median is the stable
@@ -1266,9 +1620,20 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     if repeats < 1:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
     fleet = kwargs.get("fleet", 0)
-    drive = replay_fleet if fleet else replay
-    if not fleet:
-        kwargs.pop("fleet", None)  # replay() takes no fleet kwarg
+    online = kwargs.get("online", False)
+    if fleet and online:
+        raise ValueError("--fleet and --online are separate drills")
+    if online:
+        drive = replay_online
+        # replay_online takes neither meta-kwarg (a generic caller may
+        # forward fleet=0 alongside online=True)
+        kwargs.pop("online", None)
+        kwargs.pop("fleet", None)
+    else:
+        drive = replay_fleet if fleet else replay
+        kwargs.pop("online", None)
+        if not fleet:
+            kwargs.pop("fleet", None)  # replay() takes no fleet kwarg
     runs = [drive(workload, **kwargs) for _ in range(repeats)]
     head = runs[0]
     if head["mode"] == "virtual":
@@ -1322,6 +1687,22 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             f"attribution.{key} changed "
                             f"({head['attribution'][key]!r} -> "
                             f"{r['attribution'][key]!r})"
+                        )
+            if head.get("online") is not None:
+                # the closed loop's deterministic surface: the refit
+                # transcript (drained rows, updates, scores, versions
+                # — wall seconds stripped), the refit counters, and
+                # the post-swap recovery evidence
+                for key in ("transcript_digest", "refits", "updates",
+                            "examples", "oob_estimate",
+                            "version_final", "manifest_version",
+                            "recovery"):
+                    if r["online"][key] != head["online"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"online.{key} changed "
+                            f"({head['online'][key]!r} -> "
+                            f"{r['online'][key]!r})"
                         )
             if head.get("fleet") is not None:
                 # the fleet plane's whole deterministic surface:
@@ -1431,6 +1812,57 @@ def _fleet_checks(report: dict) -> list[dict]:
     return checks
 
 
+def _online_checks(report: dict) -> list[dict]:
+    """The closed-loop gate (``--drift --online --check``): the one
+    scripted drift incident produced exactly one accepted refit, the
+    candidate passed validation and PUBLISHED (one fleet-converged
+    swap: the live version moved 1 → 2 and the written manifest
+    carries the same version every peer ``load()`` converges on), and
+    the drift gauge RECOVERED — the alert resolved and the post-swap
+    monitor's PSI sits back under the rule threshold."""
+    o = report.get("online") or {}
+    refits = o.get("refits") or {}
+    recovery = o.get("recovery") or {}
+    threshold = (report.get("drift") or {}).get("psi_threshold")
+
+    def eq(name: str, actual, want) -> dict:
+        return {"name": name, "actual": actual, "limit": want,
+                "op": "==", "ok": actual == want}
+
+    final_psi = recovery.get("final_psi_gauge")
+    return [
+        eq("online_refits_triggered", refits.get("triggered"), 1),
+        eq("online_refits_published", refits.get("published"), 1),
+        eq("online_refits_rejected", refits.get("rejected"), 0),
+        eq("online_refit_errors", refits.get("errors"), 0),
+        eq("online_version_final", o.get("version_final"), 2),
+        eq("online_manifest_converged", o.get("manifest_version"),
+           o.get("version_final")),
+        eq("online_alert_resolved",
+           recovery.get("alert_resolved"), True),
+        # recovery must be EVIDENCED, not vacuous: below the monitor's
+        # evidence floor the gauge is 0.0 by design (no evidence is
+        # not drift — the same floor that keeps fresh monitors from
+        # paging), but a gate certifying "the loop recovered" on an
+        # un-warmed monitor would pass even when the raw tail PSI
+        # still breaches. The drill's onset/window defaults exist to
+        # guarantee a warmed tail; this check keeps them honest.
+        eq("online_recovery_warmed", recovery.get("final_warmed"),
+           True),
+        {
+            # the gauge the rule reads (raw == gauge once warmed):
+            # the alert engine evaluating the tail traffic must see
+            # it back under the threshold
+            "name": "online_drift_recovered",
+            "actual": final_psi,
+            "limit": threshold, "op": "<",
+            "ok": bool(final_psi is not None
+                       and threshold is not None
+                       and final_psi < threshold),
+        },
+    ]
+
+
 def check_report(report: dict, *, spec=None, baseline: dict | None = None,
                  rps_tolerance: float | None = None,
                  latency_tolerance: float | None = None):
@@ -1447,6 +1879,9 @@ def check_report(report: dict, *, spec=None, baseline: dict | None = None,
     if report.get("drift") is not None:
         checks += _drift_checks(report)
         kind = "absolute+drift"
+    if report.get("online") is not None:
+        checks += _online_checks(report)
+        kind += "+online"
     if report.get("fleet") is not None:
         checks += _fleet_checks(report)
         kind += "+fleet"
@@ -1461,9 +1896,14 @@ def check_report(report: dict, *, spec=None, baseline: dict | None = None,
     return slo.SLOResult(checks, kind=kind)
 
 
-def _default_model(width: int, n_estimators: int, seed: int = 0):
+def _default_problem(width: int, n_estimators: int, seed: int = 0):
     """Self-contained CLI target: a seeded synthetic logistic bag (the
-    serving bench's shape, scaled down)."""
+    serving bench's shape, scaled down) PLUS the seeded linear concept
+    it was trained on, returned as ``(model, label_fn)``. The label
+    rule is what makes the closed-loop drill supervised: drifted
+    payloads are covariate shift over a FIXED concept, so the online
+    refit's labels come from the same ``y = 1[X @ w > 0]`` the batch
+    fit learned."""
     import numpy as np
 
     from spark_bagging_tpu import BaggingClassifier, LogisticRegression
@@ -1472,10 +1912,21 @@ def _default_model(width: int, n_estimators: int, seed: int = 0):
     X = rng.normal(size=(512, width)).astype(np.float32)
     w = rng.normal(size=width)
     y = (X @ w > 0).astype(np.int32)
-    return BaggingClassifier(
+    model = BaggingClassifier(
         base_learner=LogisticRegression(max_iter=5),
         n_estimators=n_estimators, seed=seed,
     ).fit(X, y)
+
+    def label_fn(Xq):
+        return (np.asarray(Xq, np.float64) @ w > 0).astype(np.int32)
+
+    return model, label_fn
+
+
+def _default_model(width: int, n_estimators: int, seed: int = 0):
+    """The model half of :func:`_default_problem` (the non-online
+    drives need no labels)."""
+    return _default_problem(width, n_estimators, seed)[0]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1488,10 +1939,15 @@ def main(argv: list[str] | None = None) -> int:
                           "telemetry.workload (default: synthetic)")
     src.add_argument("--synthetic", default="poisson",
                      choices=("poisson", "bursty", "diurnal"))
-    src.add_argument("--rate", type=float, default=200.0,
-                     help="synthetic arrival rate (rps)")
-    src.add_argument("--duration", type=float, default=1.0,
-                     help="synthetic duration (virtual seconds)")
+    src.add_argument("--rate", type=float, default=None,
+                     help="synthetic arrival rate (rps; default 200, "
+                          "300 with --online)")
+    src.add_argument("--duration", type=float, default=None,
+                     help="synthetic duration (virtual seconds; "
+                          "default 1.0, 1.4 with --online — the "
+                          "closed loop needs enough drifted traffic "
+                          "for a pure refit window AND a warmed "
+                          "recovery tail)")
     src.add_argument("--rows", type=int, default=1,
                      help="rows per synthetic request")
     src.add_argument("--width", type=int, default=16,
@@ -1543,9 +1999,26 @@ def main(argv: list[str] | None = None) -> int:
                           "quality monitor + burn-rate alert rule and "
                           "gates on exactly one alert_fired (the "
                           "model-quality plane's scripted incident)")
-    drv.add_argument("--drift-at", type=float, default=0.5,
+    drv.add_argument("--online", action="store_true",
+                     help="close the loop on the drift scenario: a "
+                          "stepped online trainer subscribes to the "
+                          "drift alert, refits the incumbent with "
+                          "streaming Poisson-weight updates over the "
+                          "recent labeled window, validates against "
+                          "the incumbent, and publishes through the "
+                          "registry swap + serve_config manifest — "
+                          "gated on exactly one alert -> one refit -> "
+                          "one fleet-converged swap -> drift-gauge "
+                          "recovery (requires --drift; synthetic "
+                          "model only, its seeded label rule "
+                          "supervises the refit)")
+    drv.add_argument("--drift-at", type=float, default=None,
                      help="drift onset as a fraction of the workload "
-                          "duration")
+                          "duration (default 0.5; 0.3 with --online "
+                          "— the closed loop spends the post-onset "
+                          "traffic on alerting, post-change "
+                          "collection, AND warming the recovery "
+                          "monitor)")
     drv.add_argument("--drift-shift", type=float, default=4.0,
                      help="additive covariate shift of the drifted "
                           "segment's payload pool")
@@ -1682,8 +2155,15 @@ def main(argv: list[str] | None = None) -> int:
         )
     else:
         wl = workload_mod.synthetic_workload(
-            args.synthetic, rate_rps=args.rate,
-            duration_s=args.duration, seed=args.seed, rows=args.rows,
+            args.synthetic,
+            # the closed-loop drill's stock shape must leave enough
+            # drifted traffic for a pure refit window and a warmed
+            # recovery tail (see replay_online's docstring)
+            rate_rps=(args.rate if args.rate is not None
+                      else (300.0 if args.online else 200.0)),
+            duration_s=(args.duration if args.duration is not None
+                        else (1.4 if args.online else 1.0)),
+            seed=args.seed, rows=args.rows,
             width=args.width,
             bucket_bounds=(args.min_bucket_rows, args.bucket_max_rows),
         )
@@ -1691,7 +2171,46 @@ def main(argv: list[str] | None = None) -> int:
     if args.save_workload:
         wl.save(args.save_workload)
 
-    if args.fleet:
+    if args.online:
+        if not args.drift:
+            ap.error("--online is the drift scenario's closing move: "
+                     "combine with --drift")
+        if args.model_checkpoint:
+            ap.error("--online refits against the synthetic model's "
+                     "seeded label rule; a checkpoint carries no "
+                     "labels (drive a real labeled stream through "
+                     "online.OnlineTrainer directly)")
+        for flag, val in (("--fleet", args.fleet),
+                          ("--swaps", args.swaps),
+                          ("--burst", args.burst),
+                          ("--throttle-ms", args.throttle_ms),
+                          ("--deadline-ms", args.deadline_ms),
+                          ("--devices", args.devices)):
+            if val:
+                ap.error(f"{flag} does not combine with --online (the "
+                         "drill scripts its own swap)")
+        if args.mode != "virtual":
+            ap.error("--online is a virtual-clock drill (the alert/"
+                     "refit/swap interleaving IS the experiment)")
+        model, label_fn = _default_problem(width, args.n_estimators,
+                                           seed=args.seed)
+        report = replay_median(
+            wl, repeats=args.repeats,
+            online=True, model=model, label_fn=label_fn,
+            drift_at=(args.drift_at if args.drift_at is not None
+                      else 0.3),
+            drift_shift=args.drift_shift,
+            drift_scale=args.drift_scale,
+            psi_threshold=args.psi_threshold,
+            max_delay_ms=args.max_delay_ms,
+            idle_flush_ms=args.idle_flush_ms,
+            max_batch_rows=args.max_batch_rows,
+            max_queue=args.max_queue,
+            min_bucket_rows=args.min_bucket_rows,
+            bucket_max_rows=args.bucket_max_rows,
+            seed=args.seed,
+        )
+    elif args.fleet:
         # the fleet drill builds its own N per-peer registries; the
         # single-target scenario flags have no meaning over it
         if args.fleet < 2:
@@ -1770,7 +2289,9 @@ def main(argv: list[str] | None = None) -> int:
             burst=args.burst, burst_at=args.burst_at, swaps=args.swaps,
             chaos=chaos_spec, retries=retries,
             retry_backoff_ms=args.retry_backoff_ms,
-            drift=args.drift, drift_at=args.drift_at,
+            drift=args.drift,
+            drift_at=(args.drift_at if args.drift_at is not None
+                      else 0.5),
             drift_shift=args.drift_shift, drift_scale=args.drift_scale,
             psi_threshold=args.psi_threshold,
             deadline_ms=args.deadline_ms,
@@ -1838,11 +2359,28 @@ def main(argv: list[str] | None = None) -> int:
     if report.get("drift") is not None:
         d = report["drift"]
         summary["drift"] = {
-            "psi_max": round(d["scores"]["psi_max"], 4),
+            "psi_max": (round(d["scores"]["psi_max"], 4)
+                        if d.get("scores") else None),
             "alerts_fired": d["alerts_fired"],
             "alerts_suppressed": d["alerts_suppressed"],
             "flight_dumps": d["flight_dumps"],
-            "digest": d["digest"][:16],
+            "digest": (d["digest"][:16] if d.get("digest") else None),
+        }
+    if report.get("online") is not None:
+        o = report["online"]
+        summary["online"] = {
+            "refits": o["refits"],
+            "version": [o["version_initial"], o["version_final"]],
+            "manifest_version": o["manifest_version"],
+            "oob_estimate": (round(o["oob_estimate"], 4)
+                             if o["oob_estimate"] is not None else None),
+            "recovery_psi_gauge": (
+                round(o["recovery"]["final_psi_gauge"], 4)
+                if o["recovery"]["final_psi_gauge"] is not None
+                else None
+            ),
+            "alert_resolved": o["recovery"]["alert_resolved"],
+            "transcript_digest": o["transcript_digest"][:16],
         }
     print(json.dumps(summary))
     print(f"report: {out}")

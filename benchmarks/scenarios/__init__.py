@@ -56,7 +56,9 @@ class Scenario:
     ``slo`` is an ``SLOSpec`` dict (validated at registration,
     round-tripped through the committed baseline file); ``devices``
     serves through a replica-sharded ``(1, N)`` mesh; ``fleet`` drives
-    the N-virtual-peer drill; ``parity_with`` additionally asserts
+    the N-virtual-peer drill; ``online`` drives the closed-loop
+    drift-refit drill (``replay_online`` — the drive kwargs are its
+    drift/refit knobs); ``parity_with`` additionally asserts
     this scenario's output digest equals ANOTHER scenario's committed
     output digest (the sharded-parity contract).
     """
@@ -71,6 +73,7 @@ class Scenario:
     repeats: int = 2
     devices: int | None = None
     fleet: int = 0
+    online: bool = False
     parity_with: str | None = None
     tags: tuple[str, ...] = ()
 
@@ -253,6 +256,26 @@ register(Scenario(
     fleet=3,
     slo={"max_post_warmup_compiles": 0},
     tags=("fleet", "chaos"),
+))
+
+register(Scenario(
+    name="online-refit",
+    description="the closed loop [ROADMAP item 1]: covariate-shifted "
+                "traffic trips the drift rule, the online trainer "
+                "drains the recent labeled window, refits with "
+                "streaming Poisson weights, validates against the "
+                "incumbent, and publishes a version-2 swap + manifest "
+                "— exactly one alert -> one refit -> one "
+                "fleet-converged swap -> warmed drift-gauge recovery, "
+                "the whole refit transcript digest-identical",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 1.4,
+              "seed": 108, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"drift_at": 0.3, "buffer_rows": 128},
+    model={"n_estimators": 4, "seed": 0},
+    serving=dict(_SERVING),
+    online=True,
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("quality", "online"),
 ))
 
 register(Scenario(

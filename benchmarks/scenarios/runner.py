@@ -86,22 +86,28 @@ def env_comparable(env: dict[str, Any],
             and env.get("device_count") == recorded.get("device_count"))
 
 
-# one fitted model per (width, n_estimators, seed): scenarios sharing a
-# shape share the fit (and the parity pair MUST — same model is part of
-# its contract), which keeps a full `check` interactive
+# one fitted problem per (width, n_estimators, seed): scenarios sharing
+# a shape share the fit (and the parity pair MUST — same model is part
+# of its contract), which keeps a full `check` interactive. Cached as
+# (model, label_fn) pairs so the online drill's label rule rides the
+# same entry.
 _MODEL_CACHE: dict[tuple, Any] = {}
 
 
-def _model_for(sc: Scenario):
+def _problem_for(sc: Scenario):
     width = int(sc.workload.get("width", 16))
     n_est = int(sc.model.get("n_estimators", 8))
     seed = int(sc.model.get("seed", 0))
     key = (width, n_est, seed)
     if key not in _MODEL_CACHE:
-        from benchmarks.replay import _default_model
+        from benchmarks.replay import _default_problem
 
-        _MODEL_CACHE[key] = _default_model(width, n_est, seed=seed)
+        _MODEL_CACHE[key] = _default_problem(width, n_est, seed=seed)
     return _MODEL_CACHE[key]
+
+
+def _model_for(sc: Scenario):
+    return _problem_for(sc)[0]
 
 
 def run_scenario(sc: Scenario,
@@ -126,6 +132,14 @@ def run_scenario(sc: Scenario,
     reps = repeats if repeats is not None else sc.repeats
     min_rows = int(sc.serving.get("min_bucket_rows", 8))
     max_rows = int(sc.serving.get("max_batch_rows", 32))
+    if sc.online:
+        _, label_fn = _problem_for(sc)
+        return R.replay_median(
+            wl, repeats=reps, online=True, model=model,
+            label_fn=label_fn, seed=seed,
+            min_bucket_rows=min_rows, bucket_max_rows=max_rows,
+            **drive,
+        )
     if sc.fleet:
         return R.replay_median(
             wl, repeats=reps, fleet=sc.fleet, model=model,
@@ -176,6 +190,9 @@ def digests_of(report: dict[str, Any]) -> dict[str, str]:
         d["fleet_merged"] = fleet["merged_digest"]
         d["fleet_skew"] = fleet["skew_digest"]
         d["fleet_incidents"] = fleet["incident_digest"]
+    online = report.get("online")
+    if online is not None:
+        d["online_transcript"] = online["transcript_digest"]
     return d
 
 
@@ -410,7 +427,8 @@ def run_conformance(
         row["counts"] = counts_of(report)
         # scenario-class sections ride the report verbatim so the
         # conformance JSON is a one-stop incident view
-        for section in ("attribution", "chaos", "fleet", "drift"):
+        for section in ("attribution", "chaos", "fleet", "drift",
+                        "online"):
             if report.get(section) is not None:
                 row[section] = report[section]
         rows.append(row)

@@ -87,6 +87,10 @@ SITES: dict[str, str] = {
     "checkpoint.write": "inside the checkpoint writer, before its atomic swap",
     "aot.save": "inside save_executables, before its atomic install",
     "fleet.scrape": "per peer scrape attempt by the fleet aggregator (peer-loss drills)",
+    "trainer.drain": "per refit's labeled-traffic drain by the online trainer",
+    "trainer.refit": "per bounded update epoch run by the online trainer",
+    "trainer.validate": "per candidate validation pass by the online trainer",
+    "trainer.publish": "per candidate publish (swap + checkpoint) by the online trainer",
 }
 
 ACTIONS = ("error", "transient", "poison", "shard", "kill", "delay")

@@ -1,0 +1,25 @@
+"""The continuous-learning plane — ROADMAP item 1's closing move.
+
+Everything upstream of this package already exists and this package
+only CONNECTS it: :mod:`ops/bootstrap` draws bootstraps as weights,
+the quality plane (PR 8) detects drift and fires alerts, the workload
+recorder (PR 6) captures the serving request stream, and the registry
+(PR 9) hot-swaps versions fleet-wide through ``serve_config.json``.
+
+- :class:`~spark_bagging_tpu.online.updater.OnlineUpdater` — streaming
+  Poisson-weight ``partial_fit`` steps over the stacked replica axis
+  (online bagging, arXiv 1312.5021 / 2010.01051), with a streaming
+  out-of-bag quality tap.
+- :class:`~spark_bagging_tpu.online.trainer.OnlineTrainer` — the
+  drift-triggered trainer daemon: subscribes to the alert engine,
+  drains recent labeled traffic, runs bounded update epochs, validates
+  the candidate against the incumbent, and publishes through
+  ``ModelRegistry.swap()``/``save()`` so the serving fleet converges.
+- :class:`~spark_bagging_tpu.online.trainer.LabeledBuffer` — the
+  bounded labeled-traffic reservoir refits drain from.
+"""
+
+from spark_bagging_tpu.online.trainer import LabeledBuffer, OnlineTrainer
+from spark_bagging_tpu.online.updater import OnlineUpdater
+
+__all__ = ["LabeledBuffer", "OnlineTrainer", "OnlineUpdater"]

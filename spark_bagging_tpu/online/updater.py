@@ -1,0 +1,365 @@
+"""Streaming Poisson-weight updates over the stacked replica axis.
+
+The batch engine's whole design — bootstraps are per-row WEIGHT
+vectors, replicas are one ``vmap``'d axis of a single stacked pytree —
+is exactly the form that admits online updates: per-example Poisson(1)
+weights make online bagging consistent with the batch bootstrap
+(*Efficient Online Bootstrapping for Large Scale Learning*, arXiv
+1312.5021), and the same trick scales to SGD-trained learners (*Neural
+Bootstrapper*, arXiv 2010.01051). An :class:`OnlineUpdater` wraps a
+FITTED estimator and applies ``partial_fit(X, y)`` steps:
+
+- **Weights.** Step ``t`` derives its base key from
+  :func:`~spark_bagging_tpu.ops.bootstrap.online_step_key` (the
+  ``_ONLINE_STREAM`` tag folded with the step index) and feeds it to
+  the SAME :func:`~spark_bagging_tpu.ops.bootstrap
+  .bootstrap_weights_one` schedule the batch fit uses — replica ``r``
+  of step ``t`` draws Poisson(1) row weights that depend only on
+  ``(seed, t, r)``. Byte-deterministic given (seed, example order);
+  independent of every batch-fit stream by construction.
+- **Update.** One jitted step maps the base learner's own ``fit``
+  over the stacked replica axis (``vmap``, or ``lax.map`` in the
+  estimator's resolved chunk), warm-starting each replica from its
+  current params — the same stacked-params layout the serving
+  executor consumes, so a candidate publishes with zero re-stacking.
+  Restricted to the SGD-able family (``learner.streamable``): solvers
+  that refine arbitrary initial params (GLM/logistic/SVM IRLS-Newton,
+  MLP Adam). Structure-search learners (trees) cannot move their
+  params incrementally and are rejected loudly.
+- **Streaming OOB tap.** Before the update touches params, each
+  example is scored by exactly the replicas whose Poisson draw was 0
+  (the step's out-of-bag replicas, via the shared
+  :func:`~spark_bagging_tpu.ensemble.oob_replica_contrib` contract),
+  feeding a running OOB-quality estimate — prequential
+  test-then-train, so the estimate is honest: no example is scored by
+  a replica that has already trained on it in this step.
+
+**Batch parity.** ``warm=False`` resets the params and makes the
+first ``partial_fit`` replay the batch engine's OWN compiled program
+(:func:`bagging._jitted_fit` with the estimator's recorded fit
+config + original fit key): a full-dataset pass under all-ones
+weights (an estimator fitted ``bootstrap=False``) reproduces the
+batch fit bit for bit on the served forward — the anchor test that
+pins the online path to the batch semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.ensemble import map_replicas, oob_replica_contrib
+from spark_bagging_tpu.models.base import BaseLearner
+from spark_bagging_tpu.ops.bootstrap import (
+    bootstrap_weights_one,
+    fit_key,
+    online_step_key,
+)
+
+WEIGHT_MODES = ("poisson", "ones")
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_update(learner: BaseLearner, n_outputs: int,
+                   n_classes: int | None, identity_subspace: bool,
+                   weight_mode: str, chunk_size: int | None):
+    """One compiled online-update step (cached per config, like the
+    batch engine's ``_jitted_fit``): ``fn(params, subspaces, ids, X,
+    y, key) -> (new_params, oob_agg, oob_votes, losses)``. The OOB tap
+    runs on the INCUMBENT params (test-then-train), its mask
+    regenerated from the same draw the update consumes — XLA CSEs the
+    two ``bootstrap_weights_one`` calls into one."""
+
+    def fn(params, subspaces, ids, X, y, key):
+        n = X.shape[0]
+
+        def one(args):
+            p, idx, rid = args
+            Xs = X if identity_subspace else X[:, idx]
+            if weight_mode == "poisson":
+                w = bootstrap_weights_one(key, rid, n, ratio=1.0,
+                                          replacement=True)
+                contrib, votes = oob_replica_contrib(
+                    learner, p, idx, rid, X, key,
+                    sample_ratio=1.0, bootstrap=True,
+                    n_classes=n_classes,
+                    identity_subspace=identity_subspace,
+                )
+            else:  # "ones": no resampling, hence no OOB rows
+                w = jnp.ones((n,), jnp.float32)
+                shape = (n, n_classes) if n_classes is not None else (n,)
+                contrib = jnp.zeros(shape, jnp.float32)
+                votes = jnp.zeros((n,), jnp.float32)
+            p2, aux = learner.fit(
+                p, Xs, y, w, fit_key(key, rid), axis_name=None
+            )
+            return p2, contrib, votes, aux["loss"]
+
+        new_params, contribs, votes, losses = map_replicas(
+            one, (params, subspaces, ids), chunk_size
+        )
+        return new_params, contribs.sum(axis=0), votes.sum(axis=0), losses
+
+    return jax.jit(fn)
+
+
+class OnlineUpdater:
+    """Streaming Poisson-weight updates for one fitted bagging
+    estimator (see module docstring).
+
+    Single-writer by contract — ``partial_fit`` calls must be
+    serialized by the caller (the trainer constructs one updater per
+    refit and drives it on one thread); the updater itself is a
+    deterministic state machine, not a concurrency primitive, and
+    deliberately carries no lock. ``seed=None`` derives the key stream from
+    the estimator's own fit seed; pass a distinct seed for independent
+    update streams over the same model.
+    """
+
+    def __init__(self, estimator: Any, *, seed: int | None = None,
+                 weight_mode: str = "poisson", warm: bool = True,
+                 labels: dict[str, Any] | None = None) -> None:
+        estimator._check_fitted()
+        if weight_mode not in WEIGHT_MODES:
+            raise ValueError(
+                f"weight_mode must be one of {WEIGHT_MODES}, got "
+                f"{weight_mode!r}"
+            )
+        if getattr(estimator, "mesh", None) is not None:
+            raise ValueError(
+                "OnlineUpdater is single-device (like the serving "
+                "executors): save() the mesh-fitted ensemble and "
+                "load() it without a mesh first"
+            )
+        learner = estimator.base_learner_
+        if not learner.streamable:
+            raise ValueError(
+                f"{type(learner).__name__} is not an SGD-able learner "
+                "(streamable=False): its params cannot be refined "
+                "incrementally, so online updates do not apply — refit "
+                "offline and hot-swap instead"
+            )
+        if learner.uses_aux:
+            raise ValueError(
+                "aux-column learners (censoring etc.) are not supported "
+                "online: the serving stream carries no aux channel"
+            )
+        # stream fits also set _fit_key; their designated guard
+        # attribute is _fit_subspace_cfg=None (bagging.py fit_stream)
+        if getattr(estimator, "_fit_subspace_cfg", None) is None:
+            raise ValueError(
+                "estimator carries no in-memory fit state "
+                "(stream-fitted, or not fitted by this build): "
+                "stream-fitted ensembles update from their own "
+                "fit_stream path, not OnlineUpdater"
+            )
+        self._est = estimator
+        self._learner = learner
+        self._task = estimator.task
+        self._n_outputs = (int(estimator.n_classes_)
+                           if self._task == "classification" else 1)
+        self._n_classes = (self._n_outputs
+                           if self._task == "classification" else None)
+        self._identity = bool(getattr(estimator, "_identity_subspace",
+                                      True))
+        self._chunk = estimator._eff_chunk()
+        self.weight_mode = weight_mode
+        self.labels = dict(labels) if labels else None
+        self.seed = int(estimator.seed if seed is None else seed)
+        self._base_key = jax.random.key(self.seed)
+        self._subspaces = estimator.subspaces_
+        self._ids = jnp.arange(int(estimator.n_estimators_),
+                               dtype=jnp.int32)
+        self._params = estimator.ensemble_ if warm else None
+        self._step = 0
+        self._rows = 0
+        # running OOB accumulators (float64 host side — deterministic):
+        # classification counts correct/voted; regression folds SSE
+        # plus the voted rows' label moments for a running R²
+        self._oob_correct = 0.0
+        self._oob_voted = 0.0
+        self._oob_sse = 0.0
+        self._oob_y_n = 0.0
+        self._oob_y_sum = 0.0
+        self._oob_y_sumsq = 0.0
+        self._last_losses: np.ndarray | None = None
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def steps(self) -> int:
+        return self._step
+
+    @property
+    def rows_seen(self) -> int:
+        return self._rows
+
+    @property
+    def oob_rows(self) -> int:
+        return int(self._oob_voted)
+
+    def oob_estimate(self) -> float | None:
+        """Running streaming OOB quality — accuracy (classification) or
+        R² (regression) over every row at least one OOB replica voted
+        on; ``None`` until the first vote (no evidence is not a
+        score)."""
+        if self._oob_voted <= 0:
+            return None
+        if self._task == "classification":
+            return float(self._oob_correct / self._oob_voted)
+        sst = self._oob_y_sumsq - self._oob_y_sum ** 2 / self._oob_y_n
+        if sst <= 0:
+            return 0.0
+        return float(1.0 - self._oob_sse / sst)
+
+    # -- the step -------------------------------------------------------
+
+    def _encode_y(self, y) -> np.ndarray:
+        y = np.asarray(y).ravel()
+        if self._task != "classification":
+            return np.asarray(y, np.float32)
+        classes = np.asarray(self._est.classes_)
+        enc = np.searchsorted(classes, y)
+        enc_clip = np.clip(enc, 0, len(classes) - 1)
+        if not np.array_equal(classes[enc_clip], y):
+            unknown = sorted(set(np.unique(y)) - set(classes.tolist()))
+            raise ValueError(
+                f"y carries labels outside the fitted class set: "
+                f"{unknown[:5]} (online updates cannot grow the label "
+                "space; register the new space under a new model)"
+            )
+        return np.asarray(enc_clip, np.int32)
+
+    def partial_fit(self, X, y) -> dict[str, Any]:
+        """Apply one streaming update step over ``(X, y)``; returns a
+        compact step report (step index, rows, OOB rows/estimate).
+
+        First call with ``warm=False`` replays the estimator's batch
+        fit program instead (the parity anchor — see module doc);
+        every later call is a warm Poisson-weighted step.
+        """
+        X = np.asarray(X, np.float32)
+        if X.ndim != 2 or X.shape[1] != self._est.n_features_in_:
+            raise ValueError(
+                f"X must be (n, {self._est.n_features_in_}), got "
+                f"{X.shape}"
+            )
+        y_enc = self._encode_y(y)
+        if y_enc.shape[0] != X.shape[0]:
+            raise ValueError("X and y row counts differ")
+        n = int(X.shape[0])
+        oob_new = 0
+        if self._params is None:
+            # cold start: the batch engine's own compiled program with
+            # the estimator's recorded config + original fit key — the
+            # one path guaranteed bit-identical to the batch fit
+            from spark_bagging_tpu.bagging import _jitted_fit
+
+            ratio, replacement = self._est._fit_sampling
+            n_sub, boot_feat = self._est._fit_subspace_cfg
+            fit_fn = _jitted_fit(
+                self._learner, self._n_outputs, ratio, replacement,
+                n_sub, boot_feat, self._chunk,
+                use_pooled=self._est._fit_pooled_gate,
+            )
+            params, subspaces, aux = fit_fn(
+                jnp.asarray(X), jnp.asarray(y_enc),
+                self._est._fit_key, self._ids,
+            )
+            self._params = params
+            self._subspaces = subspaces
+            self._last_losses = np.asarray(aux["loss"])
+        else:
+            step_fn = _jitted_update(
+                self._learner, self._n_outputs, self._n_classes,
+                self._identity, self.weight_mode, self._chunk,
+            )
+            key = online_step_key(self._base_key, self._step)
+            params, oob_agg, oob_votes, losses = step_fn(
+                self._params, self._subspaces, self._ids,
+                jnp.asarray(X), jnp.asarray(y_enc), key,
+            )
+            self._params = params
+            self._last_losses = np.asarray(losses)
+            oob_new = self._fold_oob(
+                np.asarray(oob_agg), np.asarray(oob_votes), y_enc
+            )
+        self._step += 1
+        self._rows += n
+        if telemetry.enabled():
+            telemetry.inc("sbt_online_updates_total", labels=self.labels)
+            telemetry.inc("sbt_online_examples_total", float(n),
+                          labels=self.labels)
+            if oob_new:
+                telemetry.inc("sbt_online_oob_rows_total",
+                              float(oob_new), labels=self.labels)
+            est = self.oob_estimate()
+            if est is not None:
+                telemetry.set_gauge("sbt_online_oob_estimate", est,
+                                    labels=self.labels)
+        return {
+            "step": self._step - 1,
+            "rows": n,
+            "oob_rows": oob_new,
+            "oob_estimate": self.oob_estimate(),
+        }
+
+    def _fold_oob(self, agg: np.ndarray, votes: np.ndarray,
+                  y_enc: np.ndarray) -> int:
+        """Fold one step's OOB votes into the running estimate; returns
+        the number of newly voted rows."""
+        has = votes > 0
+        voted = int(has.sum())
+        if voted == 0:
+            return 0
+        if self._task == "classification":
+            pred = agg.argmax(axis=1)
+            self._oob_correct += float((pred[has] == y_enc[has]).sum())
+            self._oob_voted += voted
+            return voted
+        yv = np.asarray(y_enc, np.float64)[has]
+        pred = agg[has] / votes[has]
+        self._oob_sse += float(((pred - yv) ** 2).sum())
+        self._oob_voted += voted
+        self._oob_y_n += voted
+        self._oob_y_sum += float(yv.sum())
+        self._oob_y_sumsq += float((yv ** 2).sum())
+        return voted
+
+    # -- materialization ------------------------------------------------
+
+    def to_estimator(self) -> Any:
+        """A fitted estimator carrying the updated stacked params — the
+        publishable candidate. A shallow copy of the wrapped estimator
+        with ``ensemble_`` rebound (the program-cache fingerprint
+        token invalidates by identity, so the candidate compiles under
+        its own key); batch-fit OOB artifacts are dropped — they
+        describe the OLD params — and the RUNNING streaming estimate
+        rides in ``online_oob_estimate_`` (all steps; a caller that
+        re-presented rows across epochs should overwrite it with its
+        own honest first-pass value, as the trainer does)."""
+        import copy as _copy
+
+        if self._params is None:
+            raise RuntimeError(
+                "no params yet: warm=False updaters need one "
+                "partial_fit before to_estimator()"
+            )
+        cand = _copy.copy(self._est)
+        cand.ensemble_ = self._params
+        cand.subspaces_ = self._subspaces
+        for stale in ("oob_score_", "oob_decision_function_",
+                      "oob_prediction_", "_fp_token"):
+            if hasattr(cand, stale):
+                try:
+                    delattr(cand, stale)
+                except AttributeError:
+                    pass
+        cand.online_steps_ = self._step
+        cand.online_oob_estimate_ = self.oob_estimate()
+        return cand

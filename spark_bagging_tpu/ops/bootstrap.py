@@ -81,6 +81,15 @@ def poisson_counts(
 _FEATURE_STREAM = 0x5EED
 _FIT_STREAM = 0xF17
 _ROW_STREAM = 0xB0B5
+# The online-update stream (online/updater.py): every streaming
+# partial_fit step derives its own base key from this tag + the step
+# index, and THAT key feeds the same _ROW_STREAM/_FIT_STREAM schedule
+# the batch fit uses — so online Poisson draws are independent of every
+# batch-fit stream by construction, and step t's draws depend only on
+# (seed, t, replica_id). Like the other tags, the value sits far above
+# any plausible replica id so fold_in(key, tag) cannot collide with a
+# replica's fold_in(key, replica_id).
+_ONLINE_STREAM = 0xA511
 # Bumped whenever the key schedule above changes (schema 2 = the
 # _ROW_STREAM retag): stream checkpoints fingerprint this so a
 # snapshot trained under an older schedule is rejected at resume
@@ -126,6 +135,23 @@ def replica_init_fit_keys(
     consumer together — never re-derive it inline.
     """
     return split_init_fit(fit_key(key, replica_id))
+
+
+def online_step_key(key: jax.Array, step: jax.Array | int) -> jax.Array:
+    """THE base key of online-update step ``step`` (online/updater.py).
+
+    Single source of truth for the streaming key schedule: the returned
+    key is consumed exactly like a batch fit's base key — row draws
+    fold ``_ROW_STREAM`` + replica_id (:func:`bootstrap_weights_one`),
+    fit keys fold ``_FIT_STREAM`` + replica_id (:func:`fit_key`) — so
+    one step's per-replica Poisson(1) draws and solver keys are
+    mutually independent AND independent across steps, and the whole
+    update stream is a pure function of ``(seed, step, replica_id)``
+    regardless of batch sizes or how many replicas run per device.
+    """
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _ONLINE_STREAM), step
+    )
 
 
 def bootstrap_weights_one(

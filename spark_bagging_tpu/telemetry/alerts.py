@@ -187,6 +187,10 @@ class AlertEngine:
     def __init__(self, rules=(), *, registry=None) -> None:
         self._lock = make_lock("telemetry.alerts")
         self._states: dict[str, _RuleState] = {}
+        # direct listeners (subscribe()): the trigger-bus seam — the
+        # online trainer hangs its refit trigger here. Delivered after
+        # the engine lock is released, alongside the sink emits
+        self._listeners: list[Any] = []
         # where rule series are sampled from: anything with a
         # ``peek(name, labels)`` returning an object carrying
         # ``kind``/``value`` (the process Registry, or the fleet
@@ -210,6 +214,51 @@ class AlertEngine:
     def rules(self) -> tuple[AlertRule, ...]:
         with self._lock:
             return tuple(st.rule for st in self._states.values())
+
+    # -- the trigger bus -----------------------------------------------
+
+    def subscribe(self, listener) -> None:
+        """Register a callable receiving every ``alert_fired`` /
+        ``alert_resolved`` event this engine emits — the trigger-bus
+        seam the online trainer (``online/trainer.py``) subscribes
+        its refit trigger to. Listeners run AFTER the engine lock is
+        released (a listener may re-enter the engine — ``state()``
+        from a trainer transcript is fine) and exceptions are
+        isolated: one broken consumer must not unhook alerting for
+        everyone else (warned, not raised)."""
+        if not callable(listener):
+            raise TypeError(f"listener must be callable, got "
+                            f"{type(listener).__name__}")
+        with self._lock:
+            if listener not in self._listeners:
+                self._listeners.append(listener)
+
+    def unsubscribe(self, listener) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def _notify(self, events: list[dict]) -> None:
+        if not events:
+            return
+        with self._lock:
+            listeners = list(self._listeners)
+        for ev in events:
+            for fn in listeners:
+                try:
+                    fn(ev)
+                except Exception as e:  # noqa: BLE001 — isolation, see
+                    # subscribe(): alert delivery must survive one
+                    # broken consumer
+                    import warnings
+
+                    warnings.warn(
+                        f"alert listener {fn!r} raised {e!r}; event "
+                        f"{ev.get('kind')}/{ev.get('rule')} dropped "
+                        "for that listener only",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
 
     # -- sampling ------------------------------------------------------
 
@@ -371,6 +420,7 @@ class AlertEngine:
         # lock the next evaluate() needs
         for ev in events:
             _emit(ev)
+        self._notify(events)
         return events
 
     # -- introspection -------------------------------------------------

@@ -53,15 +53,17 @@ DUMP_SCHEMA_VERSION = 1
 # serving shards (each per-kind cooldown'd to one dump per incident)
 TRIGGER_KINDS = ("serving_batch_error", "swap_rejected", "alert_fired",
                  "serving_crash_loop", "swap_failed",
-                 "serving_shard_failed")
+                 "serving_shard_failed", "refit_rejected")
 # event kind that dumps only as a burst
 BURST_KIND = "serving_overloaded"
 
 # event kinds the fleet incident timeline collects from each peer's
-# ring: every dump trigger, the overload bursts, and the swap commits
-# (not incidents themselves, but the events incidents correlate WITH —
-# "did that flight dump land right after peer 2's rolling swap?")
-TIMELINE_KINDS = TRIGGER_KINDS + (BURST_KIND, "model_swapped")
+# ring: every dump trigger, the overload bursts, and the swap/refit
+# commits (not incidents themselves, but the events incidents
+# correlate WITH — "did that flight dump land right after peer 2's
+# rolling swap?")
+TIMELINE_KINDS = TRIGGER_KINDS + (BURST_KIND, "model_swapped",
+                                  "refit_published")
 
 
 # sbt-lint: shared-state

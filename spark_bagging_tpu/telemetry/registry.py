@@ -152,6 +152,17 @@ SERIES_HELP: dict[str, str] = {
     "sbt_scenario_failures_total": "Scenario conformance failures by class (labels scenario + kind=digest/slo/baseline-missing)",
     "sbt_scenario_digest_match": "Latest scenario digest verdict vs its committed baseline (gauge, label scenario; 1 match / 0 mismatch)",
     "sbt_scenario_wall_seconds": "Wall-clock of the latest run of one scenario, repeats included (gauge, label scenario)",
+    "sbt_online_updates_total": "Streaming partial_fit steps applied by online updaters (label model when attached)",
+    "sbt_online_examples_total": "Rows consumed by streaming online updates",
+    "sbt_online_oob_rows_total": "Rows scored by the streaming out-of-bag quality tap (Poisson draw 0 replicas)",
+    "sbt_online_oob_estimate": "Running streaming OOB quality estimate: accuracy or R2 over OOB-voted rows (gauge)",
+    "sbt_online_refits_triggered_total": "Drift-alert refit triggers accepted by the online trainer (label model)",
+    "sbt_online_refits_published_total": "Refit candidates that passed validation and were published (swap + checkpoint; label model)",
+    "sbt_online_refits_rejected_total": "Refit candidates rejected by validation: scored worse than the incumbent (never published; label model)",
+    "sbt_online_refits_skipped_total": "Refit triggers skipped for lack of buffered labeled rows (below min_refit_rows; label model)",
+    "sbt_online_refit_errors_total": "Refits that died mid-flight and were absorbed by the trainer's supervision (label model)",
+    "sbt_online_refit_seconds": "Wall-clock of one drain->refit->validate->publish cycle (histogram, label model)",
+    "sbt_online_buffer_rows": "Labeled rows currently held by one online refit buffer (gauge; label model when attached)",
     "sbt_history_appends_total": "Records appended to the longitudinal history store (telemetry_dir()/history/history.jsonl)",
     "sbt_history_records": "Records seen by the latest history trend scan (gauge)",
     "sbt_history_groups": "Distinct (kind, key) groups in the latest history trend scan (gauge)",

@@ -388,6 +388,34 @@ class WorkloadRecorder:
         return Workload(entries, source="capture",
                         created_ts=self.t_started)
 
+    def drain(self, max_requests: int | None = None) -> list[WorkloadRequest]:
+        """Consume the captured window: return up to ``max_requests``
+        of the MOST RECENT recorded arrivals and remove everything
+        returned from the ring (recording continues; the running
+        aggregates keep covering the whole seen stream). This is the
+        online trainer's hand-off seam — each drift-triggered refit
+        drains the traffic window that tripped the alert, and the next
+        refit starts from an empty window instead of re-consuming the
+        same incident. Returned entries are arrival records (schedule
+        + shapes), the refit transcript's bookkeeping; payloads and
+        labels ride the trainer's :class:`~spark_bagging_tpu.online
+        .trainer.LabeledBuffer`, which the serving edge feeds."""
+        import itertools
+
+        with self._lock:
+            entries = list(self._entries)
+            if max_requests is not None and max_requests >= 0:
+                entries = entries[-max_requests:] if max_requests else []
+            if entries:
+                # islice, never per-index deque access: this lock sits
+                # on the live submit path, and rebuilding the kept
+                # prefix by indexing would be O(keep²) inside it
+                keep = len(self._entries) - len(entries)
+                kept = list(itertools.islice(self._entries, keep))
+                self._entries.clear()
+                self._entries.extend(kept)
+        return entries
+
     def summary(self) -> dict[str, Any]:
         """Digest for ``/debug/workload``: the captured stream so far,
         plus recorder state. Built from running aggregates — O(1)

@@ -31,6 +31,10 @@ FAST_EXAMPLES = [
     pytest.param("07_survival_aft.py", marks=pytest.mark.slow),
     pytest.param("08_out_of_core.py", marks=pytest.mark.slow),
     pytest.param("09_serving.py", marks=pytest.mark.slow),
+    # 10_online_refit drives 400 batched requests through the whole
+    # closed loop (~15s of subprocess serving); the loop's contract
+    # coverage lives tier-1 in test_online + the online-refit scenario
+    pytest.param("10_online_refit.py", marks=pytest.mark.slow),
 ]
 
 

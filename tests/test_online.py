@@ -1,0 +1,498 @@
+"""The continuous-learning plane [ISSUE 15]: streaming Poisson-weight
+updates (batch-fit parity bit for bit, streaming OOB vs batch
+``oob_score_``, key-stream determinism), the labeled-traffic buffer,
+the drift-triggered trainer's state machine (publish / reject+flight /
+skip / supervised fault absorption) over real registry swaps, the
+alert-engine trigger bus and workload drain seams, the lock-order
+detector over the trainer→registry→recorder edges, and the in-process
+closed-loop gate (one alert → one refit → one swap → recovery).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    BaggingRegressor,
+    LogisticRegression,
+    faults,
+    telemetry,
+)
+from spark_bagging_tpu.online import (
+    LabeledBuffer,
+    OnlineTrainer,
+    OnlineUpdater,
+)
+from spark_bagging_tpu.serving import EnsembleExecutor, ModelRegistry
+from spark_bagging_tpu.telemetry import alerts
+from spark_bagging_tpu.telemetry import workload as workload_mod
+from spark_bagging_tpu.telemetry.recorder import FlightRecorder
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_clock():
+    """Wall-clock anchor for the budget test (module import happens at
+    collection, long before the first test runs)."""
+    return time.perf_counter()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.recorder.disarm()
+    telemetry.reset()
+    telemetry.enable()
+
+
+def _problem(n=256, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w > 0).astype(np.int32)
+    return X, y, w
+
+
+def _fit(X, y, *, n_estimators=4, seed=3, **kw):
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=n_estimators, seed=seed, **kw,
+    ).fit(X, y)
+
+
+# -- the updater --------------------------------------------------------
+
+class TestOnlineUpdater:
+    def test_partial_fit_matches_batch_fit_bitwise(self):
+        """Satellite [ISSUE 15]: a partial_fit pass over the full
+        dataset under all-ones weights (an estimator fitted
+        bootstrap=False) must reproduce the batch fit BIT FOR BIT on
+        the served forward — the anchor pinning the online path to
+        the batch semantics."""
+        X, y, _ = _problem()
+        est = _fit(X, y, bootstrap=False)
+        upd = OnlineUpdater(est, warm=False)
+        upd.partial_fit(X, y)
+        for a, b in zip(jax.tree.leaves(est.ensemble_),
+                        jax.tree.leaves(upd._params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        cand = upd.to_estimator()
+        ex_a = EnsembleExecutor(est, min_bucket_rows=8,
+                                max_batch_rows=32)
+        ex_b = EnsembleExecutor(cand, min_bucket_rows=8,
+                                max_batch_rows=32)
+        out_a = ex_a.forward(X[:19])
+        out_b = ex_b.forward(X[:19])
+        assert out_a.tobytes() == out_b.tobytes()
+
+    def test_streaming_oob_tracks_batch_oob(self):
+        """Satellite [ISSUE 15]: the prequential streaming OOB
+        estimate over a seeded workload agrees with the batch
+        ``oob_score_`` within the declared tolerance (0.1 — the
+        streaming estimate is test-then-train while params move, so
+        exact equality is not the contract)."""
+        X, y, _ = _problem(n=512)
+        est = _fit(X, y, n_estimators=16, seed=0, oob_score=True)
+        upd = OnlineUpdater(est, seed=7)
+        for lo in range(0, 512, 128):
+            upd.partial_fit(X[lo:lo + 128], y[lo:lo + 128])
+        assert upd.oob_rows > 100
+        assert abs(upd.oob_estimate() - est.oob_score_) <= 0.1
+
+    def test_key_stream_determinism(self):
+        """Same (seed, example order) -> byte-identical params and OOB
+        estimate; a different seed draws a different Poisson stream."""
+        X, y, _ = _problem()
+        est = _fit(X, y, oob_score=True)
+
+        def run(seed):
+            upd = OnlineUpdater(est, seed=seed)
+            for lo in range(0, 256, 64):
+                upd.partial_fit(X[lo:lo + 64], y[lo:lo + 64])
+            return upd
+
+        a, b, c = run(7), run(7), run(8)
+        for la, lb in zip(jax.tree.leaves(a._params),
+                          jax.tree.leaves(b._params)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+        assert a.oob_estimate() == b.oob_estimate()
+        assert any(
+            not np.array_equal(np.asarray(la), np.asarray(lc))
+            for la, lc in zip(jax.tree.leaves(a._params),
+                              jax.tree.leaves(c._params))
+        )
+
+    def test_rejects_non_streamable_and_unknown_labels(self):
+        X, y, _ = _problem(n=128)
+        from spark_bagging_tpu import DecisionTreeClassifier
+
+        tree_bag = BaggingClassifier(
+            base_learner=DecisionTreeClassifier(max_depth=2),
+            n_estimators=2, seed=0,
+        ).fit(X, y)
+        with pytest.raises(ValueError, match="not an SGD-able"):
+            OnlineUpdater(tree_bag)
+        est = _fit(X, y)
+        upd = OnlineUpdater(est)
+        with pytest.raises(ValueError, match="outside the fitted"):
+            upd.partial_fit(X[:4], np.array([0, 1, 2, 1]))
+        with pytest.raises(ValueError, match="must be"):
+            upd.partial_fit(X[:4, :5], y[:4])
+
+    def test_regressor_stream_r2(self):
+        """The regression half of the streaming OOB estimate: R² over
+        OOB-voted rows on a stationary stream lands near the batch
+        score."""
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(384, 6)).astype(np.float32)
+        w = rng.normal(size=6)
+        y = (X @ w + 0.1 * rng.normal(size=384)).astype(np.float32)
+        from spark_bagging_tpu import LinearRegression
+
+        est = BaggingRegressor(
+            base_learner=LinearRegression(),
+            n_estimators=8, seed=0, oob_score=True,
+        ).fit(X, y)
+        upd = OnlineUpdater(est, seed=5)
+        for lo in range(0, 384, 128):
+            upd.partial_fit(X[lo:lo + 128], y[lo:lo + 128])
+        assert upd.oob_estimate() == pytest.approx(est.oob_score_,
+                                                   abs=0.1)
+
+
+# -- the buffer ---------------------------------------------------------
+
+class TestLabeledBuffer:
+    def test_capacity_eviction_and_drain(self):
+        buf = LabeledBuffer(capacity_rows=64)
+        for k in range(4):
+            buf.add(np.full((32, 3), k, np.float32),
+                    np.full(32, k, np.int32))
+        # 128 rows added into a 64-row reservoir: the oldest blocks
+        # evicted whole, the RECENT window retained
+        assert buf.rows == 64
+        assert buf.dropped_rows == 64
+        X, y = buf.drain()
+        assert X.shape == (64, 3)
+        assert set(np.unique(y)) == {2, 3}
+        # order preserved within the window
+        assert y[0] == 2 and y[-1] == 3
+        assert buf.drain() is None
+        assert buf.rows == 0
+
+    def test_shape_validation(self):
+        buf = LabeledBuffer()
+        with pytest.raises(ValueError, match="2-D"):
+            buf.add(np.zeros(4, np.float32), np.zeros(4))
+        with pytest.raises(ValueError, match="row counts"):
+            buf.add(np.zeros((4, 2), np.float32), np.zeros(3))
+
+
+# -- the seams ----------------------------------------------------------
+
+class TestSeams:
+    def test_alert_engine_trigger_bus(self):
+        """subscribe() delivers alert events in subscription order,
+        isolates a broken listener, and unsubscribe() detaches."""
+        telemetry.set_gauge("sbt_quality_psi_max", 9.0)
+        eng = alerts.AlertEngine([alerts.AlertRule(
+            "r", "sbt_quality_psi_max", threshold=0.5,
+            fast_window_s=1.0, slow_window_s=1.0, cooldown_s=100.0,
+        )])
+        got: list = []
+
+        def boom(ev):
+            raise RuntimeError("broken consumer")
+
+        eng.subscribe(boom)
+        eng.subscribe(got.append)
+        with pytest.raises(TypeError):
+            eng.subscribe("not callable")
+        eng.evaluate(now=0.0)
+        with pytest.warns(RuntimeWarning, match="alert listener"):
+            events = eng.evaluate(now=2.0)
+        assert [e["kind"] for e in events] == ["alert_fired"]
+        assert [e["kind"] for e in got] == ["alert_fired"]
+        assert got[0]["rule"] == "r"
+        eng.unsubscribe(got.append)
+        telemetry.set_gauge("sbt_quality_psi_max", 0.0)
+        eng.evaluate(now=3.0)  # resolves; detached listener silent
+        assert len(got) == 1
+
+    def test_workload_recorder_drain(self):
+        rec = workload_mod.WorkloadRecorder()
+        rec.start()
+        try:
+            for i in range(6):
+                rec.emit({"kind": "serving_request", "rows": i + 1,
+                          "t_mono": float(i)})
+            first = rec.drain(max_requests=4)
+            assert [r.rows for r in first] == [3, 4, 5, 6]
+            # drained entries are consumed; the earlier ones remain
+            rest = rec.drain()
+            assert [r.rows for r in rest] == [1, 2]
+            assert rec.drain() == []
+            # aggregates still cover the whole seen stream
+            assert rec.summary()["n_seen"] == 6
+        finally:
+            rec.stop()
+
+
+# -- the trainer --------------------------------------------------------
+
+def _serving_stack(X, y, **est_kw):
+    est = _fit(X, y, **est_kw)
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+    reg.register("m", est, warmup=False)
+    return est, reg
+
+
+class TestOnlineTrainer:
+    def test_publishes_on_trigger(self, tmp_path):
+        X, y, _ = _problem()
+        est, reg = _serving_stack(X, y)
+        reg.enable_quality("m", refresh_every=1)
+        buf = LabeledBuffer()
+        buf.add(X[:128], y[:128])
+        trainer = OnlineTrainer(
+            reg, "m", buf, epochs=1, min_refit_rows=32,
+            margin=0.05, seed=0, publish_dir=str(tmp_path / "pub"),
+        )
+        trainer.trigger(reason="manual", now=1.0)
+        (rec,) = trainer.run_pending(now=1.0)
+        assert rec["action"] == "published"
+        assert rec["version"] == 2
+        assert rec["manifest_version"] == 2
+        assert reg.version("m") == 2
+        # sticky quality monitoring re-attached to the candidate (the
+        # recovery seam): fresh sketches, the candidate's own profile
+        mon = reg.executor("m").quality
+        assert mon is not None
+        assert mon.profile is reg.model("m").quality_profile_
+        # published checkpoint converges a peer registry by load()
+        peer = ModelRegistry(min_bucket_rows=8, max_batch_rows=32)
+        peer.load("m", str(tmp_path / "pub"), warm=False)
+        assert peer.version("m") == 2
+        snap = telemetry.registry().counter(
+            "sbt_online_refits_published_total",
+            labels={"model": "m"},
+        ).value
+        assert snap == 1.0
+
+    def test_rejects_worse_candidate_and_flight_records(self):
+        """A candidate scoring under the incumbent is rejected —
+        counted, flight-recorded (refit_rejected is a trigger kind),
+        and NEVER published. The incumbent is pinned unbeatable via
+        the scoring seam so the reject branch is deterministic."""
+
+        class _Unbeatable(OnlineTrainer):
+            @staticmethod
+            def _score(estimator, X, y):
+                return 1.1  # > any achievable OOB estimate
+
+        X, y, _ = _problem()
+        est, reg = _serving_stack(X, y)
+        buf = LabeledBuffer()
+        buf.add(X[:128], y[:128])
+        flight = FlightRecorder(cooldown_s=0.0)
+        flight.arm()
+        try:
+            trainer = _Unbeatable(reg, "m", buf, min_refit_rows=32,
+                                  seed=0)
+            trainer.trigger(now=1.0)
+            (rec,) = trainer.run_pending(now=1.0)
+        finally:
+            flight.disarm()
+        assert rec["action"] == "rejected"
+        assert reg.version("m") == 1
+        assert trainer.rejected == 1 and trainer.published == 0
+        assert len(flight.dumps) == 1
+        assert flight.dump_records[0]["kind"] == "refit_rejected"
+
+    def test_skips_below_min_rows_without_draining(self):
+        """A premature trigger (labels still in flight) must leave the
+        window ACCUMULATING — the rule cooldown means no second
+        trigger comes for this incident, so a drain here would
+        permanently discard its labeled rows."""
+        X, y, _ = _problem(n=256)
+        est, reg = _serving_stack(X, y)
+        buf = LabeledBuffer()
+        buf.add(X[:8], y[:8])
+        trainer = OnlineTrainer(reg, "m", buf, min_refit_rows=32,
+                                margin=0.05, seed=0)
+        trainer.trigger(now=0.0)
+        (rec,) = trainer.run_pending()
+        assert rec["action"] == "skipped"
+        assert rec["buffered_rows"] == 8
+        assert trainer.skipped == 1
+        assert reg.version("m") == 1
+        assert buf.rows == 8  # retained, not discarded
+        # once the labels catch up, the SAME incident's window refits
+        buf.add(X[8:136], y[8:136])
+        trainer.trigger(now=1.0)
+        (rec2,) = trainer.run_pending()
+        assert rec2["action"] == "published"
+        assert rec2["drained_rows"] == 136
+
+    def test_supervision_absorbs_injected_faults(self):
+        """The daemon contract: a refit killed at any hand-off site is
+        absorbed (counted, transcribed) and the NEXT trigger still
+        publishes — a trainer crash never takes the loop down."""
+        X, y, _ = _problem()
+        est, reg = _serving_stack(X, y)
+        buf = LabeledBuffer()
+        buf.add(X[:128], y[:128])
+        trainer = OnlineTrainer(reg, "m", buf, min_refit_rows=32,
+                                margin=0.05, seed=0)
+        plan = faults.FaultPlan(
+            [{"site": "trainer.refit", "action": "error", "at": [1]}]
+        )
+        with faults.armed(plan):
+            trainer.trigger(now=0.0)
+            (rec,) = trainer.run_pending()
+        assert rec["action"] == "error"
+        assert "injected" in rec["error"]
+        assert trainer.errors == 1
+        assert reg.version("m") == 1
+        # drained rows were consumed by the dead refit (the window is
+        # gone — a crashed refit must not replay stale data); refill
+        # and the daemon publishes normally
+        buf.add(X[:128], y[:128])
+        trainer.trigger(now=1.0)
+        (rec2,) = trainer.run_pending()
+        assert rec2["action"] == "published"
+        assert reg.version("m") == 2
+
+    def test_alert_filter_and_threaded_daemon(self):
+        X, y, _ = _problem()
+        est, reg = _serving_stack(X, y)
+        buf = LabeledBuffer()
+        buf.add(X[:128], y[:128])
+        trainer = OnlineTrainer(reg, "m", buf, min_refit_rows=32,
+                                margin=0.05, seed=0,
+                                trigger_rules=("the-rule",))
+        # the trigger bus filter: foreign rules and resolutions pass
+        trainer.on_alert({"kind": "alert_fired", "rule": "other"})
+        trainer.on_alert({"kind": "alert_resolved", "rule": "the-rule"})
+        assert trainer.pending == 0
+        trainer.start()
+        try:
+            trainer.on_alert({"kind": "alert_fired", "rule": "the-rule",
+                              "now": 2.0})
+            deadline = time.time() + 20.0
+            while trainer.published == 0 and time.time() < deadline:
+                if trainer.errors:
+                    break
+                time.sleep(0.02)
+        finally:
+            trainer.stop()
+        assert trainer.published == 1
+        assert reg.version("m") == 2
+
+    def test_lock_order_clean_over_refit(self):
+        """Satellite [ISSUE 15]: the lock-order detector over the
+        trainer→registry→recorder edges — a full publish cycle under
+        instrumented locks (trainer lock, buffer lock, registry lock,
+        recorder lock, telemetry quality lock) must close no cycle."""
+        from spark_bagging_tpu.analysis import locks
+
+        locks.clear()
+        locks.enable(True)
+        try:
+            X, y, _ = _problem()
+            est, reg = _serving_stack(X, y)
+            reg.enable_quality("m", refresh_every=1)
+            flight = FlightRecorder(cooldown_s=0.0)
+            flight.arm()
+            try:
+                buf = LabeledBuffer()
+                buf.add(X[:128], y[:128])
+                trainer = OnlineTrainer(reg, "m", buf,
+                                        min_refit_rows=32,
+                                        margin=0.05, seed=0)
+                trainer.trigger(now=0.0)
+                (rec,) = trainer.run_pending()
+            finally:
+                flight.disarm()
+            assert rec["action"] == "published"
+            assert locks.violations() == [], locks.violations()
+            edges = locks.acquisition_edges()
+            assert ("online.trainer", "online.trainer") not in edges
+        finally:
+            locks.enable(False)
+            locks.clear()
+
+    def test_validation_errors(self):
+        X, y, _ = _problem(n=64)
+        est, reg = _serving_stack(X, y)
+        buf = LabeledBuffer()
+        with pytest.raises(KeyError):
+            OnlineTrainer(reg, "nope", buf)
+        with pytest.raises(ValueError, match="epochs"):
+            OnlineTrainer(reg, "m", buf, epochs=0)
+        with pytest.raises(ValueError, match="margin"):
+            OnlineTrainer(reg, "m", buf, margin=-1.0)
+
+
+# -- the closed-loop gate ----------------------------------------------
+
+class TestClosedLoop:
+    def test_online_drill_gate(self):
+        """The in-process acceptance drill: one alert → one refit →
+        one fleet-converged swap → drift-gauge recovery, repeats
+        byte-identical (replay_median asserts the online transcript
+        digest across them), every gate check green."""
+        from benchmarks import replay as R
+
+        model, label_fn = R._default_problem(8, 4, seed=0)
+        wl = workload_mod.synthetic_workload(
+            "poisson", rate_rps=300.0, duration_s=1.4, seed=108,
+            rows=1, width=8, bucket_bounds=(8, 32),
+        )
+        report = R.replay_median(
+            wl, repeats=2, online=True, model=model,
+            label_fn=label_fn, seed=108, drift_at=0.3,
+            buffer_rows=128, min_bucket_rows=8, bucket_max_rows=32,
+        )
+        result = R.check_report(report)
+        assert result.ok, result.render()
+        o = report["online"]
+        assert o["refits"] == {"triggered": 1, "published": 1,
+                               "rejected": 0, "skipped": 0,
+                               "errors": 0}
+        assert o["version_final"] == 2
+        assert o["manifest_version"] == 2
+        assert report["drift"]["alerts_fired"] == 1
+        assert report["drift"]["flight_dumps"] == 1
+        assert o["recovery"]["alert_resolved"] is True
+        # warmed recovery: the post-swap monitor saw enough tail rows
+        # to score honestly, and the gauge sits back under the rule
+        assert o["recovery"]["final_warmed"] is True
+        assert o["recovery"]["final_psi_gauge"] < 0.5
+
+    def test_online_cli_flag_validation(self):
+        from benchmarks import replay as R
+
+        with pytest.raises(SystemExit):
+            R.main(["--online"])  # needs --drift
+        with pytest.raises(SystemExit):
+            R.main(["--online", "--drift", "--fleet", "3"])
+        with pytest.raises(SystemExit):
+            R.main(["--online", "--drift", "--mode", "timed"])
+
+
+def test_zz_online_suite_under_budget(_module_clock):
+    """Tier-1 allowance for this module (the ratchet discipline): the
+    closed-loop drill is already covered by the budgeted scenario
+    conformance smoke; this suite must stay a lightweight unit+gate
+    suite."""
+    elapsed = time.perf_counter() - _module_clock
+    assert elapsed < 35.0, (
+        f"tests/test_online.py took {elapsed:.1f}s; move the offender "
+        "to -m slow or shrink it"
+    )
