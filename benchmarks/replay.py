@@ -1604,6 +1604,310 @@ def replay_online(
     }
 
 
+def replay_churn(
+    workload,
+    *,
+    models=None,
+    n_models: int = 6,
+    cache_capacity: int = 4,
+    zipf_s: float = 1.1,
+    width: int = 8,
+    n_estimators: int = 2,
+    seed: int = 0,
+    hot_rps: float = 50.0,
+    warm_rps: float = 20.0,
+    max_delay_ms: float = 2.0,
+    idle_flush_ms: float = 1.0,
+    max_batch_rows: int = 256,
+    max_queue: int = 1024,
+    min_bucket_rows: int = 8,
+    bucket_max_rows: int = 32,
+    snapshot_every: int = 8,
+    timeout_s: float = 120.0,
+) -> dict:
+    """The capacity drill (``--churn``): K registered model versions
+    contending for a program cache deliberately sized BELOW K, with
+    arrivals routed by a seeded Zipf popularity law. One FRESH stack
+    per run — a private ``ProgramCache(capacity=cache_capacity)`` and
+    a private ``CapacityPlane`` are installed for the drill's duration
+    and restored in the ``finally`` — so the residency/eviction
+    transcript is a pure function of ``(workload, seed)`` and asserted
+    byte-identical across ``replay_median`` repeats.
+
+    What the transcript records, and what it deliberately omits: the
+    snapshots carry residency ORDER (owner, bucket, LRU position, hit
+    counts, insertion sequence), cumulative per-owner eviction counts,
+    and the demand plane's ranks/classes — all workload-pure. Raw byte
+    VALUES (serialized-executable sizes) are toolchain-dependent and
+    stay OUT of the digest; they are still measured and reconciled
+    (the ``reconciled`` flag in the churn section is the ledger-vs-
+    cache sum check, run before the private plane is torn down).
+
+    Compile accounting: executors retain their compiled programs, so
+    each (model, bucket) pair compiles exactly once regardless of how
+    often the cache evicts its entry — the drill's compiles are the
+    scripted cold-start cost of serving K cold models, carried as
+    ``churn.compiles`` (the ``swap_compiles`` convention), and
+    ``post_warmup_compiles`` reports 0 so the stock SLO gate stays
+    meaningful. Eviction churn therefore happens during the demand-
+    driven admission phase, in Zipf arrival order."""
+    import numpy as np
+
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.serving import ModelRegistry
+    from spark_bagging_tpu.serving import program_cache as _pc
+    from spark_bagging_tpu.serving.batcher import MicroBatcher, Overloaded
+    from spark_bagging_tpu.telemetry import capacity as capacity_mod
+
+    telemetry.enable()
+    requests = workload.requests
+    if not requests:
+        raise ValueError("empty workload")
+    if n_models < 2:
+        raise ValueError("--churn needs at least 2 models")
+    if not (1 <= cache_capacity < n_models):
+        raise ValueError(
+            "--churn needs 1 <= cache_capacity < n_models "
+            f"(got capacity={cache_capacity}, models={n_models})"
+        )
+    if models is None:
+        models = [
+            _default_model(width, n_estimators, seed=seed + 101 * (i + 1))
+            for i in range(n_models)
+        ]
+    if len(models) != n_models:
+        raise ValueError(
+            f"models list has {len(models)} entries, expected {n_models}"
+        )
+
+    # the popularity law: one seeded draw assigns every arrival an
+    # owner; rank-1 gets the Zipf head. Pure function of (seed, n).
+    ranks = np.arange(1, n_models + 1, dtype=np.float64)
+    weights = ranks ** (-float(zipf_s))
+    probs = weights / weights.sum()
+    rng = np.random.default_rng(seed)
+    owner_of = rng.choice(n_models, size=len(requests), p=probs)
+
+    reg_counters = telemetry.registry()
+
+    def counter(name: str) -> float:
+        return reg_counters.counter(name).value
+
+    c0 = {
+        name: counter(name)
+        for name in (
+            "sbt_serving_compiles_total",
+            "sbt_serving_batches_total",
+            "sbt_program_cache_hits_total",
+            "sbt_program_cache_misses_total",
+            "sbt_program_cache_evictions_total",
+        )
+    }
+
+    plane = capacity_mod.CapacityPlane(
+        hot_rps=hot_rps, warm_rps=warm_rps,
+    )
+    prev_plane = capacity_mod.install(plane)
+    small = _pc.ProgramCache(capacity=cache_capacity)
+    prev_cache = _pc.install(small)
+
+    registry = ModelRegistry(
+        min_bucket_rows=min_bucket_rows, max_batch_rows=bucket_max_rows,
+    )
+    names = [f"m{i}" for i in range(n_models)]
+    batchers: dict[str, MicroBatcher] = {}
+    futs: dict[int, object] = {}
+    overloads = 0
+    snapshots: list[dict] = []
+
+    def snap(window_i: int, vt: float) -> None:
+        plane.classify(now=vt)
+        residents = [
+            {
+                "owner": plane.owner_label(e["fingerprint"])
+                or capacity_mod.UNATTRIBUTED,
+                "bucket": e["bucket"],
+                "lru": e["lru_position"],
+                "hits": e["hits"],
+                "seq": e["seq_inserted"],
+            }
+            for e in small.snapshot()["entries"]
+        ]
+        snapshots.append({
+            "window": window_i,
+            "residents": residents,
+            "demand": plane.demand_summary(),
+            "evictions": plane.eviction_counts(),
+        })
+
+    t_wall0 = time.perf_counter()
+    try:
+        for i, name in enumerate(names):
+            # warmup=False on purpose: the drill wants the cache to
+            # admit programs in DEMAND order, not registration order
+            registry.register(name, models[i], warmup=False, version=1)
+        payload = _payloads(
+            workload, registry.executor(names[0]).n_features, seed,
+        )
+        for name in names:
+            batchers[name] = MicroBatcher(
+                lambda name=name: registry.executor(name),
+                max_delay_ms=max_delay_ms,
+                idle_flush_ms=idle_flush_ms,
+                max_batch_rows=max_batch_rows,
+                max_queue=max_queue,
+                threaded=False,
+            )
+        windows = plan_windows(
+            requests,
+            max_delay_s=max_delay_ms / 1e3,
+            idle_flush_s=idle_flush_ms / 1e3,
+        )
+        for w_i, window in enumerate(windows):
+            touched: set[str] = set()
+            for idx in window:
+                name = names[int(owner_of[idx])]
+                try:
+                    futs[idx] = batchers[name].submit(
+                        payload(idx, requests[idx].rows)
+                    )
+                    touched.add(name)
+                except Overloaded:
+                    overloads += 1
+            for name in sorted(touched):
+                batchers[name].run_pending()
+            vt = requests[window[0]].t
+            if w_i % snapshot_every == 0 or w_i == len(windows) - 1:
+                snap(w_i, vt)
+        wall = time.perf_counter() - t_wall0
+        # read the ledger while the private cache + plane are still
+        # installed: the reconciliation check and the final residency
+        # are part of the transcript's closing state
+        led = plane.ledger()
+        final_snapshot = small.snapshot()
+        residents_final = [
+            {
+                "owner": plane.owner_label(e["fingerprint"])
+                or capacity_mod.UNATTRIBUTED,
+                "bucket": e["bucket"],
+                "lru": e["lru_position"],
+                "hits": e["hits"],
+            }
+            for e in final_snapshot["entries"]
+        ]
+        demand_final = plane.demand_summary()
+        eviction_counts = plane.eviction_counts()
+        eviction_events = [
+            {k: v for k, v in ev.items() if k != "bytes"}
+            for ev in plane.recent_evictions(limit=64)
+        ]
+    finally:
+        for b in batchers.values():
+            b.close()
+        _pc.install(prev_cache)
+        capacity_mod.install(prev_plane)
+
+    collected = _collect_futures(futs, timeout_s)
+    latencies = collected["latencies"]
+
+    compiles = int(counter("sbt_serving_compiles_total")
+                   - c0["sbt_serving_compiles_total"])
+    cache_hits = int(counter("sbt_program_cache_hits_total")
+                     - c0["sbt_program_cache_hits_total"])
+    cache_misses = int(counter("sbt_program_cache_misses_total")
+                       - c0["sbt_program_cache_misses_total"])
+    evictions = int(counter("sbt_program_cache_evictions_total")
+                    - c0["sbt_program_cache_evictions_total"])
+    unattributed_final = sum(
+        1 for e in residents_final
+        if e["owner"] == capacity_mod.UNATTRIBUTED
+    )
+    transcript = {
+        "snapshots": snapshots,
+        "residents_final": residents_final,
+        "demand_final": demand_final,
+        "evictions_by_owner": eviction_counts,
+        "eviction_events": eviction_events,
+        "compiles": compiles,
+        "evictions": evictions,
+    }
+    churn_report = {
+        "models": n_models,
+        "cache_capacity": cache_capacity,
+        "zipf_s": zipf_s,
+        "hot_rps": hot_rps,
+        "warm_rps": warm_rps,
+        "compiles": compiles,
+        "evictions": evictions,
+        "cache_hits": cache_hits,
+        "cache_misses": cache_misses,
+        "snapshots": len(snapshots),
+        "models_tracked": len(demand_final),
+        "residents_final": residents_final,
+        "demand_final": demand_final,
+        "evictions_by_owner": eviction_counts,
+        "eviction_events": eviction_events,
+        "unattributed_final": unattributed_final,
+        "reconciled": bool(led["reconciled"]),
+        "transcript_digest": hashlib.sha256(
+            json.dumps(transcript, sort_keys=True).encode()
+        ).hexdigest(),
+    }
+
+    import jax
+
+    return {
+        "metric": "workload_replay",
+        "schema": REPLAY_SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "mode": "virtual",
+        "speed": 1.0,
+        "seed": seed,
+        "workload": workload.summary(),
+        "workload_digest": workload_digest(workload),
+        "batcher": {
+            "max_delay_ms": max_delay_ms,
+            "idle_flush_ms": idle_flush_ms,
+            "max_batch_rows": max_batch_rows,
+            "max_queue": max_queue,
+        },
+        "burst": 0,
+        "swaps": 0,
+        "n_requests": len(requests),
+        "served": collected["served"],
+        "errors": collected["errors"],
+        "overloads": overloads,
+        "deadline_ms": None,
+        "deadline_sheds": 0,
+        "batches": int(counter("sbt_serving_batches_total")
+                       - c0["sbt_serving_batches_total"]),
+        # every compile in this drill is the scripted cold-start cost
+        # of K cold models (the experiment, not a regression) — carried
+        # as churn.compiles, the swap_compiles convention
+        "post_warmup_compiles": 0,
+        "swap_compiles": 0,
+        "wall_seconds": round(wall, 6),
+        "rps": (round(collected["served"] / wall, 2)
+                if wall > 0 else None),
+        "latency_ms": {
+            "p50": _percentile(latencies, 0.50),
+            "p95": _percentile(latencies, 0.95),
+            "p99": _percentile(latencies, 0.99),
+            "max": latencies[-1] if latencies else None,
+        },
+        "forward_ms_total": round(collected["forward_ms"], 3),
+        "padding": {"rows": None},
+        "model": {"name": "churn", "version": 1},
+        "composition_digest": collected["comp_h"].hexdigest(),
+        "output_digest": collected["out_h"].hexdigest(),
+        "drift": None,
+        "chaos": None,
+        "attribution": None,
+        "online": None,
+        "churn": churn_report,
+    }
+
+
 def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     """Median-of-``repeats`` replay (the BENCH protocol: thread noise
     on small hosts swings single runs; the median is the stable
@@ -1621,17 +1925,27 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
     fleet = kwargs.get("fleet", 0)
     online = kwargs.get("online", False)
-    if fleet and online:
-        raise ValueError("--fleet and --online are separate drills")
-    if online:
+    churn = kwargs.get("churn", False)
+    if sum((bool(fleet), bool(online), bool(churn))) > 1:
+        raise ValueError(
+            "--fleet, --online and --churn are separate drills"
+        )
+    if churn:
+        drive = replay_churn
+        kwargs.pop("churn", None)
+        kwargs.pop("online", None)
+        kwargs.pop("fleet", None)
+    elif online:
         drive = replay_online
         # replay_online takes neither meta-kwarg (a generic caller may
         # forward fleet=0 alongside online=True)
         kwargs.pop("online", None)
         kwargs.pop("fleet", None)
+        kwargs.pop("churn", None)
     else:
         drive = replay_fleet if fleet else replay
         kwargs.pop("online", None)
+        kwargs.pop("churn", None)
         if not fleet:
             kwargs.pop("fleet", None)  # replay() takes no fleet kwarg
     runs = [drive(workload, **kwargs) for _ in range(repeats)]
@@ -1703,6 +2017,24 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             f"online.{key} changed "
                             f"({head['online'][key]!r} -> "
                             f"{r['online'][key]!r})"
+                        )
+            if head.get("churn") is not None:
+                # the capacity drill's deterministic surface: the
+                # residency/eviction transcript (byte VALUES excluded
+                # — they are toolchain facts, not workload facts) plus
+                # the cache and ledger counts it summarises
+                for key in ("transcript_digest", "compiles",
+                            "evictions", "cache_hits", "cache_misses",
+                            "snapshots", "models_tracked",
+                            "residents_final", "demand_final",
+                            "evictions_by_owner",
+                            "unattributed_final", "reconciled"):
+                    if r["churn"][key] != head["churn"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"churn.{key} changed "
+                            f"({head['churn'][key]!r} -> "
+                            f"{r['churn'][key]!r})"
                         )
             if head.get("fleet") is not None:
                 # the fleet plane's whole deterministic surface:
@@ -1863,6 +2195,35 @@ def _online_checks(report: dict) -> list[dict]:
     ]
 
 
+def _churn_checks(report: dict) -> list[dict]:
+    """The capacity gate (``--churn --check``): the drill actually
+    forced contention (at least one eviction — a capacity sized under
+    K models that never evicts means the workload never exercised the
+    cache), every resident program traces to a committed owner (zero
+    unattributed entries — the ledger's attribution contract), the
+    per-owner ledger sums reconcile exactly against the cache totals,
+    and the demand plane tracked every registered model."""
+    c = report.get("churn") or {}
+
+    def eq(name: str, actual, want) -> dict:
+        return {"name": name, "actual": actual, "limit": want,
+                "op": "==", "ok": actual == want}
+
+    return [
+        {
+            "name": "churn_evictions",
+            "actual": c.get("evictions"),
+            "limit": 1, "op": ">=",
+            "ok": bool((c.get("evictions") or 0) >= 1),
+        },
+        eq("churn_unattributed_final", c.get("unattributed_final"), 0),
+        eq("churn_ledger_reconciled", c.get("reconciled"), True),
+        eq("churn_models_tracked", c.get("models_tracked"),
+           c.get("models")),
+        eq("churn_errors", report.get("errors"), 0),
+    ]
+
+
 def check_report(report: dict, *, spec=None, baseline: dict | None = None,
                  rps_tolerance: float | None = None,
                  latency_tolerance: float | None = None):
@@ -1885,6 +2246,9 @@ def check_report(report: dict, *, spec=None, baseline: dict | None = None,
     if report.get("fleet") is not None:
         checks += _fleet_checks(report)
         kind += "+fleet"
+    if report.get("churn") is not None:
+        checks += _churn_checks(report)
+        kind += "+churn"
     if baseline is not None:
         kw = {}
         if rps_tolerance is not None:
@@ -2012,6 +2376,26 @@ def main(argv: list[str] | None = None) -> int:
                           "recovery (requires --drift; synthetic "
                           "model only, its seeded label rule "
                           "supervises the refit)")
+    drv.add_argument("--churn", action="store_true",
+                     help="the capacity drill: K registered model "
+                          "versions (--churn-models) contend for a "
+                          "program cache sized BELOW K "
+                          "(--churn-cache-capacity), arrivals routed "
+                          "by a seeded Zipf popularity law — the "
+                          "residency/eviction transcript is a pure "
+                          "function of (workload, seed) and gates on "
+                          "eviction pressure, zero unattributed "
+                          "residents, and exact ledger "
+                          "reconciliation")
+    drv.add_argument("--churn-models", type=int, default=6,
+                     help="number of registered model versions in the "
+                          "churn drill (K)")
+    drv.add_argument("--churn-cache-capacity", type=int, default=4,
+                     help="program-cache capacity for the churn drill "
+                          "(must be < --churn-models)")
+    drv.add_argument("--churn-zipf", type=float, default=1.1,
+                     help="Zipf exponent of the churn drill's "
+                          "popularity law (higher = more skewed)")
     drv.add_argument("--drift-at", type=float, default=None,
                      help="drift onset as a fraction of the workload "
                           "duration (default 0.5; 0.3 with --online "
@@ -2171,7 +2555,46 @@ def main(argv: list[str] | None = None) -> int:
     if args.save_workload:
         wl.save(args.save_workload)
 
-    if args.online:
+    if args.churn:
+        if args.mode != "virtual":
+            ap.error("--churn is a virtual-clock drill (the admission/"
+                     "eviction interleaving IS the experiment)")
+        if args.model_checkpoint:
+            ap.error("--churn builds its own K seeded models; a "
+                     "single checkpoint cannot populate the fleet")
+        for flag, val in (("--fleet", args.fleet),
+                          ("--online", args.online),
+                          ("--drift", args.drift),
+                          ("--swaps", args.swaps),
+                          ("--burst", args.burst),
+                          ("--throttle-ms", args.throttle_ms),
+                          ("--deadline-ms", args.deadline_ms),
+                          ("--devices", args.devices)):
+            if val:
+                ap.error(f"{flag} does not combine with --churn (the "
+                         "drill scripts its own fleet and cache)")
+        # build the K models ONCE, outside replay_median: repeats must
+        # re-drive the same fitted fleet, not refit it
+        models = [
+            _default_model(width, args.n_estimators,
+                           seed=args.seed + 101 * (i + 1))
+            for i in range(args.churn_models)
+        ]
+        report = replay_median(
+            wl, repeats=args.repeats,
+            churn=True, models=models,
+            n_models=args.churn_models,
+            cache_capacity=args.churn_cache_capacity,
+            zipf_s=args.churn_zipf,
+            max_delay_ms=args.max_delay_ms,
+            idle_flush_ms=args.idle_flush_ms,
+            max_batch_rows=args.max_batch_rows,
+            max_queue=args.max_queue,
+            min_bucket_rows=args.min_bucket_rows,
+            bucket_max_rows=args.bucket_max_rows,
+            seed=args.seed,
+        )
+    elif args.online:
         if not args.drift:
             ap.error("--online is the drift scenario's closing move: "
                      "combine with --drift")
@@ -2381,6 +2804,19 @@ def main(argv: list[str] | None = None) -> int:
             ),
             "alert_resolved": o["recovery"]["alert_resolved"],
             "transcript_digest": o["transcript_digest"][:16],
+        }
+    if report.get("churn") is not None:
+        c = report["churn"]
+        summary["churn"] = {
+            "models": c["models"],
+            "cache_capacity": c["cache_capacity"],
+            "compiles": c["compiles"],
+            "evictions": c["evictions"],
+            "cache_hits": c["cache_hits"],
+            "cache_misses": c["cache_misses"],
+            "unattributed_final": c["unattributed_final"],
+            "reconciled": c["reconciled"],
+            "transcript_digest": c["transcript_digest"][:16],
         }
     print(json.dumps(summary))
     print(f"report: {out}")

@@ -58,7 +58,9 @@ class Scenario:
     serves through a replica-sharded ``(1, N)`` mesh; ``fleet`` drives
     the N-virtual-peer drill; ``online`` drives the closed-loop
     drift-refit drill (``replay_online`` — the drive kwargs are its
-    drift/refit knobs); ``parity_with`` additionally asserts
+    drift/refit knobs); ``churn`` drives the capacity drill
+    (``replay_churn`` — the dict carries ``n_models`` /
+    ``cache_capacity`` / ``zipf_s``); ``parity_with`` additionally asserts
     this scenario's output digest equals ANOTHER scenario's committed
     output digest (the sharded-parity contract).
     """
@@ -74,6 +76,7 @@ class Scenario:
     devices: int | None = None
     fleet: int = 0
     online: bool = False
+    churn: dict[str, Any] | None = None
     parity_with: str | None = None
     tags: tuple[str, ...] = ()
 
@@ -276,6 +279,25 @@ register(Scenario(
     online=True,
     slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
     tags=("quality", "online"),
+))
+
+register(Scenario(
+    name="cache-churn",
+    description="the capacity drill [ISSUE 16]: 6 registered model "
+                "versions contend for a program cache deliberately "
+                "sized at 4, arrivals routed by a seeded Zipf law — "
+                "the residency/eviction transcript (LRU order, "
+                "per-owner eviction counts, demand ranks/classes) is "
+                "digest-identical, every resident traces to a "
+                "committed owner, and the capacity ledger reconciles "
+                "exactly against the cache totals",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 109, "width": 8, "bucket_bounds": (8, 32)},
+    model={"n_estimators": 2, "seed": 0},
+    serving=dict(_SERVING),
+    churn={"n_models": 6, "cache_capacity": 4, "zipf_s": 1.1},
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("capacity", "serving"),
 ))
 
 register(Scenario(

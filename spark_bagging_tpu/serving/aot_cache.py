@@ -62,6 +62,32 @@ FORMAT_VERSION = 1
 MANIFEST = "aot_manifest.json"
 
 
+def _count(executor: Any, series: str) -> None:
+    """Count one AOT event, unlabeled always and ``model=``-labeled
+    when the executor is registry-committed [ISSUE 16]. Restores that
+    run during ``register``/``swap`` pre-commit happen BEFORE the name
+    is stamped and stay unlabeled — deliberately: labels exist only
+    for owners a commit established, matching the capacity plane's
+    attribution contract."""
+    telemetry.inc(series)
+    name = getattr(executor, "model_name", None)
+    if name is not None:
+        telemetry.inc(series, labels={"model": str(name)})
+
+
+def dir_nbytes(path: str) -> int:
+    """Total bytes on disk under an AOT cache directory."""
+    total = 0
+    try:
+        with os.scandir(path) as it:
+            for entry in it:
+                if entry.is_file():
+                    total += entry.stat().st_size
+    except OSError:
+        return 0
+    return total
+
+
 def model_fingerprint(executor: Any) -> str:
     """sha256 identity of the program an executor compiles — the SAME
     fingerprint the in-process unified cache keys on
@@ -142,7 +168,7 @@ def save_executables(executor: Any, path: str) -> tuple[int, ...]:
         with open(os.path.join(tmp, fname), "wb") as f:
             pickle.dump(triple, f)
         saved[str(bucket)] = fname
-        telemetry.inc("sbt_serving_aot_saved_total")
+        _count(executor, "sbt_serving_aot_saved_total")
     with open(os.path.join(tmp, MANIFEST), "w") as f:
         json.dump({"key": cache_key(executor), "buckets": saved}, f,
                   indent=2)
@@ -155,6 +181,17 @@ def save_executables(executor: Any, path: str) -> tuple[int, ...]:
     if os.path.exists(path):
         shutil.rmtree(path)
     os.replace(tmp, path)
+    # capacity ledger feed [ISSUE 16]: disk bytes this model's AOT
+    # cache now holds, attributed only when the executor is committed
+    name = getattr(executor, "model_name", None)
+    if name is not None:
+        from spark_bagging_tpu.telemetry import capacity as _capacity
+
+        cap = _capacity.ACTIVE
+        if cap is not None:
+            cap.set_aot_bytes(str(name),
+                              int(executor.model_version or 0),
+                              dir_nbytes(path))
     return tuple(int(b) for b in sorted(saved, key=int))
 
 
@@ -167,13 +204,13 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
 
     manifest_path = os.path.join(path, MANIFEST)
     if not os.path.isfile(manifest_path):
-        telemetry.inc("sbt_serving_aot_misses_total")
+        _count(executor, "sbt_serving_aot_misses_total")
         return ()
     try:
         with open(manifest_path) as f:
             manifest = json.load(f)
     except (OSError, ValueError) as e:
-        telemetry.inc("sbt_serving_aot_misses_total")
+        _count(executor, "sbt_serving_aot_misses_total")
         warnings.warn(f"unreadable AOT manifest at {manifest_path!r} "
                       f"({e!r}); warm start falls back to lowering",
                       stacklevel=2)
@@ -184,7 +221,7 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
         # would be the WRONG program — fall back to lowering. A
         # non-dict "key" (version skew, hand edit) is the same miss,
         # not an AttributeError
-        telemetry.inc("sbt_serving_aot_misses_total")
+        _count(executor, "sbt_serving_aot_misses_total")
         found = manifest.get("key")
         if not isinstance(found, dict):
             found = {}
@@ -198,7 +235,7 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
         return ()
     entries = manifest.get("buckets")
     if not isinstance(entries, dict):
-        telemetry.inc("sbt_serving_aot_misses_total")
+        _count(executor, "sbt_serving_aot_misses_total")
         warnings.warn(
             f"AOT manifest at {path!r} has a malformed buckets "
             "section; warm start falls back to lowering",
@@ -209,7 +246,7 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
         ordered = sorted((int(b), f) for b, f in entries.items())
     except (TypeError, ValueError):
         # non-numeric bucket keys: same corrupt-manifest miss
-        telemetry.inc("sbt_serving_aot_misses_total")
+        _count(executor, "sbt_serving_aot_misses_total")
         warnings.warn(
             f"AOT manifest at {path!r} has non-numeric bucket keys; "
             "warm start falls back to lowering",
@@ -225,7 +262,7 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
                 payload, in_tree, out_tree
             )
         except Exception as e:  # noqa: BLE001 — per-bucket fallback
-            telemetry.inc("sbt_serving_aot_misses_total")
+            _count(executor, "sbt_serving_aot_misses_total")
             warnings.warn(
                 f"failed to restore bucket {bucket} executable from "
                 f"{path!r} ({e!r}); it will lower on demand",
@@ -234,5 +271,5 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
             continue
         if executor._adopt(bucket, compiled):
             restored.append(bucket)
-            telemetry.inc("sbt_serving_aot_restored_total")
+            _count(executor, "sbt_serving_aot_restored_total")
     return tuple(restored)

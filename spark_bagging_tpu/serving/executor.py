@@ -29,6 +29,7 @@ import numpy as np
 
 from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.telemetry import capacity as _capacity
 from spark_bagging_tpu.telemetry import perf as _perf
 from spark_bagging_tpu.telemetry import tracing
 from spark_bagging_tpu.serving import program_cache as _pc
@@ -728,6 +729,16 @@ class EnsembleExecutor:
         mon = self._quality
         if mon is not None:
             self._feed_quality(mon, parts, outs, first_slab)
+        # capacity demand tap [ISSUE 16]: same one-attribute-read
+        # contract as the quality tap and faults.ACTIVE — unarmed cost
+        # is this single module-attribute load. Feeds per-model
+        # request/row demand under BOTH dispatch paths; anonymous
+        # executors (model_name unset — never registry-committed) stay
+        # out of the demand table by design.
+        cap = _capacity.ACTIVE
+        if cap is not None and self.model_name is not None:
+            cap.observe_demand(self.model_name, self.model_version,
+                               len(parts), n)
         return outs
 
     # sbt-lint: hot-path

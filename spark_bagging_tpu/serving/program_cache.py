@@ -40,11 +40,13 @@ time, so a cache entry pins no model weights.
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import OrderedDict
 from typing import Any, Callable, NamedTuple
 
 from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.telemetry import capacity as _capacity
 
 
 class ProgramKey(NamedTuple):
@@ -142,27 +144,70 @@ def mesh_shape(mesh: Any) -> tuple[int, int] | None:
             int(mesh.shape.get(REPLICA_AXIS, 1)))
 
 
+class _Entry:
+    """One resident program: the executable plus the residency facts
+    the capacity plane's explainer reads (bytes + measurement source,
+    hit counts, a monotonic insert/hit sequence — the workload-pure
+    event clock the churn drill's transcript records — and wall-clock
+    timestamps for live last-hit-age reporting only, never digests)."""
+
+    __slots__ = ("compiled", "nbytes", "source", "hits",
+                 "seq_inserted", "seq_last_hit", "ts_inserted",
+                 "ts_last_hit")
+
+    def __init__(self, compiled: Any, nbytes: int | None, source: str,
+                 seq: int):
+        self.compiled = compiled
+        self.nbytes = nbytes
+        self.source = source
+        self.hits = 0
+        self.seq_inserted = seq
+        self.seq_last_hit = seq
+        self.ts_inserted = time.time()
+        self.ts_last_hit: float | None = None
+
+
 # sbt-lint: shared-state
 class ProgramCache:
-    """Bounded, thread-safe LRU map ``ProgramKey -> compiled``."""
+    """Bounded, thread-safe LRU map ``ProgramKey -> compiled``.
+
+    Since ISSUE 16 each entry carries residency metadata (measured
+    executable bytes via :func:`telemetry.capacity.executable_bytes`,
+    hit counts, insert sequence) and lookups/evictions feed the armed
+    capacity plane: hit/miss/eviction counters gain ``model=`` owner
+    labels (resolved lazily through the plane's fingerprint map, so
+    only COMMITTED owners ever appear) while the unlabeled totals keep
+    their exact pre-existing meaning for dashboard continuity.
+    """
 
     def __init__(self, capacity: int = 256):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self._lock = make_lock("serving.program_cache")
-        self._entries: OrderedDict[ProgramKey, Any] = OrderedDict()
+        self._entries: OrderedDict[ProgramKey, _Entry] = OrderedDict()
+        self._seq = 0
 
     def get(self, key: ProgramKey) -> Any | None:
         """The cached executable for ``key``, or None (counted as a
         hit/miss either way)."""
         with self._lock:
-            compiled = self._entries.get(key)
-            if compiled is not None:
+            entry = self._entries.get(key)
+            if entry is not None:
                 self._entries.move_to_end(key)
-        telemetry.inc("sbt_program_cache_hits_total" if compiled is not None
-                      else "sbt_program_cache_misses_total")
-        return compiled
+                self._seq += 1
+                entry.hits += 1
+                entry.seq_last_hit = self._seq
+                entry.ts_last_hit = time.time()
+        name = ("sbt_program_cache_hits_total" if entry is not None
+                else "sbt_program_cache_misses_total")
+        telemetry.inc(name)
+        cap = _capacity.ACTIVE
+        if cap is not None:
+            owner = cap.owner_label(key.fingerprint)
+            if owner is not None:
+                telemetry.inc(name, labels={"model": owner})
+        return None if entry is None else entry.compiled
 
     def put(self, key: ProgramKey, compiled: Any) -> Any:
         """Insert-if-absent; returns the winning executable (the first
@@ -172,21 +217,42 @@ class ProgramCache:
             # caller (executor build, swap pre-compile) exactly where
             # an allocation failure would
             faults.fire("program_cache.put", bucket=key.bucket)
-        evicted = 0
+        # measure OUTSIDE the lock: the serialize fallback is not free,
+        # and put() runs on the compile path where seconds were already
+        # spent — never on the per-request path
+        nbytes, source = _capacity.executable_bytes(compiled)
+        evicted: list[tuple[ProgramKey, _Entry]] = []
         with self._lock:
             existing = self._entries.get(key)
             if existing is not None:
                 self._entries.move_to_end(key)
-                return existing
-            self._entries[key] = compiled
+                return existing.compiled
+            self._seq += 1
+            self._entries[key] = _Entry(compiled, nbytes, source,
+                                        self._seq)
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
-                evicted += 1
+                evicted.append(self._entries.popitem(last=False))
             size = len(self._entries)
+            total_bytes = sum(e.nbytes or 0
+                              for e in self._entries.values())
         if evicted:
             telemetry.inc("sbt_program_cache_evictions_total",
-                          float(evicted))
+                          float(len(evicted)))
+            cap = _capacity.ACTIVE
+            for ekey, entry in evicted:
+                if cap is None:
+                    continue
+                owner = cap.observe_eviction(
+                    fingerprint=ekey.fingerprint, bucket=ekey.bucket,
+                    variant=ekey.variant, nbytes=entry.nbytes,
+                    seq=entry.seq_inserted,
+                )
+                if owner != _capacity.UNATTRIBUTED:
+                    telemetry.inc("sbt_program_cache_evictions_total",
+                                  labels={"model": owner})
         telemetry.set_gauge("sbt_program_cache_entries", float(size))
+        telemetry.set_gauge("sbt_program_cache_bytes",
+                            float(total_bytes))
         return compiled
 
     def get_or_build(self, key: ProgramKey,
@@ -205,11 +271,47 @@ class ProgramCache:
         with self._lock:
             self._entries.clear()
         telemetry.set_gauge("sbt_program_cache_entries", 0.0)
+        telemetry.set_gauge("sbt_program_cache_bytes", 0.0)
 
     def stats(self) -> dict:
         with self._lock:
+            nbytes = sum(e.nbytes or 0 for e in self._entries.values())
+            unmeasured = sum(1 for e in self._entries.values()
+                             if e.nbytes is None)
             return {"entries": len(self._entries),
-                    "capacity": self.capacity}
+                    "capacity": self.capacity,
+                    "bytes": nbytes,
+                    "unmeasured": unmeasured}
+
+    def snapshot(self) -> dict:
+        """Residency raw material for the capacity plane's ledger and
+        explainer: every entry LRU-first (position 0 is next to evict)
+        with its key fields and metadata, plus the totals the ledger
+        reconciles against. Point-in-time consistent: one lock hold."""
+        with self._lock:
+            entries = []
+            for pos, (key, e) in enumerate(self._entries.items()):
+                entries.append({
+                    "lru_position": pos,
+                    "fingerprint": key.fingerprint,
+                    "variant": key.variant,
+                    "bucket": key.bucket,
+                    "mesh": key.mesh,
+                    "bytes": e.nbytes,
+                    "source": e.source,
+                    "hits": e.hits,
+                    "seq_inserted": e.seq_inserted,
+                    "seq_last_hit": e.seq_last_hit,
+                    "ts_last_hit": e.ts_last_hit,
+                })
+            return {
+                "capacity": self.capacity,
+                "entries_total": len(entries),
+                "bytes_total": sum(e["bytes"] or 0 for e in entries),
+                "unmeasured_total": sum(1 for e in entries
+                                        if e["bytes"] is None),
+                "entries": entries,
+            }
 
     def __len__(self) -> int:
         with self._lock:
@@ -227,6 +329,17 @@ def cache() -> ProgramCache:
         if _default is None:
             _default = ProgramCache()
         return _default
+
+
+def install(c: ProgramCache | None) -> ProgramCache | None:
+    """Swap the process-wide cache, returning the previous one — the
+    churn drill's save/restore seam (mirrors ``telemetry.perf`` /
+    ``telemetry.capacity``). ``None`` restores lazy re-creation."""
+    global _default
+    with _default_lock:
+        prev = _default
+        _default = c
+    return prev
 
 
 def clear() -> None:

@@ -35,6 +35,7 @@ import numpy as np
 from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.serving.executor import EnsembleExecutor
+from spark_bagging_tpu.telemetry import capacity as _capacity
 
 
 class _Entry:
@@ -177,6 +178,12 @@ class ModelRegistry:
         telemetry.inc("sbt_serving_models_registered_total")
         telemetry.set_gauge("sbt_serving_model_version", float(version),
                             labels={"model": name})
+        # capacity ledger feed [ISSUE 16]: ownership is established
+        # HERE, at commit — any compiles the executor did before this
+        # point retroactively become attributed via its fingerprint
+        cap = _capacity.ACTIVE
+        if cap is not None:
+            cap.register_owner(ex)
         return ex
 
     def swap(self, name: str, model: Any, *, warm: bool = True,
@@ -321,6 +328,16 @@ class ModelRegistry:
             "kind": "model_swapped", "model": name,
             "version": int(version),
         })
+        # capacity ledger feed [ISSUE 16]: runs ONLY on the commit
+        # path — a failed swap raised out of _fail_swap above, so the
+        # replacement's fingerprint never acquires an owner and its
+        # pre-compile cache entries stay unattributed (the no-leak
+        # contract, regression-tested). The outgoing executor is
+        # retired, not erased: its resident entries keep their owner
+        # for eviction attribution.
+        cap = _capacity.ACTIVE
+        if cap is not None:
+            cap.register_owner(new, retired_fingerprint=old.fingerprint)
         if quality_gap is not None:
             # the one attach failure that does NOT roll back: a
             # replacement with no fit-time quality_profile_ (stream

@@ -495,6 +495,62 @@ def default_drift_rules(
     ]
 
 
+def default_capacity_rules(
+    *,
+    headroom_threshold: float = 0.1,
+    eviction_rate_threshold: float = 1.0,
+    fast_window_s: float = 30.0,
+    slow_window_s: float = 300.0,
+    cooldown_s: float = 300.0,
+    labels: dict[str, Any] | None = None,
+    name_prefix: str = "",
+) -> list[AlertRule]:
+    """The starter rule set for the capacity plane [ISSUE 16], reading
+    the gauges ``telemetry.capacity`` refreshes on every scrape:
+
+    - **capacity-headroom-low** — the program cache's free-slot ratio
+      fell below ``headroom_threshold``: the next cold model admission
+      evicts someone;
+    - **capacity-cold-model-resident** — entries owned by cold-class
+      models are resident while headroom is being consumed — the
+      reclaim candidates a residency policy would take first;
+    - **capacity-eviction-churn** — sustained eviction burn rate above
+      ``eviction_rate_threshold``/s: the cache capacity sits below the
+      working set and compiles are being re-paid (the thrash signal
+      the ``cache-churn`` drill manufactures deliberately).
+    """
+    return [
+        AlertRule(
+            f"{name_prefix}capacity-headroom-low",
+            "sbt_capacity_cache_headroom_ratio", labels=labels,
+            threshold=headroom_threshold, kind="value", op="<",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="program-cache free-slot ratio below "
+                        "threshold: the next admission evicts",
+        ),
+        AlertRule(
+            f"{name_prefix}capacity-cold-model-resident",
+            "sbt_capacity_cold_resident_entries", labels=labels,
+            threshold=0.0, kind="value", op=">",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="cold-demand models hold resident cache "
+                        "entries — reclaimable bytes",
+        ),
+        AlertRule(
+            f"{name_prefix}capacity-eviction-churn",
+            "sbt_program_cache_evictions_total", labels=labels,
+            threshold=eviction_rate_threshold, kind="rate", op=">",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="sustained program-cache eviction burn rate: "
+                        "capacity below the working set, compiles "
+                        "being re-paid",
+        ),
+    ]
+
+
 # -- process default ----------------------------------------------------
 
 _default: AlertEngine | None = None

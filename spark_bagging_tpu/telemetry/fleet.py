@@ -113,6 +113,11 @@ FLEET_DIGEST_SERIES: tuple[str, ...] = (
     "sbt_fleet_version",
     "sbt_fleet_version_skew",
     "sbt_fleet_convergence_seconds",
+    # capacity plane [ISSUE 16]: demand counters are workload-pure
+    # (fed per packed batch under the virtual clock); the byte gauges
+    # are toolchain-dependent measurements and stay out of the digest
+    "sbt_capacity_demand_requests_total",
+    "sbt_capacity_demand_rows_total",
 )
 
 

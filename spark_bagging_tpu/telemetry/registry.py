@@ -168,6 +168,24 @@ SERIES_HELP: dict[str, str] = {
     "sbt_history_groups": "Distinct (kind, key) groups in the latest history trend scan (gauge)",
     "sbt_history_digest_flips": "Digest/SLO flips found by the latest history trend scan (gauge; any nonzero is a regression finding)",
     "sbt_history_numeric_drift": "Numeric fields outside the CI-noise band in the latest history trend scan (gauge, advisory)",
+    "sbt_program_cache_bytes": "Measured executable bytes resident in the unified program cache (gauge; unmeasured entries excluded, see sbt_capacity_unmeasured_entries)",
+    "sbt_capacity_params_bytes": "Stacked-pytree parameter bytes held by one committed (model, version) (gauge, labels model+version)",
+    "sbt_capacity_compiled_bytes": "Measured program-cache executable bytes attributed to one committed model (gauge, label model)",
+    "sbt_capacity_resident_entries": "Program-cache entries attributed to one committed model (gauge, label model)",
+    "sbt_capacity_unmeasured_entries": "Resident entries whose executable bytes could not be measured - flagged, never counted as 0 (gauge, label model)",
+    "sbt_capacity_aot_disk_bytes": "AOT executable-cache bytes on disk for one committed model (gauge, label model)",
+    "sbt_capacity_models": "Distinct models in the capacity ledger (gauge)",
+    "sbt_capacity_demand_requests_total": "Requests served per model, fed from the packed-forward demand tap (label model)",
+    "sbt_capacity_demand_rows_total": "Rows served per model, fed from the packed-forward demand tap (label model)",
+    "sbt_capacity_demand_rate_rps": "Per-model request rate over the last classification window (gauge, label model)",
+    "sbt_capacity_demand_rank": "Per-model popularity rank by cumulative requests, 1 = hottest (gauge, label model)",
+    "sbt_capacity_demand_class": "Per-model demand class with hysteresis: 2 hot / 1 warm / 0 cold (gauge, label model)",
+    "sbt_capacity_demand_dropped_total": "Demand observations dropped by the fixed-memory model cap (capacity plane max_models)",
+    "sbt_capacity_cache_headroom_ratio": "Free-slot ratio of the program cache: (capacity - entries) / capacity (gauge)",
+    "sbt_capacity_cold_resident_entries": "Program-cache entries owned by cold-demand-class models (gauge; the reclaim candidates)",
+    "sbt_process_device_bytes_in_use": "Device memory currently allocated, where the backend reports it (gauge, label device)",
+    "sbt_process_device_bytes_limit": "Device memory capacity, where the backend reports it (gauge, label device)",
+    "sbt_process_device_peak_bytes": "Peak device memory allocated since process start, where reported (gauge, label device)",
 }
 
 

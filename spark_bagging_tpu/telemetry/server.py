@@ -39,6 +39,11 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
   (scenario/bench/tier runs) plus the ``compare_trend`` verdict over
   the full store — digest flips are findings, noise-band numeric
   wobble is not;
+- ``GET /debug/capacity`` — the capacity & residency plane
+  (``telemetry/capacity.py``): per-owner ledger reconciled against
+  the program cache, the per-resident eviction-decision explainer
+  (LRU position, demand rank/class, bytes reclaimable, last-hit age),
+  demand table, recent owner-attributed evictions, device memory;
 - ``GET /debug/profile?seconds=N`` — on-demand live device profiling:
   starts a single-flight ``jax.profiler`` capture that auto-stops
   after N seconds (hard-capped) into ``telemetry_dir()/profiles/``;
@@ -174,6 +179,29 @@ def _refresh_process_gauges() -> tuple[float | None, int | None]:
             STATE.registry.set("sbt_process_uptime_seconds", uptime)
         if rss is not None:
             STATE.registry.set("sbt_process_rss_bytes", float(rss))
+        # device residency twins [ISSUE 16]: honest-None on backends
+        # without memory stats (CPU) — the gauges simply don't exist
+        # there, they never report a made-up 0
+        from spark_bagging_tpu.utils.memory import device_memory_stats
+
+        for d in device_memory_stats() or ():
+            labels = {"device": str(d["id"])}
+            STATE.registry.set("sbt_process_device_bytes_in_use",
+                               float(d["bytes_in_use"]), labels)
+            STATE.registry.set("sbt_process_device_bytes_limit",
+                               float(d["bytes_limit"]), labels)
+            if d["peak_bytes_in_use"] is not None:
+                STATE.registry.set("sbt_process_device_peak_bytes",
+                                   float(d["peak_bytes_in_use"]),
+                                   labels)
+        # capacity gauge refresh: scrape-time, like rss — the alert
+        # rules (default_capacity_rules) read headroom/cold-resident
+        # off the registry, so each scrape re-derives them
+        from spark_bagging_tpu.telemetry import capacity
+
+        plane = capacity.ACTIVE
+        if plane is not None:
+            plane.export_gauges()
     return uptime, rss
 
 
@@ -268,6 +296,16 @@ def _debug_tail(query: dict[str, list[str]]) -> dict[str, Any]:
     except ValueError:
         window_s = 1.0
     return perf.tail_report(limit=limit, window_s=window_s)
+
+
+def _debug_capacity(query: dict[str, list[str]]) -> dict[str, Any]:
+    from spark_bagging_tpu.telemetry import capacity
+
+    try:
+        limit = max(1, int((query.get("limit") or ["64"])[0]))
+    except ValueError:
+        limit = 64
+    return capacity.capacity_report(limit=limit)
 
 
 def _debug_profile(query: dict[str, list[str]]) -> tuple[int, dict]:
@@ -405,6 +443,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _debug_tail(query))
             elif url.path == "/debug/history":
                 self._send_json(200, _debug_history(query))
+            elif url.path == "/debug/capacity":
+                self._send_json(200, _debug_capacity(query))
             elif url.path == "/debug/profile":
                 code, body = _debug_profile(query)
                 self._send_json(code, body)
@@ -421,7 +461,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "/debug/spans", "/debug/runs",
                         "/debug/workload", "/debug/drift",
                         "/debug/tail", "/debug/history",
-                        "/debug/profile",
+                        "/debug/capacity", "/debug/profile",
                         "/fleet/metrics", "/fleet/varz",
                         "/fleet/healthz", "/fleet/incidents",
                     ],

@@ -55,6 +55,33 @@ def device_memory_budget(safety: float = SAFETY) -> float:
     return FALLBACK_BUDGET_BYTES * safety
 
 
+def device_memory_stats() -> list[dict] | None:
+    """Per-device memory stats where the backend reports them, honest
+    ``None`` where it does not (CPU) — the same contract as
+    ``device_peak_tflops()`` [ISSUE 16]. Each entry:
+    ``{"id", "platform", "bytes_in_use", "bytes_limit",
+    "peak_bytes_in_use"}`` (peak None when unreported). Mirrored as
+    ``sbt_process_device_*`` gauges on scrape (telemetry/server.py)
+    and carried in ``/debug/capacity``."""
+    out = []
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backends without stats (CPU)
+            stats = None
+        if not stats or not stats.get("bytes_limit"):
+            continue
+        peak = stats.get("peak_bytes_in_use")
+        out.append({
+            "id": int(dev.id),
+            "platform": str(dev.platform),
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "bytes_limit": int(stats["bytes_limit"]),
+            "peak_bytes_in_use": None if peak is None else int(peak),
+        })
+    return out or None
+
+
 def host_rss_bytes() -> int | None:
     """Current resident set size of THIS process, or None when the
     platform exposes neither ``/proc`` nor ``getrusage``.

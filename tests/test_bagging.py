@@ -117,6 +117,7 @@ class TestBaggingClassifier:
         assert set(clf.predict(X)) <= set(names)
         assert clf.score(X, names) > 0.9
 
+    @pytest.mark.slow  # [PR 16 pyramid] ~3.7s chunked-vs-unchunked parity soak; chunking parity stays tier-1 via test_tree.py::TestTreeBagging::test_chunked_fit_matches_vmap
     def test_chunked_equals_unchunked(self, iris):
         X, y = iris
         a = BaggingClassifier(n_estimators=8, seed=4).fit(X, y)

@@ -1,0 +1,501 @@
+"""Capacity & residency plane [ISSUE 16]: the per-(model, version)
+memory ledger (params / compiled-executable / AOT-disk bytes with
+honest ``unmeasured`` instead of fabricated zeros), exact
+reconciliation against the program cache's own totals, demand
+accounting behind the one-attribute-read probe, owner-attributed
+eviction accounting, the ``/debug/capacity`` explainer, the starter
+alert rules, and the swap-rollback no-leak regression."""
+
+import time
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    faults,
+    telemetry,
+)
+from spark_bagging_tpu.serving import EnsembleExecutor, ModelRegistry
+from spark_bagging_tpu.serving import program_cache as _pc
+from spark_bagging_tpu.telemetry import alerts, capacity
+from spark_bagging_tpu.telemetry.registry import SERIES_HELP
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_clock():
+    return time.perf_counter()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    capacity.disable()
+    prev_cache = _pc.install(_pc.ProgramCache(capacity=64))
+    yield
+    _pc.install(prev_cache)
+    capacity.disable()
+    telemetry.reset()
+    telemetry.enable()
+
+
+def _fitted(seed=0, width=6, n_estimators=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(64, width)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=3),
+        n_estimators=n_estimators, seed=seed,
+    ).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def clf():
+    return _fitted(seed=0)
+
+
+@pytest.fixture(scope="module")
+def clf_b():
+    return _fitted(seed=7)
+
+
+def _registry(clf, name="a", **kw):
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16, **kw)
+    reg.register(name, clf, warmup=False, version=1)
+    return reg
+
+
+def _rows(width=6, n=4, seed=1):
+    return np.random.default_rng(seed).normal(
+        size=(n, width)).astype(np.float32)
+
+
+# -- the byte ladder ---------------------------------------------------
+
+class TestExecutableBytes:
+    def test_real_compiled_program_measures_honestly(self):
+        import jax
+        import jax.numpy as jnp
+
+        compiled = jax.jit(lambda x: x * 2.0).lower(
+            jnp.zeros((4,), jnp.float32)
+        ).compile()
+        nbytes, source = capacity.executable_bytes(compiled)
+        assert source in ("memory_analysis", "serialized")
+        assert nbytes is not None and nbytes > 0
+
+    def test_unmeasurable_object_is_none_never_zero(self):
+        nbytes, source = capacity.executable_bytes(object())
+        assert nbytes is None
+        assert source == "unmeasured"
+
+
+class TestClassifyRate:
+    def test_thresholds_and_hysteresis(self):
+        kw = dict(hot_rps=50.0, warm_rps=10.0, hysteresis=0.5)
+        assert capacity.classify_rate(None, 60.0, **kw) == "hot"
+        assert capacity.classify_rate(None, 20.0, **kw) == "warm"
+        assert capacity.classify_rate(None, 1.0, **kw) == "cold"
+        # hot holds down to hysteresis * hot_rps, then demotes
+        assert capacity.classify_rate("hot", 30.0, **kw) == "hot"
+        assert capacity.classify_rate("hot", 20.0, **kw) == "warm"
+        # warm holds down to hysteresis * warm_rps, then cold
+        assert capacity.classify_rate("warm", 6.0, **kw) == "warm"
+        assert capacity.classify_rate("warm", 4.0, **kw) == "cold"
+        # a cold model needs the full threshold to come back
+        assert capacity.classify_rate("cold", 6.0, **kw) == "cold"
+        assert capacity.classify_rate("cold", 10.0, **kw) == "warm"
+
+
+# -- ledger reconciliation ---------------------------------------------
+
+class TestLedger:
+    def test_reconciles_exactly_against_cache_totals(self, clf, clf_b):
+        """The acceptance assertion: sum of per-owner entries/bytes/
+        unmeasured equals the cache's own totals — including an
+        anonymous (never registry-committed) executor's programs,
+        which roll up under the unattributed label instead of
+        vanishing from the sums."""
+        plane = capacity.enable()
+        reg = _registry(clf, "a")
+        reg.register("b", clf_b, warmup=False, version=1)
+        reg.executor("a").forward(_rows())
+        reg.executor("b").forward(_rows(seed=2))
+        # a DIFFERENT fitted model, never committed: its programs
+        # must roll up unattributed (an executor over a registered
+        # model's exact fit shares its fingerprint and attributes)
+        anon = EnsembleExecutor(_fitted(seed=42), min_bucket_rows=4,
+                                max_batch_rows=8)
+        anon.forward(_rows(n=3, seed=3))
+
+        led = plane.ledger()
+        assert led["reconciled"] is True
+        stats = _pc.cache().stats()
+        assert sum(o["entries"] for o in led["owners"].values()) \
+            == stats["entries"]
+        assert sum(o["bytes"] for o in led["owners"].values()) \
+            == stats["bytes"]
+        assert sum(o["unmeasured"] for o in led["owners"].values()) \
+            == stats["unmeasured"]
+        assert "a" in led["owners"] and "b" in led["owners"]
+        assert capacity.UNATTRIBUTED in led["owners"]
+        assert led["committed"]["a@1"]["params_bytes"] > 0
+        assert led["committed"]["a@1"]["live"] is True
+
+    def test_params_bytes_and_placement_are_commit_facts(self, clf):
+        plane = capacity.enable()
+        reg = _registry(clf, "a")
+        rec = led = plane.ledger()["committed"]["a@1"]
+        assert rec["params_bytes"] == capacity.params_nbytes(
+            reg.executor("a"))
+        assert rec["placement"] in ("cpu", "host", "tpu", "gpu")
+        assert telemetry.registry().peek(
+            "sbt_capacity_params_bytes",
+            {"model": "a", "version": "1"},
+        ).value == float(rec["params_bytes"])
+        del led
+
+
+# -- the demand plane --------------------------------------------------
+
+class TestDemand:
+    def test_forward_feeds_labeled_demand_counters(self, clf):
+        plane = capacity.enable()
+        reg = _registry(clf, "a")
+        reg.executor("a").forward(_rows(n=4))
+        reg.executor("a").forward(_rows(n=3, seed=2))
+        s = plane.demand_summary()
+        assert s["a"]["requests"] == 2
+        assert s["a"]["rows"] == 7
+        assert telemetry.registry().peek(
+            "sbt_capacity_demand_requests_total", {"model": "a"}
+        ).value == 2.0
+        assert telemetry.registry().peek(
+            "sbt_capacity_demand_rows_total", {"model": "a"}
+        ).value == 7.0
+
+    def test_anonymous_executors_stay_out_of_the_table(self, clf):
+        plane = capacity.enable()
+        EnsembleExecutor(clf, min_bucket_rows=4,
+                         max_batch_rows=8).forward(_rows(n=2))
+        assert plane.demand_summary() == {}
+
+    def test_classify_ranks_by_cumulative_demand(self, clf, clf_b):
+        plane = capacity.enable(hot_rps=50.0, warm_rps=5.0)
+        reg = _registry(clf, "a")
+        reg.register("b", clf_b, warmup=False, version=1)
+        reg.executor("a").forward(_rows())
+        reg.executor("b").forward(_rows(seed=2))
+        plane.classify(now=0.0)  # baseline window: rates start here
+        for _ in range(3):
+            reg.executor("a").forward(_rows())
+        view = plane.classify(now=0.01)  # a: 300 rps, b: idle
+        assert view["a"]["rank"] == 1
+        assert view["b"]["rank"] == 2
+        assert view["a"]["class"] == "hot"
+        assert view["b"]["class"] == "cold"
+
+    def test_unarmed_probe_is_one_attribute_read(self, clf,
+                                                 monkeypatch):
+        """The zero-overhead contract, both halves: (1) an unarmed
+        forward must never even CALL the plane (a booby-trapped
+        observe_demand proves the probe short-circuits on the module
+        attribute), and (2) the probe itself — exactly what
+        _forward_packed runs — stays far under a microsecond."""
+        capacity.disable()
+
+        def boom(*a, **kw):  # pragma: no cover — must never run
+            raise AssertionError("unarmed forward touched the plane")
+
+        monkeypatch.setattr(capacity.CapacityPlane, "observe_demand",
+                            boom)
+        reg = _registry(clf, "a")
+        reg.executor("a").forward(_rows())
+
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            cap = capacity.ACTIVE
+            if cap is not None:  # pragma: no cover — disabled
+                raise AssertionError
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 2e-6, f"{per_call * 1e9:.0f}ns per probe"
+
+    def test_demand_table_is_fixed_memory(self, clf):
+        plane = capacity.enable()
+        plane.max_models = 2
+        plane.observe_demand("m1", 1, 1, 1)
+        plane.observe_demand("m2", 1, 1, 1)
+        plane.observe_demand("m3", 1, 1, 1)  # over the cap: dropped
+        assert sorted(plane.demand_summary()) == ["m1", "m2"]
+        assert telemetry.registry().counter(
+            "sbt_capacity_demand_dropped_total").value == 1.0
+
+
+# -- eviction attribution ----------------------------------------------
+
+class TestEvictionAttribution:
+    def test_evictions_charge_the_owner(self, clf, clf_b):
+        plane = capacity.enable()
+        small = _pc.install(_pc.ProgramCache(capacity=1))
+        try:
+            reg = _registry(clf, "a")
+            reg.register("b", clf_b, warmup=False, version=1)
+            reg.executor("a").forward(_rows())
+            reg.executor("b").forward(_rows(seed=2))  # evicts a's
+            counts = plane.eviction_counts()
+            assert counts.get("a") == 1
+            (ev,) = plane.recent_evictions()
+            assert ev["owner"] == "a"
+            assert telemetry.registry().peek(
+                "sbt_program_cache_evictions_total", {"model": "a"}
+            ).value == 1.0
+        finally:
+            _pc.install(small)
+
+    def test_labeled_cache_counters_keep_unlabeled_totals(self, clf):
+        """Satellite 1: hit/miss counters gain model= labels while the
+        unlabeled totals keep counting everything (dashboards keyed on
+        the old names must not go dark)."""
+        capacity.enable()
+        reg_t = telemetry.registry()
+        m0 = reg_t.counter("sbt_program_cache_misses_total").value
+        h0 = reg_t.counter("sbt_program_cache_hits_total").value
+        reg = _registry(clf, "a")
+        reg.executor("a").forward(_rows())  # miss + put
+        # a second executor over the SAME fitted model shares the
+        # fingerprint: its build is the labeled cache HIT
+        twin = EnsembleExecutor(clf, min_bucket_rows=8,
+                                max_batch_rows=16)
+        twin.forward(_rows(seed=2))
+        assert reg_t.counter(
+            "sbt_program_cache_misses_total").value > m0
+        assert reg_t.counter("sbt_program_cache_hits_total").value > h0
+        assert reg_t.peek("sbt_program_cache_misses_total",
+                          {"model": "a"}).value >= 1.0
+        assert reg_t.peek("sbt_program_cache_hits_total",
+                          {"model": "a"}).value >= 1.0
+
+
+# -- the swap-rollback regression --------------------------------------
+
+class TestSwapAccounting:
+    def test_failed_swap_leaks_no_ledger_entries(self, clf, clf_b):
+        """Satellite 3 regression: ownership is written ONLY at
+        registry commit, so a swap that dies pre-commit must leave
+        the ledger exactly as it was — no orphaned (model, version)
+        rows, reconciliation still exact."""
+        plane = capacity.enable()
+        reg = _registry(clf, "a")
+        reg.executor("a").forward(_rows())
+        plan = faults.FaultPlan([{
+            "site": "registry.swap.precompile",
+            "action": "error", "at": [1],
+        }])
+        with faults.armed(plan):
+            with pytest.raises(Exception):
+                reg.swap("a", clf_b)
+        led = plane.ledger()
+        assert sorted(led["committed"]) == ["a@1"]
+        assert led["reconciled"] is True
+
+    def test_committed_swap_retires_the_old_version(self, clf, clf_b):
+        plane = capacity.enable()
+        reg = _registry(clf, "a")
+        reg.swap("a", clf_b)
+        led = plane.ledger()
+        assert led["committed"]["a@1"]["live"] is False
+        assert led["committed"]["a@2"]["live"] is True
+
+    def test_degraded_variant_still_reconciles(self):
+        """The degraded-quorum fault response compiles a NEW program
+        variant under the same fingerprint — it must attribute to the
+        same owner and keep the ledger sums exact."""
+        import warnings
+
+        import jax
+
+        from spark_bagging_tpu.parallel import make_mesh
+
+        if jax.device_count() < 4:
+            pytest.skip("needs 4 forced host devices")
+        plane = capacity.enable()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = _fitted(seed=0, width=8, n_estimators=8)
+        mesh = make_mesh(data=1, replica=4,
+                         devices=jax.devices()[:4])
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16,
+                            mesh=mesh)
+        reg.register("m", model, warmup=False, version=1)
+        ex = reg.executor("m")
+        X = _rows(width=8, n=5)
+        ex.forward(X)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ex.degrade_shards([1])
+        ex.forward(X)  # degraded-variant compile, same owner
+        led = plane.ledger()
+        assert led["reconciled"] is True
+        assert led["owners"]["m"]["entries"] >= 2
+        assert capacity.UNATTRIBUTED not in led["owners"]
+
+
+# -- surfaces: series, route, rules, device stats ----------------------
+
+class TestSurfaces:
+    def test_series_help_covers_the_new_series(self):
+        for name in (
+            "sbt_program_cache_bytes",
+            "sbt_capacity_params_bytes",
+            "sbt_capacity_compiled_bytes",
+            "sbt_capacity_resident_entries",
+            "sbt_capacity_unmeasured_entries",
+            "sbt_capacity_aot_disk_bytes",
+            "sbt_capacity_models",
+            "sbt_capacity_demand_requests_total",
+            "sbt_capacity_demand_rows_total",
+            "sbt_capacity_demand_rate_rps",
+            "sbt_capacity_demand_rank",
+            "sbt_capacity_demand_class",
+            "sbt_capacity_demand_dropped_total",
+            "sbt_capacity_cache_headroom_ratio",
+            "sbt_capacity_cold_resident_entries",
+            "sbt_process_device_bytes_in_use",
+            "sbt_process_device_bytes_limit",
+            "sbt_process_device_peak_bytes",
+        ):
+            assert name in SERIES_HELP, name
+
+    def test_debug_capacity_route(self, clf):
+        from spark_bagging_tpu.telemetry import server
+
+        body = server._debug_capacity({})
+        assert body["enabled"] is False  # honest when unarmed
+        plane = capacity.enable()
+        reg = _registry(clf, "a")
+        reg.executor("a").forward(_rows())
+        body = server._debug_capacity({"limit": ["8"]})
+        assert body["enabled"] is True
+        assert body["reconciled"] is True
+        (resident,) = [r for r in body["residents"]
+                       if r["owner"] == "a"]
+        for key in ("lru_position", "bytes_reclaimable", "hits",
+                    "demand_rank", "demand_class", "last_hit_age_s"):
+            assert key in resident, key
+        assert body["demand"]["a"]["requests"] == 1
+        del plane
+
+    def test_default_capacity_rules_grammar_and_fire(self):
+        rules = alerts.default_capacity_rules(
+            fast_window_s=2.0, slow_window_s=5.0, cooldown_s=0.0,
+        )
+        assert [r.name for r in rules] == [
+            "capacity-headroom-low",
+            "capacity-cold-model-resident",
+            "capacity-eviction-churn",
+        ]
+        for r in rules:
+            # round-trip through the wire grammar (config files)
+            assert alerts.AlertRule.from_dict(
+                r.to_dict()).to_dict() == r.to_dict()
+        headroom = rules[0]
+        assert headroom.op == "<" and headroom.kind == "value"
+        assert rules[2].kind == "rate"
+        eng = alerts.AlertEngine([headroom])
+        telemetry.set_gauge("sbt_capacity_cache_headroom_ratio", 0.02)
+        assert eng.evaluate(now=0.0) == []
+        assert eng.evaluate(now=2.0) == []
+        assert eng.evaluate(now=4.0) == []
+        evs = eng.evaluate(now=5.5)
+        assert [e["kind"] for e in evs] == ["alert_fired"]
+
+    def test_export_gauges_headroom_and_cold_residents(self, clf):
+        plane = capacity.enable(hot_rps=50.0, warm_rps=5.0)
+        reg = _registry(clf, "a")
+        reg.executor("a").forward(_rows())
+        plane.export_gauges()
+        snap = _pc.cache().snapshot()
+        expect = (snap["capacity"] - snap["entries_total"]) \
+            / snap["capacity"]
+        assert telemetry.registry().gauge(
+            "sbt_capacity_cache_headroom_ratio"
+        ).value == pytest.approx(expect)
+        # never classified -> cold by default: resident cold entries
+        assert telemetry.registry().gauge(
+            "sbt_capacity_cold_resident_entries"
+        ).value >= 1.0
+
+    def test_device_memory_stats_contract(self):
+        """Satellite 2: honest None on backends that report nothing
+        (CPU), and when present every entry carries the full key
+        set; the scrape-time mirror must never raise either way."""
+        from spark_bagging_tpu.telemetry import server
+        from spark_bagging_tpu.utils.memory import device_memory_stats
+
+        stats = device_memory_stats()
+        if stats is not None:
+            assert stats, "empty list must collapse to None"
+            for d in stats:
+                for key in ("id", "platform", "bytes_in_use",
+                            "bytes_limit", "peak_bytes_in_use"):
+                    assert key in d, key
+        server._refresh_process_gauges()  # mirror path never raises
+
+    def test_fleet_digest_includes_demand_counters(self):
+        from spark_bagging_tpu.telemetry.fleet import (
+            FLEET_DIGEST_SERIES,
+        )
+
+        assert "sbt_capacity_demand_requests_total" \
+            in FLEET_DIGEST_SERIES
+        assert "sbt_capacity_demand_rows_total" in FLEET_DIGEST_SERIES
+
+
+# -- the churn drill's gate --------------------------------------------
+
+class TestChurnChecks:
+    def test_churn_checks_on_synthetic_report(self):
+        from benchmarks.replay import _churn_checks
+
+        good = {
+            "errors": 0,
+            "churn": {"evictions": 3, "unattributed_final": 0,
+                      "reconciled": True, "models_tracked": 6,
+                      "models": 6},
+        }
+        assert all(c["ok"] for c in _churn_checks(good))
+        bad = {
+            "errors": 0,
+            "churn": {"evictions": 0, "unattributed_final": 1,
+                      "reconciled": False, "models_tracked": 5,
+                      "models": 6},
+        }
+        failed = {c["name"] for c in _churn_checks(bad)
+                  if not c["ok"]}
+        assert failed == {"churn_evictions",
+                          "churn_unattributed_final",
+                          "churn_ledger_reconciled",
+                          "churn_models_tracked"}
+
+    def test_churn_is_mutually_exclusive_with_other_drills(self):
+        from benchmarks.replay import replay_median
+
+        with pytest.raises(ValueError, match="separate drills"):
+            replay_median(object(), repeats=1, churn=True, fleet=3)
+        with pytest.raises(ValueError, match="separate drills"):
+            replay_median(object(), repeats=1, churn=True, online=True)
+
+
+def test_zz_capacity_suite_under_budget(_module_clock):
+    """Tier-1 allowance for this module (the PR-11 ratchet
+    discipline): unit-sized throughout — the only compiles are a
+    handful of tiny width-6 programs plus the one 4-device mesh
+    drill."""
+    elapsed = time.perf_counter() - _module_clock
+    assert elapsed < 30.0, (
+        f"tests/test_capacity.py took {elapsed:.1f}s; move the "
+        "offender to -m slow or shrink it"
+    )

@@ -41,6 +41,7 @@ def _diabetes():
 
 
 class TestClassifierTree:
+    @pytest.mark.slow  # [PR 16 pyramid] ~2.7s planted-split recovery soak; split recovery stays tier-1 via TestRegressorTree::test_step_function_recovered
     def test_axis_aligned_split_recovered(self):
         """A single perfectly-separating feature must be found at the root."""
         rng = np.random.default_rng(0)
@@ -80,6 +81,7 @@ class TestClassifierTree:
         assert acc > 0.95
         assert np.isfinite(float(aux["loss"]))
 
+    @pytest.mark.slow  # [PR 16 pyramid] ~4.2s dual-fit equivalence soak; weight semantics stay tier-1 via test_zero_weight_rows_ignored
     def test_poisson_weights_equal_duplicated_rows(self):
         """Weighted Gini over Poisson counts must equal physically
         duplicating rows [SURVEY §7 hard-part 2]."""
@@ -127,6 +129,7 @@ class TestClassifierTree:
         p = np.exp(np.asarray(tree.predict_scores(params, Xj)))
         np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
 
+    @pytest.mark.slow  # [PR 16 pyramid] ~4.8s 4-replica batched-fit soak; vmapped tree fits stay tier-1 via TestTreeBagging::test_chunked_fit_matches_vmap
     def test_vmap_over_replicas(self):
         Xj, yj, _, y = _iris()
         tree = DecisionTreeClassifier(max_depth=3)
