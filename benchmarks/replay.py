@@ -75,8 +75,11 @@ import copy
 import hashlib
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
+from collections import deque
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -1908,6 +1911,383 @@ def replay_churn(
     }
 
 
+def replay_tenants(
+    workload,
+    *,
+    models=None,
+    n_tenants: int = 6,
+    residency_capacity: int = 4,
+    cache_capacity: int | None = None,
+    zipf_s: float = 1.1,
+    width: int = 8,
+    n_estimators: int = 2,
+    seed: int = 0,
+    hot_rps: float = 50.0,
+    warm_rps: float = 20.0,
+    head_quota_rps: float = 25.0,
+    max_delay_ms: float = 2.0,
+    idle_flush_ms: float = 1.0,
+    max_batch_rows: int = 256,
+    max_queue: int = 1024,
+    min_bucket_rows: int = 8,
+    bucket_max_rows: int = 32,
+    refit_total_per_window: int = 4,
+    refit_window_s: float = 0.25,
+    snapshot_every: int = 8,
+    timeout_s: float = 120.0,
+) -> dict:
+    """The tenancy drill (``--tenants``): N named tenants — priority
+    classes cycling interactive/standard/batch, WFQ weights descending
+    with rank — share one registry and one device through a
+    :class:`~spark_bagging_tpu.tenancy.fleet.TenantFleet`, with a
+    residency budget deliberately sized BELOW N and arrivals routed by
+    a seeded Zipf popularity law. One FRESH stack per run — a private
+    ``CapacityPlane``, a private pin-policy ``ProgramCache``, and a
+    throwaway per-run AOT root — so the admission/WFQ/residency
+    transcript is a pure function of ``(workload, specs, seed)`` and
+    asserted byte-identical across ``replay_median`` repeats.
+
+    What the drill exercises, end to end: the Zipf head tenant runs
+    into its ``quota_rps`` token bucket (deterministic per-tenant shed
+    set, reason ``"quota"``); every admitted request is WFQ-tagged and
+    drained in virtual-finish order (pop order IS batch composition —
+    the transcript records it); cold tenants past the residency budget
+    are demoted at registration (executables persisted to the AOT
+    root, programs released, unified-cache entries dropped through the
+    ledger's eviction seam) and restored — counted, never recompiled —
+    on their first hit; the refit budgeter is consulted at every
+    snapshot window for the two hottest tenants, so the per-tenant
+    refit allowance transcript is exercised without running a trainer.
+
+    Compile accounting follows the churn drill's convention: warming N
+    cold tenants is the scripted cold-start cost (``tenants.compiles``)
+    and ``post_warmup_compiles`` reports the measured post-warmup
+    delta, which the gate pins to ZERO — demote/restore round-trips
+    re-adopt AOT executables, they never re-lower. Per-tenant latency
+    (and the tail-tenant p99 the alert rules burn against) is measured
+    wall time: reported, gated as a host band, and kept OUT of the
+    digest."""
+    import numpy as np
+
+    from spark_bagging_tpu import telemetry
+    from spark_bagging_tpu.serving import ModelRegistry
+    from spark_bagging_tpu.serving import program_cache as _pc
+    from spark_bagging_tpu.telemetry import capacity as capacity_mod
+    from spark_bagging_tpu.tenancy import (
+        AdmissionShed, TenantFleet, TenantSpec,
+    )
+    from spark_bagging_tpu.tenancy.residency import cache_pin_policy
+    from spark_bagging_tpu.tenancy.spec import PRIORITY_CLASSES
+
+    telemetry.enable()
+    requests = workload.requests
+    if not requests:
+        raise ValueError("empty workload")
+    if n_tenants < 2:
+        raise ValueError("--tenants needs at least 2 tenants")
+    if not (1 <= residency_capacity < n_tenants):
+        raise ValueError(
+            "--tenants needs 1 <= residency_capacity < n_tenants "
+            f"(got capacity={residency_capacity}, tenants={n_tenants})"
+        )
+    if cache_capacity is None:
+        cache_capacity = max(8, 4 * residency_capacity)
+    if models is None:
+        models = [
+            _default_model(width, n_estimators, seed=seed + 101 * (i + 1))
+            for i in range(n_tenants)
+        ]
+    if len(models) != n_tenants:
+        raise ValueError(
+            f"models list has {len(models)} entries, expected {n_tenants}"
+        )
+
+    # the popularity law, exactly the churn drill's: one seeded draw
+    # assigns every arrival a tenant; rank-1 (t0) gets the Zipf head
+    ranks = np.arange(1, n_tenants + 1, dtype=np.float64)
+    zipf_w = ranks ** (-float(zipf_s))
+    probs = zipf_w / zipf_w.sum()
+    rng = np.random.default_rng(seed)
+    owner_of = rng.choice(n_tenants, size=len(requests), p=probs)
+
+    names = [f"t{i}" for i in range(n_tenants)]
+    specs = [
+        TenantSpec(
+            name=names[i],
+            # classes cycle with rank so every class exists at every
+            # fleet size >= 3; weights descend with popularity rank
+            priority=PRIORITY_CLASSES[i % len(PRIORITY_CLASSES)],
+            weight=float(n_tenants - i),
+            # only the head tenant is quota-bound: its shed set is the
+            # fairness evidence (nobody else pays for its popularity)
+            quota_rps=(head_quota_rps if i == 0 else None),
+        )
+        for i in range(n_tenants)
+    ]
+
+    reg_counters = telemetry.registry()
+
+    def counter(name: str) -> float:
+        return reg_counters.counter(name).value
+
+    c0 = {
+        name: counter(name)
+        for name in (
+            "sbt_serving_compiles_total",
+            "sbt_serving_batches_total",
+            "sbt_program_cache_hits_total",
+            "sbt_program_cache_misses_total",
+            "sbt_program_cache_evictions_total",
+        )
+    }
+
+    plane = capacity_mod.CapacityPlane(hot_rps=hot_rps,
+                                       warm_rps=warm_rps)
+    prev_plane = capacity_mod.install(plane)
+    small = _pc.ProgramCache(capacity=cache_capacity,
+                             pin_policy=cache_pin_policy(plane))
+    prev_cache = _pc.install(small)
+
+    aot_root = tempfile.mkdtemp(prefix="sbt_tenants_aot_")
+    registry = ModelRegistry(
+        min_bucket_rows=min_bucket_rows, max_batch_rows=bucket_max_rows,
+    )
+    fleet = TenantFleet(
+        specs, registry=registry,
+        residency_capacity=residency_capacity, aot_root=aot_root,
+        plane=plane, threaded=False,
+        refit_total_per_window=refit_total_per_window,
+        refit_window_s=refit_window_s,
+        batcher_opts=dict(
+            max_delay_ms=max_delay_ms,
+            idle_flush_ms=idle_flush_ms,
+            max_batch_rows=max_batch_rows,
+            max_queue=max_queue,
+        ),
+    )
+
+    futs: dict[int, object] = {}
+    overloads = 0
+    snapshots: list[dict] = []
+    wfq_order: list[list[str]] = []
+    budget_log: list[dict] = []
+    #: per-tenant FIFO of submitted request indices — WFQ is FIFO
+    #: WITHIN a tenant, so dispatch order maps back to request ids
+    pending: dict[str, deque] = {n: deque() for n in names}
+
+    def snap(window_i: int, vt: float) -> None:
+        plane.classify(now=vt)
+        snapshots.append({
+            "window": window_i,
+            "residents": list(fleet.residency.residents()),
+            "demand": plane.demand_summary(),
+            "evictions": plane.eviction_counts(),
+            "pressure_level": fleet.admission.pressure_level(vt),
+            "admitted": fleet.admission.admitted_counts(),
+            "wfq_served": fleet.wfq.service_totals(),
+        })
+        # the refit-budget transcript: the two hottest tenants by
+        # admitted requests ask for a refit slot at every snapshot
+        admitted = fleet.admission.admitted_counts()
+        hot2 = sorted(admitted, key=lambda t: (-admitted[t], t))[:2]
+        for name in hot2:
+            budget_log.append({
+                "window": window_i,
+                "tenant": name,
+                "allowed": fleet.refit_allowed(name, vt),
+            })
+
+    t_wall0 = time.perf_counter()
+    try:
+        for i, name in enumerate(names):
+            # warmup=True: the full bucket ladder compiles and AOT-
+            # persists at registration (TenantFleet.register's eager
+            # save), so every later demote/restore round-trip is
+            # compile-free — the gate's zero-post-warmup claim
+            fleet.register(name, models[i], warmup=True, version=1)
+        payload = _payloads(
+            workload, registry.executor(names[0]).n_features, seed,
+        )
+        windows = plan_windows(
+            requests,
+            max_delay_s=max_delay_ms / 1e3,
+            idle_flush_s=idle_flush_ms / 1e3,
+        )
+        c_warm = counter("sbt_serving_compiles_total")
+        for w_i, window in enumerate(windows):
+            vt = requests[window[0]].t
+            for idx in window:
+                name = names[int(owner_of[idx])]
+                try:
+                    fleet.submit(
+                        name, payload(idx, requests[idx].rows), now=vt,
+                    )
+                    pending[name].append(idx)
+                except AdmissionShed:
+                    pass  # counted per (tenant, reason) by admission
+            drained = fleet.dispatch(now=vt)
+            for rec in drained:
+                r_idx = pending[rec["tenant"]].popleft()
+                if rec["future"] is not None:
+                    futs[r_idx] = rec["future"]
+                elif rec["shed"] == "overload":
+                    overloads += 1
+            # pop order IS downstream batch composition: record it so
+            # the fairness/determinism claim is digested, not asserted
+            wfq_order.append([rec["tenant"] for rec in drained])
+            if w_i % snapshot_every == 0 or w_i == len(windows) - 1:
+                snap(w_i, vt)
+        wall = time.perf_counter() - t_wall0
+        post_warmup = int(counter("sbt_serving_compiles_total") - c_warm)
+        # read every deterministic surface while the private cache and
+        # plane are still installed — closing state is transcript
+        led = plane.ledger()
+        demand_final = plane.demand_summary()
+        eviction_counts = plane.eviction_counts()
+        residents_final = list(fleet.residency.residents())
+        residency_counts = fleet.residency.counts()
+        residency_events = fleet.residency.events()
+        admitted_final = fleet.admission.admitted_counts()
+        sheds_final = fleet.admission.shed_counts()
+        downstream_sheds = fleet.shed_counts()
+        served_rows = fleet.served_rows()
+        wfq_served = fleet.wfq.service_totals()
+        budget_counts = fleet.budget.counts()
+    finally:
+        fleet.close()
+        _pc.install(prev_cache)
+        capacity_mod.install(prev_plane)
+        shutil.rmtree(aot_root, ignore_errors=True)
+
+    collected = _collect_futures(futs, timeout_s)
+    latencies = collected["latencies"]
+    # per-tenant wall latency (host band: exported, never digested)
+    for rec in collected["records"]:
+        if rec.get("total_ms") is not None:
+            fleet.note_latency(
+                names[int(owner_of[rec["idx"]])], rec["total_ms"])
+    latency_by_tenant = fleet.latency_p99_ms()
+    tail_p99 = fleet.tail_p99_ms()
+    fleet.export_gauges()
+
+    compiles = int(counter("sbt_serving_compiles_total")
+                   - c0["sbt_serving_compiles_total"])
+    cache_hits = int(counter("sbt_program_cache_hits_total")
+                     - c0["sbt_program_cache_hits_total"])
+    cache_misses = int(counter("sbt_program_cache_misses_total")
+                       - c0["sbt_program_cache_misses_total"])
+    evictions = int(counter("sbt_program_cache_evictions_total")
+                    - c0["sbt_program_cache_evictions_total"])
+    demotions = sum(residency_counts["demotions"].values())
+    restores = sum(residency_counts["restores"].values())
+    pin_violations = sum(residency_counts["pin_violations"].values())
+    transcript = {
+        "specs": [s.to_dict() for s in specs],
+        "snapshots": snapshots,
+        "wfq_order": wfq_order,
+        "residency_events": residency_events,
+        "residents_final": residents_final,
+        "admitted": admitted_final,
+        "sheds": sheds_final,
+        "downstream_sheds": downstream_sheds,
+        "served_rows": served_rows,
+        "wfq_served": wfq_served,
+        "budget_log": budget_log,
+        "budget_counts": budget_counts,
+        "demand_final": demand_final,
+        "evictions_by_owner": eviction_counts,
+        "compiles": compiles,
+        "evictions": evictions,
+    }
+    tenants_report = {
+        "tenants": n_tenants,
+        "residency_capacity": residency_capacity,
+        "cache_capacity": cache_capacity,
+        "zipf_s": zipf_s,
+        "head_quota_rps": head_quota_rps,
+        "compiles": compiles,
+        "evictions": evictions,
+        "cache_hits": cache_hits,
+        "cache_misses": cache_misses,
+        "snapshots": len(snapshots),
+        "models_tracked": len(demand_final),
+        "admitted_by_tenant": admitted_final,
+        "sheds_by_tenant": sheds_final,
+        "downstream_sheds": downstream_sheds,
+        "served_rows": served_rows,
+        "served_tenants": sum(1 for v in served_rows.values() if v > 0),
+        "wfq_served": wfq_served,
+        "demotions": demotions,
+        "restores": restores,
+        "pin_violations": pin_violations,
+        "residents_final": residents_final,
+        "demand_final": demand_final,
+        "evictions_by_owner": eviction_counts,
+        "budget": budget_counts,
+        "reconciled": bool(led["reconciled"]),
+        "latency_p99_by_tenant": latency_by_tenant,
+        "tail_p99_ms": tail_p99,
+        "transcript_digest": hashlib.sha256(
+            json.dumps(transcript, sort_keys=True).encode()
+        ).hexdigest(),
+    }
+
+    import jax
+
+    return {
+        "metric": "workload_replay",
+        "schema": REPLAY_SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "mode": "virtual",
+        "speed": 1.0,
+        "seed": seed,
+        "workload": workload.summary(),
+        "workload_digest": workload_digest(workload),
+        "batcher": {
+            "max_delay_ms": max_delay_ms,
+            "idle_flush_ms": idle_flush_ms,
+            "max_batch_rows": max_batch_rows,
+            "max_queue": max_queue,
+        },
+        "burst": 0,
+        "swaps": 0,
+        "n_requests": len(requests),
+        "served": collected["served"],
+        "errors": collected["errors"],
+        "overloads": overloads,
+        "deadline_ms": None,
+        "deadline_sheds": 0,
+        "batches": int(counter("sbt_serving_batches_total")
+                       - c0["sbt_serving_batches_total"]),
+        # warming N cold tenants is the scripted cold-start cost
+        # (tenants.compiles, the churn drill's convention); the
+        # MEASURED post-warmup delta is what the gate pins to zero —
+        # demote/restore re-adopts AOT executables, it never re-lowers
+        "post_warmup_compiles": post_warmup,
+        "swap_compiles": 0,
+        "wall_seconds": round(wall, 6),
+        "rps": (round(collected["served"] / wall, 2)
+                if wall > 0 else None),
+        "latency_ms": {
+            "p50": _percentile(latencies, 0.50),
+            "p95": _percentile(latencies, 0.95),
+            "p99": _percentile(latencies, 0.99),
+            "max": latencies[-1] if latencies else None,
+        },
+        "forward_ms_total": round(collected["forward_ms"], 3),
+        "padding": {"rows": None},
+        "model": {"name": "tenants", "version": 1},
+        "composition_digest": collected["comp_h"].hexdigest(),
+        "output_digest": collected["out_h"].hexdigest(),
+        "drift": None,
+        "chaos": None,
+        "attribution": None,
+        "online": None,
+        "churn": None,
+        "tenants": tenants_report,
+    }
+
+
 def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     """Median-of-``repeats`` replay (the BENCH protocol: thread noise
     on small hosts swings single runs; the median is the stable
@@ -1926,12 +2306,22 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
     fleet = kwargs.get("fleet", 0)
     online = kwargs.get("online", False)
     churn = kwargs.get("churn", False)
-    if sum((bool(fleet), bool(online), bool(churn))) > 1:
+    tenants = kwargs.get("tenants", False)
+    if sum((bool(fleet), bool(online), bool(churn),
+            bool(tenants))) > 1:
         raise ValueError(
-            "--fleet, --online and --churn are separate drills"
+            "--fleet, --online, --churn and --tenants are separate "
+            "drills"
         )
-    if churn:
+    if tenants:
+        drive = replay_tenants
+        kwargs.pop("tenants", None)
+        kwargs.pop("churn", None)
+        kwargs.pop("online", None)
+        kwargs.pop("fleet", None)
+    elif churn:
         drive = replay_churn
+        kwargs.pop("tenants", None)
         kwargs.pop("churn", None)
         kwargs.pop("online", None)
         kwargs.pop("fleet", None)
@@ -1942,10 +2332,12 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
         kwargs.pop("online", None)
         kwargs.pop("fleet", None)
         kwargs.pop("churn", None)
+        kwargs.pop("tenants", None)
     else:
         drive = replay_fleet if fleet else replay
         kwargs.pop("online", None)
         kwargs.pop("churn", None)
+        kwargs.pop("tenants", None)
         if not fleet:
             kwargs.pop("fleet", None)  # replay() takes no fleet kwarg
     runs = [drive(workload, **kwargs) for _ in range(repeats)]
@@ -2035,6 +2427,28 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             f"churn.{key} changed "
                             f"({head['churn'][key]!r} -> "
                             f"{r['churn'][key]!r})"
+                        )
+            if head.get("tenants") is not None:
+                # the tenancy plane's deterministic surface: the
+                # admission/WFQ/residency transcript (wall latencies
+                # excluded — host bands, not workload facts) plus the
+                # per-tenant decision counts it summarises
+                for key in ("transcript_digest", "compiles",
+                            "evictions", "cache_hits", "cache_misses",
+                            "snapshots", "models_tracked",
+                            "admitted_by_tenant", "sheds_by_tenant",
+                            "downstream_sheds", "served_rows",
+                            "served_tenants", "wfq_served",
+                            "demotions", "restores", "pin_violations",
+                            "residents_final", "demand_final",
+                            "evictions_by_owner", "budget",
+                            "reconciled"):
+                    if r["tenants"][key] != head["tenants"][key]:
+                        raise AssertionError(
+                            "determinism violation across repeats: "
+                            f"tenants.{key} changed "
+                            f"({head['tenants'][key]!r} -> "
+                            f"{r['tenants'][key]!r})"
                         )
             if head.get("fleet") is not None:
                 # the fleet plane's whole deterministic surface:
@@ -2224,6 +2638,53 @@ def _churn_checks(report: dict) -> list[dict]:
     ]
 
 
+def _tenants_checks(report: dict) -> list[dict]:
+    """The tenancy gate (``--tenants --check``): residency actually
+    cycled (at least one demote AND one counted restore — a budget
+    that never evicts means the drill never exercised the round-trip),
+    no tenant starved (every tenant served rows — the WFQ floor), the
+    restore path never re-lowered (post-warmup compiles pinned to 0),
+    the demand plane tracked the whole fleet, the eviction ledger
+    reconciles, and the tail-tenant p99 stays inside a generous host
+    band (``latency_`` prefix: a breach exits 3, not 2 — wall time is
+    host-conditional evidence, not a correctness fact)."""
+    t = report.get("tenants") or {}
+
+    def eq(name: str, actual, want) -> dict:
+        return {"name": name, "actual": actual, "limit": want,
+                "op": "==", "ok": actual == want}
+
+    tail = t.get("tail_p99_ms")
+    return [
+        {
+            "name": "tenants_demotions",
+            "actual": t.get("demotions"),
+            "limit": 1, "op": ">=",
+            "ok": bool((t.get("demotions") or 0) >= 1),
+        },
+        {
+            "name": "tenants_restores",
+            "actual": t.get("restores"),
+            "limit": 1, "op": ">=",
+            "ok": bool((t.get("restores") or 0) >= 1),
+        },
+        eq("tenants_served_all", t.get("served_tenants"),
+           t.get("tenants")),
+        eq("tenants_models_tracked", t.get("models_tracked"),
+           t.get("tenants")),
+        eq("tenants_ledger_reconciled", t.get("reconciled"), True),
+        eq("tenants_post_warmup_compiles",
+           report.get("post_warmup_compiles"), 0),
+        eq("tenants_errors", report.get("errors"), 0),
+        {
+            "name": "latency_tail_p99_ms",
+            "actual": tail,
+            "limit": 250.0, "op": "<=",
+            "ok": bool(tail is not None and tail <= 250.0),
+        },
+    ]
+
+
 def check_report(report: dict, *, spec=None, baseline: dict | None = None,
                  rps_tolerance: float | None = None,
                  latency_tolerance: float | None = None):
@@ -2249,6 +2710,9 @@ def check_report(report: dict, *, spec=None, baseline: dict | None = None,
     if report.get("churn") is not None:
         checks += _churn_checks(report)
         kind += "+churn"
+    if report.get("tenants") is not None:
+        checks += _tenants_checks(report)
+        kind += "+tenants"
     if baseline is not None:
         kw = {}
         if rps_tolerance is not None:
@@ -2395,6 +2859,25 @@ def main(argv: list[str] | None = None) -> int:
                           "(must be < --churn-models)")
     drv.add_argument("--churn-zipf", type=float, default=1.1,
                      help="Zipf exponent of the churn drill's "
+                          "popularity law (higher = more skewed)")
+    drv.add_argument("--tenants", type=int, default=0,
+                     help="the tenancy drill: N named tenants "
+                          "(priority classes cycling interactive/"
+                          "standard/batch, WFQ weights descending "
+                          "with Zipf rank, the head tenant quota-"
+                          "bound) share one registry through a "
+                          "TenantFleet with a residency budget sized "
+                          "BELOW N (--tenants-capacity) — the "
+                          "admission/WFQ/residency transcript is a "
+                          "pure function of (workload, seed) and "
+                          "gates on demote/restore round-trips, zero "
+                          "post-warmup compiles, no starved tenant, "
+                          "and exact ledger reconciliation")
+    drv.add_argument("--tenants-capacity", type=int, default=4,
+                     help="residency budget for the tenancy drill "
+                          "(must be < --tenants)")
+    drv.add_argument("--tenants-zipf", type=float, default=1.1,
+                     help="Zipf exponent of the tenancy drill's "
                           "popularity law (higher = more skewed)")
     drv.add_argument("--drift-at", type=float, default=None,
                      help="drift onset as a fraction of the workload "
@@ -2555,7 +3038,49 @@ def main(argv: list[str] | None = None) -> int:
     if args.save_workload:
         wl.save(args.save_workload)
 
-    if args.churn:
+    if args.tenants:
+        if args.mode != "virtual":
+            ap.error("--tenants is a virtual-clock drill (the "
+                     "admission/WFQ/residency interleaving IS the "
+                     "experiment)")
+        if args.model_checkpoint:
+            ap.error("--tenants builds its own N seeded models; a "
+                     "single checkpoint cannot populate the fleet")
+        for flag, val in (("--churn", args.churn),
+                          ("--fleet", args.fleet),
+                          ("--online", args.online),
+                          ("--drift", args.drift),
+                          ("--swaps", args.swaps),
+                          ("--burst", args.burst),
+                          ("--throttle-ms", args.throttle_ms),
+                          ("--deadline-ms", args.deadline_ms),
+                          ("--devices", args.devices)):
+            if val:
+                ap.error(f"{flag} does not combine with --tenants "
+                         "(the drill scripts its own fleet, cache "
+                         "and residency budget)")
+        # build the N models ONCE, outside replay_median: repeats must
+        # re-drive the same fitted fleet, not refit it
+        models = [
+            _default_model(width, args.n_estimators,
+                           seed=args.seed + 101 * (i + 1))
+            for i in range(args.tenants)
+        ]
+        report = replay_median(
+            wl, repeats=args.repeats,
+            tenants=True, models=models,
+            n_tenants=args.tenants,
+            residency_capacity=args.tenants_capacity,
+            zipf_s=args.tenants_zipf,
+            max_delay_ms=args.max_delay_ms,
+            idle_flush_ms=args.idle_flush_ms,
+            max_batch_rows=args.max_batch_rows,
+            max_queue=args.max_queue,
+            min_bucket_rows=args.min_bucket_rows,
+            bucket_max_rows=args.bucket_max_rows,
+            seed=args.seed,
+        )
+    elif args.churn:
         if args.mode != "virtual":
             ap.error("--churn is a virtual-clock drill (the admission/"
                      "eviction interleaving IS the experiment)")
@@ -2817,6 +3342,20 @@ def main(argv: list[str] | None = None) -> int:
             "unattributed_final": c["unattributed_final"],
             "reconciled": c["reconciled"],
             "transcript_digest": c["transcript_digest"][:16],
+        }
+    if report.get("tenants") is not None:
+        t = report["tenants"]
+        summary["tenants"] = {
+            "tenants": t["tenants"],
+            "residency_capacity": t["residency_capacity"],
+            "served_tenants": t["served_tenants"],
+            "demotions": t["demotions"],
+            "restores": t["restores"],
+            "pin_violations": t["pin_violations"],
+            "sheds_by_tenant": t["sheds_by_tenant"],
+            "tail_p99_ms": t["tail_p99_ms"],
+            "reconciled": t["reconciled"],
+            "transcript_digest": t["transcript_digest"][:16],
         }
     print(json.dumps(summary))
     print(f"report: {out}")

@@ -60,8 +60,10 @@ class Scenario:
     drift-refit drill (``replay_online`` — the drive kwargs are its
     drift/refit knobs); ``churn`` drives the capacity drill
     (``replay_churn`` — the dict carries ``n_models`` /
-    ``cache_capacity`` / ``zipf_s``); ``parity_with`` additionally asserts
-    this scenario's output digest equals ANOTHER scenario's committed
+    ``cache_capacity`` / ``zipf_s``); ``tenants`` drives the tenancy
+    drill (``replay_tenants`` — the dict carries ``n_tenants`` /
+    ``residency_capacity`` / ``zipf_s``); ``parity_with`` additionally
+    asserts this scenario's output digest equals ANOTHER scenario's committed
     output digest (the sharded-parity contract).
     """
 
@@ -77,6 +79,7 @@ class Scenario:
     fleet: int = 0
     online: bool = False
     churn: dict[str, Any] | None = None
+    tenants: dict[str, Any] | None = None
     parity_with: str | None = None
     tags: tuple[str, ...] = ()
 
@@ -298,6 +301,27 @@ register(Scenario(
     churn={"n_models": 6, "cache_capacity": 4, "zipf_s": 1.1},
     slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
     tags=("capacity", "serving"),
+))
+
+register(Scenario(
+    name="multi-tenant-zipf",
+    description="the tenancy drill [ISSUE 17]: 6 named tenants — "
+                "priority classes cycling interactive/standard/batch, "
+                "WFQ weights descending with Zipf rank, the head "
+                "tenant quota-bound — share one registry through a "
+                "TenantFleet with a residency budget of 4; the "
+                "admission/WFQ/residency transcript (shed sets, pop "
+                "order, demote/restore events, demand ranks) is "
+                "digest-identical, every demoted tenant restores from "
+                "its AOT cache without recompiling, no tenant "
+                "starves, and the capacity ledger reconciles exactly",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 110, "width": 8, "bucket_bounds": (8, 32)},
+    model={"n_estimators": 2, "seed": 0},
+    serving=dict(_SERVING),
+    tenants={"n_tenants": 6, "residency_capacity": 4, "zipf_s": 1.1},
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("tenancy", "capacity", "serving"),
 ))
 
 register(Scenario(

@@ -110,9 +110,9 @@ def _model_for(sc: Scenario):
     return _problem_for(sc)[0]
 
 
-def _churn_models_for(sc: Scenario, seed: int) -> list:
-    """The churn drill's K-model fleet, one entry per registered
-    version, seeded by the drill's ``seed + 101 * (i + 1)`` rule (the
+def _seeded_models_for(sc: Scenario, seed: int, count: int) -> list:
+    """A K-model fleet, one entry per registered version/tenant,
+    seeded by the drills' shared ``seed + 101 * (i + 1)`` rule (the
     same rule the CLI uses) and memoised through ``_MODEL_CACHE`` so
     repeats and re-runs re-drive the same fitted fleet."""
     from benchmarks.replay import _default_problem
@@ -120,7 +120,7 @@ def _churn_models_for(sc: Scenario, seed: int) -> list:
     width = int(sc.workload.get("width", 16))
     n_est = int(sc.model.get("n_estimators", 8))
     models = []
-    for i in range(int(sc.churn["n_models"])):
+    for i in range(count):
         key = (width, n_est, seed + 101 * (i + 1))
         if key not in _MODEL_CACHE:
             _MODEL_CACHE[key] = _default_problem(width, n_est,
@@ -151,11 +151,26 @@ def run_scenario(sc: Scenario,
     reps = repeats if repeats is not None else sc.repeats
     min_rows = int(sc.serving.get("min_bucket_rows", 8))
     max_rows = int(sc.serving.get("max_batch_rows", 32))
+    if sc.tenants is not None:
+        tenants_kwargs = dict(sc.tenants)
+        n_tenants = int(tenants_kwargs.pop("n_tenants"))
+        return R.replay_median(
+            wl, repeats=reps, tenants=True,
+            models=_seeded_models_for(sc, seed, n_tenants),
+            n_tenants=n_tenants,
+            residency_capacity=int(
+                tenants_kwargs.pop("residency_capacity")),
+            zipf_s=float(tenants_kwargs.pop("zipf_s", 1.1)),
+            seed=seed,
+            min_bucket_rows=min_rows, bucket_max_rows=max_rows,
+            **drive, **tenants_kwargs,
+        )
     if sc.churn is not None:
         churn_kwargs = dict(sc.churn)
         return R.replay_median(
             wl, repeats=reps, churn=True,
-            models=_churn_models_for(sc, seed),
+            models=_seeded_models_for(sc, seed,
+                                      int(sc.churn["n_models"])),
             n_models=int(churn_kwargs.pop("n_models")),
             cache_capacity=int(churn_kwargs.pop("cache_capacity")),
             zipf_s=float(churn_kwargs.pop("zipf_s", 1.1)),
@@ -227,6 +242,9 @@ def digests_of(report: dict[str, Any]) -> dict[str, str]:
     churn = report.get("churn")
     if churn is not None:
         d["churn_transcript"] = churn["transcript_digest"]
+    tenants = report.get("tenants")
+    if tenants is not None:
+        d["tenants_transcript"] = tenants["transcript_digest"]
     return d
 
 
@@ -462,7 +480,7 @@ def run_conformance(
         # scenario-class sections ride the report verbatim so the
         # conformance JSON is a one-stop incident view
         for section in ("attribution", "chaos", "fleet", "drift",
-                        "online", "churn"):
+                        "online", "churn", "tenants"):
             if report.get(section) is not None:
                 row[section] = report[section]
         rows.append(row)

@@ -175,6 +175,7 @@ class OnlineTrainer:
         publish_dir: str | None = None,
         save_executables: bool = False,
         trigger_rules: tuple[str, ...] | None = None,
+        refit_budget: Any | None = None,
         updater_opts: dict[str, Any] | None = None,
     ) -> None:
         if epochs < 1:
@@ -209,6 +210,15 @@ class OnlineTrainer:
         self._labels = {"model": self.model_name}
         self.trigger_rules = (tuple(trigger_rules)
                               if trigger_rules is not None else None)
+        # per-tenant refit budgeting [ISSUE 17]: a ``now -> bool`` hook
+        # (``tenancy.RefitBudgeter.for_tenant``) consulted at TRIGGER
+        # time — a denied trigger is dropped (counted), never queued,
+        # so one drifting hot tenant cannot monopolize the fleet's
+        # refit compute while the tail's alerts rot in a queue
+        if refit_budget is not None and not callable(refit_budget):
+            raise ValueError("refit_budget must be callable (now -> bool)")
+        self.refit_budget = refit_budget
+        self.budget_denied = 0
         self.updater_opts = dict(updater_opts or {})
         self._lock = make_lock("online.trainer")
         self._pending: deque[dict] = deque()
@@ -246,7 +256,19 @@ class OnlineTrainer:
         (plus the candidate's reference profile) built on them would
         adapt to a mixture instead of the regime the model must serve
         next. Sizing ``collect_rows`` to the buffer capacity makes the
-        drained window exactly the post-trigger traffic."""
+        drained window exactly the post-trigger traffic.
+
+        With a ``refit_budget`` hook installed, the budget decides
+        HERE: a denied trigger is dropped and counted
+        (``sbt_online_refits_budget_denied_total{model=}``) — the next
+        drift alert re-triggers, by which time the budget window may
+        have turned."""
+        if self.refit_budget is not None and not self.refit_budget(now):
+            with self._lock:
+                self.budget_denied += 1
+            telemetry.inc("sbt_online_refits_budget_denied_total",
+                          labels=self._labels)
+            return
         ready_at = (self.buffer.rows_seen + self.collect_rows
                     if self.collect_rows else 0)
         with self._lock:
@@ -590,6 +612,7 @@ class OnlineTrainer:
                 "published": self.published,
                 "rejected": self.rejected,
                 "skipped": self.skipped,
+                "budget_denied": self.budget_denied,
                 "errors": self.errors,
                 "pending": len(self._pending),
                 "transcript": list(self.transcript),

@@ -132,6 +132,33 @@ def cache_key(executor: Any) -> dict[str, Any]:
     }
 
 
+def covers(executor: Any, path: str) -> bool:
+    """True iff the cache at ``path`` was written for exactly this
+    executor's program key and already holds every bucket the executor
+    currently has compiled — the test a residency demotion uses to
+    SKIP re-saving. The skip is load-bearing, not an optimisation:
+    re-serializing an executable that was itself deserialized is not
+    round-trip-stable on every backend (XLA:CPU loses kernel symbols),
+    so a demote→restore→demote cycle that re-saved would clobber a
+    good cache with unloadable payloads."""
+    manifest_path = os.path.join(path, MANIFEST)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if manifest.get("key") != cache_key(executor):
+        return False
+    entries = manifest.get("buckets")
+    if not isinstance(entries, dict):
+        return False
+    try:
+        saved = {int(b) for b in entries}
+    except (TypeError, ValueError):
+        return False
+    return set(executor.compiled_buckets) <= saved
+
+
 def save_executables(executor: Any, path: str) -> tuple[int, ...]:
     """Persist every bucket executable ``executor`` has compiled into
     directory ``path`` (atomic install: built in a tmp dir, then

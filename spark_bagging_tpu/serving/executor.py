@@ -328,6 +328,29 @@ class EnsembleExecutor:
 
         return restore_executables(self, path)
 
+    def release_programs(self) -> tuple[int, ...]:
+        """Drop every compiled bucket executable — the tenant-demotion
+        seam [ISSUE 17]. Executors hold their programs in-instance
+        (cache eviction alone never frees them), so a residency policy
+        that wants a cold model's device footprint gone must call
+        THIS: the in-instance ladder and the replica twins are
+        cleared, and the unified cache drops this fingerprint's
+        entries (charged through the capacity plane's eviction seam).
+        The executor stays fully serveable — the next request lowers
+        on demand, or :meth:`restore_executables` re-adopts a
+        persisted ladder with zero compiles. Returns the buckets
+        released."""
+        with self._build_lock:
+            released = tuple(sorted(self._compiled))
+            self._compiled.clear()
+            self._replica_compiled.clear()
+            self.bucket_costs.clear()
+        _pc.cache().drop_fingerprint(self.fingerprint)
+        if released:
+            telemetry.inc("sbt_serving_programs_released_total",
+                          float(len(released)))
+        return released
+
     # -- degraded-quorum serving (mesh executors) ----------------------
 
     @property

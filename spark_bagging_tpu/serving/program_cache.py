@@ -180,10 +180,17 @@ class ProgramCache:
     their exact pre-existing meaning for dashboard continuity.
     """
 
-    def __init__(self, capacity: int = 256):
+    def __init__(self, capacity: int = 256,
+                 pin_policy: Callable[[str], bool] | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
+        #: opt-in demand-aware victim selection [ISSUE 17]: a
+        #: fingerprint predicate (e.g. ``tenancy.residency.
+        #: cache_pin_policy``) whose True entries are skipped in LRU
+        #: eviction order. None (default) keeps the strict-LRU
+        #: behavior every committed churn baseline was recorded under.
+        self._pin_policy = pin_policy
         self._lock = make_lock("serving.program_cache")
         self._entries: OrderedDict[ProgramKey, _Entry] = OrderedDict()
         self._seq = 0
@@ -230,11 +237,25 @@ class ProgramCache:
             self._seq += 1
             self._entries[key] = _Entry(compiled, nbytes, source,
                                         self._seq)
+            pin_violations = 0
             while len(self._entries) > self.capacity:
-                evicted.append(self._entries.popitem(last=False))
+                victim, violated = self._pick_victim_locked(key)
+                pin_violations += int(violated)
+                evicted.append((victim, self._entries.pop(victim)))
             size = len(self._entries)
             total_bytes = sum(e.nbytes or 0
                               for e in self._entries.values())
+        if pin_violations:
+            # the hot set alone overflows the cache: the pin policy
+            # had to sacrifice a pinned entry — the capacity signal
+            # that this cache is undersized for its fleet. Unlabeled
+            # total first (the series alert rules sample), then the
+            # locating twin.
+            telemetry.inc("sbt_tenancy_pin_violations_total",
+                          float(pin_violations))
+            telemetry.inc("sbt_tenancy_pin_violations_total",
+                          float(pin_violations),
+                          labels={"level": "cache"})
         if evicted:
             telemetry.inc("sbt_program_cache_evictions_total",
                           float(len(evicted)))
@@ -254,6 +275,67 @@ class ProgramCache:
         telemetry.set_gauge("sbt_program_cache_bytes",
                             float(total_bytes))
         return compiled
+
+    def _pick_victim_locked(
+            self, protect: ProgramKey) -> tuple[ProgramKey, bool]:
+        """The next eviction victim (never ``protect``, the entry just
+        inserted). Strict LRU head without a pin policy — the exact
+        pre-ISSUE-17 behavior every committed churn baseline was
+        recorded under. With one, the first UNPINNED key in LRU order;
+        when everything is pinned the LRU head goes anyway, flagged
+        (``True`` in the return) so the caller can count it."""
+        if self._pin_policy is None:
+            return next(iter(self._entries)), False
+        fallback: ProgramKey | None = None
+        for k in self._entries:
+            if k == protect:
+                continue
+            if fallback is None:
+                fallback = k
+            if not self._pin_policy(k.fingerprint):
+                return k, False
+        if fallback is None:  # capacity 1 and only the fresh insert
+            return protect, False
+        return fallback, True
+
+    def drop_fingerprint(self, fingerprint: str) -> int:
+        """Remove every entry compiled from ``fingerprint`` — the
+        tenant-demotion seam [ISSUE 17]: the residency manager calls
+        this after releasing a demoted executor's in-instance
+        programs, so a cold tenant's cache footprint goes to zero
+        instead of aging out. Dropped entries are charged through the
+        SAME counters + capacity-plane eviction seam as pressure
+        evictions, keeping the ledger's attribution reconciled.
+        Returns the number of entries dropped."""
+        dropped: list[tuple[ProgramKey, _Entry]] = []
+        with self._lock:
+            keys = [k for k in self._entries
+                    if k.fingerprint == fingerprint]
+            for k in keys:
+                dropped.append((k, self._entries.pop(k)))
+            size = len(self._entries)
+            total_bytes = sum(e.nbytes or 0
+                              for e in self._entries.values())
+        if not dropped:
+            return 0
+        telemetry.inc("sbt_program_cache_evictions_total",
+                      float(len(dropped)))
+        cap = _capacity.ACTIVE
+        for ekey, entry in dropped:
+            if cap is None:
+                continue
+            owner = cap.observe_eviction(
+                fingerprint=ekey.fingerprint, bucket=ekey.bucket,
+                variant=ekey.variant, nbytes=entry.nbytes,
+                seq=entry.seq_inserted,
+            )
+            if owner != _capacity.UNATTRIBUTED:
+                telemetry.inc("sbt_program_cache_evictions_total",
+                              labels={"model": owner})
+        telemetry.set_gauge("sbt_program_cache_entries", float(size))
+        telemetry.set_gauge("sbt_program_cache_bytes",
+                            float(total_bytes))
+        return len(dropped)
 
     def get_or_build(self, key: ProgramKey,
                      build: Callable[[], Any]) -> tuple[Any, bool]:

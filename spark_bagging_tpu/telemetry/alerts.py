@@ -499,11 +499,14 @@ def default_capacity_rules(
     *,
     headroom_threshold: float = 0.1,
     eviction_rate_threshold: float = 1.0,
+    tail_p99_threshold_ms: float = 250.0,
+    quota_shed_rate_threshold: float = 1.0,
     fast_window_s: float = 30.0,
     slow_window_s: float = 300.0,
     cooldown_s: float = 300.0,
     labels: dict[str, Any] | None = None,
     name_prefix: str = "",
+    tenancy: bool = True,
 ) -> list[AlertRule]:
     """The starter rule set for the capacity plane [ISSUE 16], reading
     the gauges ``telemetry.capacity`` refreshes on every scrape:
@@ -518,7 +521,54 @@ def default_capacity_rules(
       ``eviction_rate_threshold``/s: the cache capacity sits below the
       working set and compiles are being re-paid (the thrash signal
       the ``cache-churn`` drill manufactures deliberately).
+
+    With ``tenancy=True`` (default) the tenant-aware variants
+    [ISSUE 17] ride along, reading the series the tenancy plane
+    exports (absent series never fire — a process with no fleet pays
+    nothing for carrying the rules):
+
+    - **tenancy-tail-latency-burn** — the tail tenants' p99
+      (``sbt_tenancy_tail_p99_ms``, everyone but the Zipf head) burned
+      above ``tail_p99_threshold_ms`` across both windows: the fleet
+      is serving its head at the tail's expense;
+    - **tenancy-quota-shed-rate** — sustained admission sheds above
+      ``quota_shed_rate_threshold``/s: quotas/priorities are actively
+      rejecting traffic, not just backstopping a burst;
+    - **tenancy-pin-violation** — a residency/cache eviction had to
+      sacrifice a hot-pinned tenant: the residency budget (or cache
+      capacity) is smaller than the hot set.
     """
+    tenancy_rules = [
+        AlertRule(
+            f"{name_prefix}tenancy-tail-latency-burn",
+            "sbt_tenancy_tail_p99_ms", labels=labels,
+            threshold=tail_p99_threshold_ms, kind="value", op=">",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="tail-tenant p99 latency burning above "
+                        "threshold: the fleet serves its head at the "
+                        "tail's expense",
+        ),
+        AlertRule(
+            f"{name_prefix}tenancy-quota-shed-rate",
+            "sbt_tenancy_shed_total", labels=labels,
+            threshold=quota_shed_rate_threshold, kind="rate", op=">",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="sustained admission shed rate: quotas/"
+                        "priorities rejecting steady traffic, not a "
+                        "burst",
+        ),
+        AlertRule(
+            f"{name_prefix}tenancy-pin-violation",
+            "sbt_tenancy_pin_violations_total", labels=labels,
+            threshold=0.0, kind="rate", op=">",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="hot-pinned tenants being evicted: the "
+                        "residency budget is smaller than the hot set",
+        ),
+    ] if tenancy else []
     return [
         AlertRule(
             f"{name_prefix}capacity-headroom-low",
@@ -548,7 +598,7 @@ def default_capacity_rules(
                         "capacity below the working set, compiles "
                         "being re-paid",
         ),
-    ]
+    ] + tenancy_rules
 
 
 # -- process default ----------------------------------------------------

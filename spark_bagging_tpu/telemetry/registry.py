@@ -183,6 +183,20 @@ SERIES_HELP: dict[str, str] = {
     "sbt_capacity_demand_dropped_total": "Demand observations dropped by the fixed-memory model cap (capacity plane max_models)",
     "sbt_capacity_cache_headroom_ratio": "Free-slot ratio of the program cache: (capacity - entries) / capacity (gauge)",
     "sbt_capacity_cold_resident_entries": "Program-cache entries owned by cold-demand-class models (gauge; the reclaim candidates)",
+    "sbt_tenancy_tenants": "Tenants configured in the installed TenantFleet (gauge)",
+    "sbt_tenancy_admitted_total": "Requests admitted by the tenancy admission controller (label tenant)",
+    "sbt_tenancy_shed_total": "Requests shed by admission policy (labels tenant + reason: quota or priority)",
+    "sbt_tenancy_overloads_total": "Downstream Overloaded sheds fed into the admission pressure window",
+    "sbt_tenancy_pressure_level": "Admission pressure state: 0 normal / 1 shed batch class / 2 shed standard too (gauge)",
+    "sbt_tenancy_demotions_total": "Tenants demoted from residency (programs released, AOT-persisted; label tenant)",
+    "sbt_tenancy_restores_total": "Demoted tenants restored from their AOT cache on first hit (label tenant)",
+    "sbt_tenancy_resident_tenants": "Tenants currently resident (compiled) under the residency budget (gauge)",
+    "sbt_tenancy_pin_violations_total": "Evictions/demotions that had to sacrifice a hot-pinned entry (label tenant, or level=cache)",
+    "sbt_tenancy_refit_denied_total": "Online-refit triggers denied by the per-tenant refit budget (label tenant)",
+    "sbt_tenancy_latency_p99_ms": "Per-tenant served-request p99 latency in ms (gauge, label tenant; host-band, never digested)",
+    "sbt_tenancy_tail_p99_ms": "p99 latency in ms over the tail tenants - everyone but the Zipf head (gauge; the fleet SLO burn signal)",
+    "sbt_serving_programs_released_total": "Compiled bucket executables dropped by executor release_programs (tenant demotion)",
+    "sbt_online_refits_budget_denied_total": "Refit triggers dropped by the per-tenant refit budget hook (label model)",
     "sbt_process_device_bytes_in_use": "Device memory currently allocated, where the backend reports it (gauge, label device)",
     "sbt_process_device_bytes_limit": "Device memory capacity, where the backend reports it (gauge, label device)",
     "sbt_process_device_peak_bytes": "Peak device memory allocated since process start, where reported (gauge, label device)",

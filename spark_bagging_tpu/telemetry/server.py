@@ -44,6 +44,11 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
   the program cache, the per-resident eviction-decision explainer
   (LRU position, demand rank/class, bytes reclaimable, last-hit age),
   demand table, recent owner-attributed evictions, device memory;
+- ``GET /debug/tenancy`` — the installed tenant fleet
+  (``spark_bagging_tpu/tenancy/``): per-tenant specs, admission
+  pressure state + decision counts, WFQ service audit, residency
+  residents/demotions/restores/pin violations, refit-budget state,
+  per-tenant latency p99s;
 - ``GET /debug/profile?seconds=N`` — on-demand live device profiling:
   starts a single-flight ``jax.profiler`` capture that auto-stops
   after N seconds (hard-capped) into ``telemetry_dir()/profiles/``;
@@ -308,6 +313,22 @@ def _debug_capacity(query: dict[str, list[str]]) -> dict[str, Any]:
     return capacity.capacity_report(limit=limit)
 
 
+def _debug_tenancy() -> dict[str, Any]:
+    """The installed :class:`~spark_bagging_tpu.tenancy.fleet.
+    TenantFleet`'s full policy report — admission state machine, WFQ
+    audit, residency transcript counts, refit budget. An honest
+    explicit shape when no fleet is installed (a single-model process
+    is the common case, not an error)."""
+    from spark_bagging_tpu import tenancy
+
+    fleet = tenancy.get()
+    if fleet is None:
+        return {"enabled": False,
+                "note": "no TenantFleet installed (tenancy.install)"}
+    fleet.export_gauges()
+    return {"enabled": True, **fleet.report()}
+
+
 def _debug_profile(query: dict[str, list[str]]) -> tuple[int, dict]:
     """On-demand live device profiling: ``?seconds=N`` starts a
     jax.profiler capture that auto-stops after N seconds (clamped to
@@ -445,6 +466,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _debug_history(query))
             elif url.path == "/debug/capacity":
                 self._send_json(200, _debug_capacity(query))
+            elif url.path == "/debug/tenancy":
+                self._send_json(200, _debug_tenancy())
             elif url.path == "/debug/profile":
                 code, body = _debug_profile(query)
                 self._send_json(code, body)
@@ -461,7 +484,8 @@ class _Handler(BaseHTTPRequestHandler):
                         "/debug/spans", "/debug/runs",
                         "/debug/workload", "/debug/drift",
                         "/debug/tail", "/debug/history",
-                        "/debug/capacity", "/debug/profile",
+                        "/debug/capacity", "/debug/tenancy",
+                        "/debug/profile",
                         "/fleet/metrics", "/fleet/varz",
                         "/fleet/healthz", "/fleet/incidents",
                     ],

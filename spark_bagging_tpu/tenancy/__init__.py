@@ -1,0 +1,100 @@
+"""Tenancy plane — multi-tenant fleet serving [ISSUE 17].
+
+One process, hundreds of living models: the north-star workload
+(ROADMAP item 2) is a Zipf-popular fleet where a handful of tenants
+carry most of the traffic and a long tail must neither starve nor
+crowd the hot set out of device memory. This package generalizes the
+single-model ``ModelRegistry`` + ``MicroBatcher`` pair into that
+fleet plane, built from four enforcement pieces that all ride the
+existing replay/digest discipline (every decision a pure function of
+(workload, seed) under an injected virtual clock):
+
+- :class:`~spark_bagging_tpu.tenancy.spec.TenantSpec` — the named
+  endpoint contract: priority class, WFQ weight, rps/row quotas,
+  refit weight.
+- :class:`~spark_bagging_tpu.tenancy.admission.AdmissionController`
+  — turns the existing ``Overloaded`` backpressure into an
+  enforcement point: deterministic token-bucket quotas, and a
+  pressure state machine that sheds low-priority classes first when
+  the device is overloaded (counted per tenant + reason).
+- :class:`~spark_bagging_tpu.tenancy.wfq.WFQScheduler` — virtual-
+  finish-time weighted fair queuing across tenants sharing a device;
+  batch composition is the pop order, a pure function of the
+  enqueue stream.
+- :class:`~spark_bagging_tpu.tenancy.residency.ResidencyManager` —
+  demand-driven residency over an executor fleet larger than what
+  stays compiled: cold tenants are demoted (programs released, AOT
+  executables already persisted) and restored on first hit via
+  ``serving/aot_cache.py`` — counted, never wrong answers; hot
+  tenants are pinned via the capacity plane's demand classes.
+- :class:`~spark_bagging_tpu.tenancy.budget.RefitBudgeter` — per-
+  tenant online-refit budgeting so one drifting hot tenant cannot
+  starve the tail's refit compute (arxiv 1312.5021's budgeted
+  online bootstrap, applied fleet-wide).
+
+:class:`~spark_bagging_tpu.tenancy.fleet.TenantFleet` composes them
+over one registry; ``install()`` publishes a fleet for the telemetry
+server's ``/debug/tenancy`` route. The gate is
+``benchmarks/replay.py --tenants N`` (scenario ``multi-tenant-zipf``).
+"""
+
+from __future__ import annotations
+
+from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.tenancy.admission import (
+    AdmissionController,
+    AdmissionShed,
+    QuotaExceeded,
+)
+from spark_bagging_tpu.tenancy.budget import RefitBudgeter
+from spark_bagging_tpu.tenancy.fleet import TenantFleet
+from spark_bagging_tpu.tenancy.residency import ResidencyManager
+from spark_bagging_tpu.tenancy.spec import (
+    PRIORITY_CLASSES,
+    PRIORITY_LEVEL,
+    TenantSpec,
+)
+from spark_bagging_tpu.tenancy.wfq import WFQScheduler
+
+__all__ = [
+    "PRIORITY_CLASSES",
+    "PRIORITY_LEVEL",
+    "AdmissionController",
+    "AdmissionShed",
+    "QuotaExceeded",
+    "RefitBudgeter",
+    "ResidencyManager",
+    "TenantFleet",
+    "TenantSpec",
+    "WFQScheduler",
+    "get",
+    "install",
+    "uninstall",
+]
+
+# -- process-default fleet (the /debug/tenancy seam) -------------------
+# Mirrors telemetry.alerts' default-engine seam: a serving process
+# installs its fleet once; the exposition server reads it at request
+# time without importing this package eagerly.
+
+_default_lock = make_lock("tenancy.default")
+_default_fleet: TenantFleet | None = None
+
+
+def install(fleet: TenantFleet) -> TenantFleet:
+    """Publish ``fleet`` as the process default (``/debug/tenancy``)."""
+    global _default_fleet
+    with _default_lock:
+        _default_fleet = fleet
+    return fleet
+
+
+def get() -> TenantFleet | None:
+    with _default_lock:
+        return _default_fleet
+
+
+def uninstall() -> None:
+    global _default_fleet
+    with _default_lock:
+        _default_fleet = None

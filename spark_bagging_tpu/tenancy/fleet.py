@@ -1,0 +1,326 @@
+"""TenantFleet — the composed multi-tenant serving plane.
+
+One registry, N named tenants, one device budget. The fleet wires the
+tenancy pieces around the existing single-model machinery without
+changing its contracts:
+
+- ``register()`` is ``ModelRegistry.register`` plus the fleet
+  bookkeeping: warmup, eager AOT persist (the demotion safety net),
+  residency adoption, and a per-tenant ``MicroBatcher``.
+- ``submit()`` is the admission seam: quota/priority decisions happen
+  HERE (counted per tenant), admitted requests are tagged into the
+  WFQ scheduler — nothing touches a batcher yet.
+- ``dispatch()`` drains the WFQ in virtual-finish order and feeds
+  each request to its tenant's batcher: pop order IS downstream batch
+  composition, so fairness and determinism are the same property. A
+  batcher's ``Overloaded`` here is both counted per tenant
+  (``sbt_serving_shed_total{reason="overload",tenant=}``) and fed
+  back into the admission controller's pressure machine — the
+  backpressure-to-policy loop the tentpole names.
+
+Stepped batchers (``threaded=False``, the default) make the whole
+fleet a pure function of (workload, specs, seed) under a virtual
+clock — the replay drill's mode. Threaded batchers serve live
+traffic with identical policy decisions; only batch timing differs.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Iterable
+
+from spark_bagging_tpu import telemetry
+from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.serving.batcher import Degraded, Overloaded
+from spark_bagging_tpu.tenancy.admission import AdmissionController
+from spark_bagging_tpu.tenancy.budget import RefitBudgeter
+from spark_bagging_tpu.tenancy.residency import ResidencyManager
+from spark_bagging_tpu.tenancy.spec import TenantSpec
+from spark_bagging_tpu.tenancy.wfq import WFQScheduler
+
+#: bounded per-tenant latency reservoir (sorted insert; p99 export)
+_LATENCY_KEEP = 2048
+
+
+# sbt-lint: shared-state
+class TenantFleet:
+    """N tenants sharing one registry + device, policy-enforced."""
+
+    def __init__(
+        self,
+        specs: Iterable[TenantSpec],
+        *,
+        registry: Any = None,
+        residency_capacity: int | None = None,
+        aot_root: str | None = None,
+        plane: Any = None,
+        pressure_window_s: float = 1.0,
+        escalate_after: int = 3,
+        refit_total_per_window: int = 4,
+        refit_window_s: float = 60.0,
+        threaded: bool = False,
+        batcher_opts: dict | None = None,
+    ) -> None:
+        specs = list(specs)
+        if not specs:
+            raise ValueError("TenantFleet needs at least one TenantSpec")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names in {names}")
+        if registry is None:
+            from spark_bagging_tpu.serving.registry import ModelRegistry
+
+            registry = ModelRegistry()
+        self.registry = registry
+        self.specs: dict[str, TenantSpec] = {s.name: s for s in specs}
+        self.admission = AdmissionController(
+            specs, pressure_window_s=pressure_window_s,
+            escalate_after=escalate_after,
+        )
+        self.wfq = WFQScheduler({s.name: s.weight for s in specs})
+        self.budget = RefitBudgeter(
+            specs, total_per_window=refit_total_per_window,
+            window_s=refit_window_s,
+        )
+        self.residency: ResidencyManager | None = None
+        if residency_capacity is not None:
+            if aot_root is None:
+                raise ValueError(
+                    "residency_capacity needs aot_root (the demotion "
+                    "persist directory)"
+                )
+            self.residency = ResidencyManager(
+                registry, capacity=residency_capacity,
+                aot_root=aot_root, plane=plane,
+            )
+        self._threaded = bool(threaded)
+        self._batcher_opts = dict(batcher_opts or {})
+        self._lock = make_lock("tenancy.fleet")
+        self._batchers: dict[str, Any] = {}
+        #: per-tenant downstream sheds {(tenant, reason): n}
+        self._sheds: dict[tuple[str, str], int] = {}
+        self._submitted: dict[str, int] = {}
+        self._served_rows: dict[str, int] = {}
+        self._latency_ms: dict[str, list[float]] = {}
+        telemetry.set_gauge("sbt_tenancy_tenants", float(len(specs)))
+
+    # -- lifecycle ------------------------------------------------------
+
+    def register(self, name: str, model: Any, *,
+                 warmup: bool = True,
+                 batcher_opts: dict | None = None,
+                 **executor_opts: Any) -> Any:
+        """Install ``model`` as tenant ``name``'s serving bag."""
+        spec = self.specs.get(name)
+        if spec is None:
+            raise KeyError(
+                f"no TenantSpec for {name!r}; have {sorted(self.specs)}"
+            )
+        ex = self.registry.register(name, model, warmup=warmup,
+                                    **executor_opts)
+        if self.residency is not None:
+            if ex.compiled_buckets:
+                # the demotion safety net: persist NOW so a later
+                # demote (which may race a restore of someone else)
+                # never finds an unsaved ladder
+                ex.save_executables(self.residency.tenant_dir(name))
+            self.residency.adopt(name)
+        opts = {**self._batcher_opts, **(batcher_opts or {})}
+        opts.setdefault("threaded", self._threaded)
+        batcher = self.registry.batcher(name, **opts)
+        with self._lock:
+            self._batchers[name] = batcher
+        return ex
+
+    def batcher(self, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._batchers[name]
+            except KeyError:
+                raise KeyError(
+                    f"tenant {name!r} has no registered model yet"
+                ) from None
+
+    def close(self) -> None:
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.close()
+
+    # -- the serve path -------------------------------------------------
+
+    def submit(self, name: str, X: Any, *, now: float,
+               mode: str = "aggregate",
+               deadline_ms: float | None = None) -> float:
+        """Admit + fair-queue one request; returns its WFQ finish tag.
+
+        Raises :class:`~spark_bagging_tpu.tenancy.admission.QuotaExceeded`
+        / :class:`~spark_bagging_tpu.tenancy.admission.AdmissionShed`
+        when admission sheds it (already counted). The request reaches
+        its batcher at the next :meth:`dispatch`."""
+        rows = int(getattr(X, "shape", (1,))[0])
+        self.admission.check(name, rows, now)
+        with self._lock:
+            self._submitted[name] = self._submitted.get(name, 0) + rows
+        return self.wfq.enqueue(
+            name, (X, mode, deadline_ms), cost=float(rows))
+
+    def dispatch(self, *, now: float,
+                 run_pending: bool = True) -> list[dict]:
+        """Drain the WFQ in fair order into the per-tenant batchers.
+
+        Returns one record per drained request:
+        ``{"tenant", "future", "rows", "shed"}`` — ``future`` is None
+        iff the batcher shed it (``shed`` carries the reason, the
+        overload case also feeds :meth:`AdmissionController.
+        observe_overload`). With stepped batchers and
+        ``run_pending=True`` every touched tenant's queue is then
+        served on this thread, in tenant-name order (the churn drill's
+        idiom) — with residency admitting each tenant back immediately
+        BEFORE its own forwards run (the counted restore path). The
+        placement is load-bearing: touching at drain time instead
+        would let a window that drains more distinct tenants than the
+        residency budget demote the earliest-touched ones again before
+        their forwards ran, and they would recompile on demand —
+        breaking the zero-post-warmup-compile promise for every
+        over-budget window. Threaded batchers forward concurrently, so
+        there the tenant is made resident at drain time (its forwards
+        may start before this loop ends) and an over-budget window
+        genuinely thrashes — bounded tenancy needs the stepped drive."""
+        out: list[dict] = []
+        touched: set[str] = set()
+        stepped = run_pending and not self._threaded
+        for tenant, (X, mode, deadline_ms) in self.wfq.drain():
+            if self.residency is not None and not stepped:
+                self.residency.touch(tenant)
+            rows = int(getattr(X, "shape", (1,))[0])
+            rec: dict[str, Any] = {"tenant": tenant, "future": None,
+                                   "rows": rows, "shed": None}
+            try:
+                rec["future"] = self.batcher(tenant).submit(
+                    X, mode=mode, deadline_ms=deadline_ms)
+                touched.add(tenant)
+                with self._lock:
+                    self._served_rows[tenant] = (
+                        self._served_rows.get(tenant, 0) + rows)
+            except Overloaded:
+                rec["shed"] = "overload"
+                self.admission.observe_overload(now)
+            except Degraded:
+                rec["shed"] = "degraded"
+            if rec["shed"] is not None:
+                with self._lock:
+                    key = (tenant, rec["shed"])
+                    self._sheds[key] = self._sheds.get(key, 0) + 1
+                # the tenant-labeled twin of the batcher's own shed
+                # counter [ISSUE 17 satellite]: same series, tenant
+                # dimension added at the seam that knows it
+                telemetry.inc(
+                    "sbt_serving_shed_total",
+                    labels={"reason": rec["shed"], "tenant": tenant},
+                )
+            out.append(rec)
+        if stepped:
+            for tenant in sorted(touched):
+                if self.residency is not None:
+                    self.residency.touch(tenant)
+                self.batcher(tenant).run_pending()
+        return out
+
+    # -- refit budgeting -------------------------------------------------
+
+    def refit_allowed(self, name: str, now: float) -> bool:
+        """The :class:`RefitBudgeter` decision for ``name`` — also the
+        hook to pass an ``OnlineTrainer`` as ``refit_budget=``
+        (via :meth:`RefitBudgeter.for_tenant`)."""
+        return self.budget.allow(name, now)
+
+    # -- latency accounting ----------------------------------------------
+
+    def note_latency(self, name: str, ms: float) -> None:
+        """Record one served request's wall latency (host-band data:
+        exported as gauges, never digested)."""
+        with self._lock:
+            res = self._latency_ms.setdefault(name, [])
+            bisect.insort(res, float(ms))
+            if len(res) > _LATENCY_KEEP:
+                res.pop()  # drop the max: keep the reservoir bounded
+
+    @staticmethod
+    def _p99(sorted_ms: list[float]) -> float | None:
+        if not sorted_ms:
+            return None
+        i = min(len(sorted_ms) - 1,
+                int(0.99 * (len(sorted_ms) - 1) + 0.5))
+        return sorted_ms[i]
+
+    def latency_p99_ms(self) -> dict[str, float]:
+        with self._lock:
+            out = {}
+            for name in sorted(self._latency_ms):
+                p = self._p99(self._latency_ms[name])
+                if p is not None:
+                    out[name] = p
+            return out
+
+    def tail_p99_ms(self) -> float | None:
+        """p99 over the TAIL tenants — everyone but the top tenant by
+        submitted rows (the Zipf head). The fleet SLO the tenancy
+        alert rules burn against."""
+        per = self.latency_p99_ms()
+        if not per:
+            return None
+        with self._lock:
+            ranked = sorted(self._submitted,
+                            key=lambda t: (-self._submitted[t], t))
+        head = ranked[0] if ranked else None
+        tail = [p for t, p in per.items() if t != head]
+        if not tail:
+            return max(per.values())
+        return max(tail)
+
+    def export_gauges(self) -> None:
+        """Per-tenant latency gauges + the tail SLO gauge — called at
+        scrape time by the exposition server (like the capacity
+        plane's export) and at snapshot time by the drill."""
+        for name, p in self.latency_p99_ms().items():
+            telemetry.set_gauge("sbt_tenancy_latency_p99_ms", p,
+                                labels={"tenant": name})
+        tail = self.tail_p99_ms()
+        if tail is not None:
+            telemetry.set_gauge("sbt_tenancy_tail_p99_ms", tail)
+
+    # -- reporting -------------------------------------------------------
+
+    def shed_counts(self) -> dict[str, dict[str, int]]:
+        """Downstream (batcher) sheds per tenant, name-sorted."""
+        with self._lock:
+            out: dict[str, dict[str, int]] = {}
+            for (name, reason), n in sorted(self._sheds.items()):
+                out.setdefault(name, {})[reason] = n
+            return out
+
+    def served_rows(self) -> dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._served_rows.items()))
+
+    def report(self) -> dict:
+        """The ``/debug/tenancy`` document: every policy surface's
+        deterministic state, one JSON object."""
+        with self._lock:
+            registered = sorted(self._batchers)
+        return {
+            "tenants": [self.specs[n].to_dict()
+                        for n in sorted(self.specs)],
+            "registered": registered,
+            "admission": self.admission.state(),
+            "wfq": self.wfq.state(),
+            "residency": (None if self.residency is None
+                          else self.residency.state()),
+            "refit_budget": self.budget.state(),
+            "downstream_sheds": self.shed_counts(),
+            "served_rows": self.served_rows(),
+            "latency_p99_ms": self.latency_p99_ms(),
+            "tail_p99_ms": self.tail_p99_ms(),
+        }

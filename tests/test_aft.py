@@ -296,6 +296,7 @@ def test_streamed_aft_scores_its_own_training_source():
     )
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~1.7s stream/refit isolation soak; the aux-column convention stays tier-1 via test_streamed_aft_scores_its_own_training_source
 def test_stream_aux_convention_does_not_leak_into_memory_refit():
     """An in-memory refit clears the prior fit_stream's aux column, so
     a later (D+1)-wide stream source gets the honest width error, not a

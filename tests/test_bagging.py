@@ -50,6 +50,7 @@ class TestBaggingClassifier:
         single = (np.asarray(lr.predict_scores(params, jnp.asarray(X)).argmax(1)) == y).mean()
         assert clf.score(X, y) >= single - 0.01
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~2s n_estimators=1 equivalence soak; ensemble correctness stays tier-1 via test_sklearn_parity + test_oob_score
     def test_degenerate_ensemble_equals_base_learner(self, breast_cancer):
         """n_estimators=1, no bootstrap, full features ⇒ exactly the base
         learner [SURVEY §4]."""
@@ -375,6 +376,7 @@ class TestWarmStart:
     """warm_start grows a fitted ensemble; id-keyed replica streams make
     the result EXACTLY a cold fit of the larger ensemble."""
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~3.9s warm==cold dual-fit soak; warm-start contracts stay tier-1 via the rejection tests here + streaming resume parity
     def test_equals_cold_fit(self, breast_cancer):
         X, y = breast_cancer
         cold = BaggingClassifier(
@@ -481,6 +483,7 @@ def test_int_max_samples(breast_cancer):
         BaggingClassifier(max_samples=0).fit(X, y)
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~2.3s per-replica slice soak; the estimators_ view contract stays tier-1 via test_estimators_features_alias
 def test_replica_params_slices_match_ensemble(breast_cancer):
     """Per-replica access (estimators_[i] analog): averaging the
     single-replica probabilities must reproduce soft-vote
@@ -568,6 +571,7 @@ def test_replica_weights_rejects_stream_fit(breast_cancer):
         sclf.replica_weights(0)
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~3.2s mesh-detach rejection twin; the replica-weights rejection contract stays tier-1 via test_replica_weights_rejects_stream_fit
 def test_replica_weights_data_sharded_rejected_even_after_mesh_detach(
     breast_cancer,
 ):
@@ -715,6 +719,7 @@ class TestLinearCollapseInference:
         del reg.__dict__["_collapsed_beta_cache"]
         return pred
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~2.1s subspace variant; linear-collapse device parity stays tier-1 via the base TestLinearCollapseInference tests
     def test_ridge_with_subspaces_matches_device_path(self):
         rng = np.random.default_rng(0)
         X = rng.normal(size=(300, 12)).astype(np.float32)

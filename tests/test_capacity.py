@@ -396,6 +396,15 @@ class TestSurfaces:
             "capacity-headroom-low",
             "capacity-cold-model-resident",
             "capacity-eviction-churn",
+            "tenancy-tail-latency-burn",
+            "tenancy-quota-shed-rate",
+            "tenancy-pin-violation",
+        ]
+        assert [r.name for r in alerts.default_capacity_rules(
+            tenancy=False)] == [
+            "capacity-headroom-low",
+            "capacity-cold-model-resident",
+            "capacity-eviction-churn",
         ]
         for r in rules:
             # round-trip through the wire grammar (config files)

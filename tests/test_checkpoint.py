@@ -21,6 +21,7 @@ def iris():
     return StandardScaler().fit_transform(X).astype(np.float32), y
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~3.6s full classifier roundtrip soak; the roundtrip contract stays tier-1 via test_string_label_roundtrip + test_auto_chunk_resolution_survives_roundtrip + test_aft_checkpoint_roundtrip
 def test_classifier_roundtrip(tmp_path, iris):
     X, y = iris
     clf = BaggingClassifier(

@@ -58,6 +58,7 @@ class TestMLPClassifier:
         curve = np.asarray(aux["loss_curve"])
         assert curve[-1] < curve[0]
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~2.4s minibatch soak; minibatch solver path stays exercised via test_property_fuzz MLP params; fullbatch contracts stay tier-1 here
     def test_minibatch_mode(self):
         Xj, yj, X, y = _breast_cancer()
         mlp = MLPClassifier(hidden=32, max_iter=400, batch_size=64, lr=3e-3)
@@ -91,6 +92,7 @@ class TestMLPClassifier:
         with pytest.raises(ValueError, match="activation"):
             MLPClassifier(activation="sigmoidal")
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~2s batched-fit soak; vmapped MLP fits are exercised by every bagged MLP fit (fuzz zoo); seed determinism stays tier-1 here
     def test_vmap_over_replicas(self):
         X, y = _two_moons(200)
         mlp = MLPClassifier(hidden=8, max_iter=30)

@@ -89,6 +89,7 @@ class TestOnlineUpdater:
         out_b = ex_b.forward(X[:19])
         assert out_a.tobytes() == out_b.tobytes()
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~4.6s OOB-tolerance soak (clf); the bitwise batch anchor test_partial_fit_matches_batch_fit_bitwise + test_regressor_stream_r2 stay tier-1
     def test_streaming_oob_tracks_batch_oob(self):
         """Satellite [ISSUE 15]: the prequential streaming OOB
         estimate over a seeded workload agrees with the batch
@@ -103,6 +104,7 @@ class TestOnlineUpdater:
         assert upd.oob_rows > 100
         assert abs(upd.oob_estimate() - est.oob_score_) <= 0.1
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~3.2s key-schedule soak; online determinism stays tier-1 via the online-refit scenario transcript digest in the conformance smoke
     def test_key_stream_determinism(self):
         """Same (seed, example order) -> byte-identical params and OOB
         estimate; a different seed draws a different Poisson stream."""
@@ -442,6 +444,7 @@ class TestOnlineTrainer:
 # -- the closed-loop gate ----------------------------------------------
 
 class TestClosedLoop:
+    @pytest.mark.slow  # [PR 17 budget offset] ~3.2s in-process drill; the same gate runs tier-1 as the online-refit scenario (digest + SLO) in the conformance smoke
     def test_online_drill_gate(self):
         """The in-process acceptance drill: one alert → one refit →
         one fleet-converged swap → drift-gauge recovery, repeats

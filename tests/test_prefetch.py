@@ -195,6 +195,7 @@ def test_worth_prefetching_gates_on_spare_core(monkeypatch):
     assert pf.worth_prefetching()
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~4.7s default-policy end-to-end soak; prefetch equivalence + knob contracts stay tier-1 via TestEngineEquivalence + test_scoring_stream_prefetch_knob
 def test_engine_default_wrap_policy(monkeypatch):
     """The engine's prefetch policy, end to end [round-5 review]: the
     None default wraps only with a spare core, an explicit int forces

@@ -35,7 +35,7 @@ def _soak(learner):
     """[PR 14 pyramid] the heavyweight zoo entries (1.5-5s per fuzz
     test each) carry the slow mark: the INVARIANTS stay continuously
     enforced in tier-1 by the cheap representatives below (plain
-    logistic, the NBs, linear/GLM/isotonic/tree regressors), and the
+    logistic, the NBs, linear/GLM regressors), and the
     heavy families keep full fuzz coverage in the slow tier plus
     their own dedicated suites."""
     return pytest.param(learner, marks=pytest.mark.slow)
@@ -63,8 +63,12 @@ REGRESSORS = [
     _soak(GeneralizedLinearRegression(family="poisson", max_iter=5)),
     _soak(GeneralizedLinearRegression(family="poisson", max_iter=2,
                                       init="pooled")),
-    DecisionTreeRegressor(max_depth=3, n_bins=8),
-    IsotonicRegression(n_bins=16),
+    # [PR 17 budget offset] tree/isotonic regressors move to the slow
+    # zoo: both have dedicated tier-1 suites (tests/test_tree.py
+    # regressor contracts, tests/test_isotonic.py) enforcing the same
+    # invariants on their own data shapes
+    _soak(DecisionTreeRegressor(max_depth=3, n_bins=8)),
+    _soak(IsotonicRegression(n_bins=16)),
     _soak(MLPRegressor(hidden=8, max_iter=30)),
     _soak(FMRegressor(factor_size=2, max_iter=30)),
     _soak(GBTRegressor(n_rounds=4, max_depth=2, n_bins=8)),

@@ -12,10 +12,13 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~9.1s bench smoke; serving-path contracts stay tier-1 via test_serving_fastpath + the scenario conformance smoke; bench numbers trend via the history store
 def test_serving_latency_smoke(tmp_path):
     out = str(tmp_path / "BENCH_serving.json")
     tel = str(tmp_path / "telemetry.jsonl")
@@ -74,6 +77,7 @@ def test_serving_latency_smoke(tmp_path):
     assert "sbt_serving_latency_seconds" in names
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~3.9s bench smoke; sharded serving stays tier-1 via test_serving_sharded parity tests + the sharded-parity scenario
 def test_serving_sharded_bench_smoke(tmp_path):
     """ISSUE 10 acceptance: ``--devices 8`` (forced-host-device CPU)
     serves the oversized bag through the replica-sharded executor with

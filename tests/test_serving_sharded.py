@@ -137,6 +137,7 @@ def test_sharded_parity_every_bucket_and_ragged_plan(clf, data, mesh):
         np.testing.assert_array_equal(got, clf.predict_proba(Xn))
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~1.8s parity variant; representative coverage stays tier-1 via test_sharded_parity_every_bucket_and_ragged_plan
 def test_sharded_parity_hard_voting(data, mesh):
     """Hard voting serves vote FREQUENCIES; the sharded one-hot gather
     must reproduce them exactly."""
@@ -154,6 +155,7 @@ def test_sharded_parity_hard_voting(data, mesh):
         )
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~1.6s parity variant; representative coverage stays tier-1 via test_sharded_parity_every_bucket_and_ragged_plan
 def test_sharded_parity_regressor(data, mesh):
     X, y = data
     rgr = BaggingRegressor(n_estimators=16, seed=1).fit(
@@ -534,6 +536,7 @@ def test_serve_config_mesh_degrades_with_warning(
 
 # -- deterministic replay over the sharded path ------------------------
 
+@pytest.mark.slow  # [PR 17 budget offset] ~2.5s replay twin; the sharded-parity scenario reproduces steady-poisson's committed output digest bitwise in the conformance smoke
 def test_replay_devices_mode_serves_sharded_deterministically():
     """``benchmarks/replay.py --devices 8``: the deterministic replay
     gate covers the sharded path — virtual-mode digests are stable and

@@ -420,6 +420,7 @@ def test_stream_oob_tree_data_mesh_rejected(cancer):
     assert ok.oob_score_ == pytest.approx(ref.oob_score_, abs=1e-9)
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~1.5s subspace stream soak; subspace semantics stay tier-1 via test_property_fuzz subspace params + bagging subspace tests
 def test_stream_subspaces(cancer):
     X, y = cancer
     sclf = BaggingClassifier(
@@ -497,6 +498,7 @@ def _stream_kw(**extra):
                 **extra)
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~2.9s kill/resume soak; resume determinism stays tier-1 via test_stream_seed_determinism + test_tree_stream_resume_rejects_config_change
 def test_stream_kill_and_resume_reproduces_uninterrupted(cancer, tmp_path):
     X, y = cancer
     ckpt = str(tmp_path / "snap")
@@ -693,6 +695,7 @@ def test_stream_checkpoint_resume_on_mesh(cancer, tmp_path):
 # ---------------------------------------------------------------------
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~2.2s streaming-OOB quality band; OOB-on-stream stays tier-1 via test_stream_regressor + test_online OOB anchors
 def test_stream_oob_classifier(cancer):
     X, y = cancer
     clf = BaggingClassifier(

@@ -280,6 +280,7 @@ class TestTreeBagging:
         assert not np.isnan(thr).any()
         assert np.isfinite(thr[:, 0]).all()  # the root always splits here
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~2.3s mesh twin; sharded tree fits stay tier-1 via tests/test_sharded.py + the sharded-parity scenario digest
     def test_sharded_tree_fit_on_mesh(self):
         from spark_bagging_tpu import make_mesh
 
@@ -391,6 +392,7 @@ class TestPrePruning:
         with pytest.raises(ValueError, match="criterion"):
             DecisionTreeClassifier(criterion="logloss")
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~3.7s pruning soak; the pre-pruning knob contract stays tier-1 via TestPrePruning::test_min_instances_blocks_tiny_splits
     def test_min_info_gain_prunes_to_stump(self):
         X, y = self._data()
         # an absurd floor: no split clears it, so the tree is a single
@@ -448,6 +450,7 @@ class TestPrePruning:
         ).fit_stream(ArrayChunks(X, y, chunk_rows=100), classes=[0, 1])
         assert np.isinf(np.asarray(clf.ensemble_["threshold"])).all()
 
+    @pytest.mark.slow  # [PR 17 budget offset] ~2.9s knob-plumbing fit; knob rejection/enforcement stays tier-1 via TestPrePruning::test_min_instances_blocks_tiny_splits
     def test_forest_exposes_knobs(self):
         from spark_bagging_tpu import RandomForestClassifier
 
@@ -519,6 +522,7 @@ def test_to_debug_string_matches_predictions():
     assert pl == 0 and pr == 1
 
 
+@pytest.mark.slow  # [PR 17 budget offset] ~3.9s deep-fit render soak; debug-string rendering stays tier-1 via the shallow debug-string tests in this file
 def test_debug_string_split_count_matches_rendered_tree():
     """The header's splits= count must equal the number of rendered
     'If (' lines — phantom finite-threshold nodes inside unreachable
