@@ -213,18 +213,23 @@ def _payloads(workload, n_features: int, seed: int, *,
     return payload
 
 
-def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
+def _collect_futures(futs: dict[int, object], timeout_s: float,
+                     owner=None) -> dict:
     """Walk the served futures in request order and fold what the
     tracing plane observed into digests + latency stats — the shared
     back half of :func:`replay` and :func:`replay_fleet`. Returns
     ``out_h``/``comp_h`` (sha256 objects over output bytes and batch
     composition), sorted ``latencies``, ``forward_ms``, ``errors``,
     ``served``, and ``records`` — one compact per-request breakdown
-    record per future (the attribution section's raw material)."""
+    record per future (the attribution section's raw material).
+    ``owner`` (optional ``idx -> str``) additionally folds each
+    result into a per-owner digest (``out_h_by_owner``, hex) — the
+    tenant-chaos drill's bystander-bitwise-unchanged evidence."""
     import numpy as np
 
     out_h = hashlib.sha256()
     comp_h = hashlib.sha256()
+    out_by_owner: dict = {}
     latencies: list[float] = []
     forward_ms = 0.0
     errors = 0
@@ -264,6 +269,11 @@ def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
         out_h.update(str(arr.shape).encode())
         out_h.update(str(arr.dtype).encode())
         out_h.update(arr.tobytes())
+        if owner is not None:
+            h = out_by_owner.setdefault(owner(idx), hashlib.sha256())
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
         if bd:
             latencies.append(bd["total_ms"])
             forward_ms += bd.get("forward_ms") or 0.0
@@ -281,6 +291,8 @@ def _collect_futures(futs: dict[int, object], timeout_s: float) -> dict:
         "out_h": out_h, "comp_h": comp_h, "latencies": latencies,
         "forward_ms": forward_ms, "errors": errors, "served": served,
         "records": records,
+        "out_h_by_owner": {k: h.hexdigest()
+                           for k, h in sorted(out_by_owner.items())},
     }
 
 
@@ -1934,6 +1946,8 @@ def replay_tenants(
     refit_total_per_window: int = 4,
     refit_window_s: float = 0.25,
     snapshot_every: int = 8,
+    chaos=None,
+    retries: int = 0,
     timeout_s: float = 120.0,
 ) -> dict:
     """The tenancy drill (``--tenants``): N named tenants — priority
@@ -1958,6 +1972,16 @@ def replay_tenants(
     on their first hit; the refit budgeter is consulted at every
     snapshot window for the two hottest tenants, so the per-tenant
     refit allowance transcript is exercised without running a trainer.
+
+    With ``chaos=`` the drill becomes the blast-radius experiment: a
+    seeded fault plan (typically ``tenant-chaos``, whose specs are
+    tenant-scoped to the Zipf head) is armed AFTER warmup, the fleet's
+    quarantine machine trips/probes/recovers on the injected failures,
+    and the report carries the generic chaos transcript plus the
+    quarantine event log. The containment claim is structural: faults
+    scoped to one tenant leave every bystander's output digest and
+    post-warmup compile count bitwise/exactly what they are without
+    the plan.
 
     Compile accounting follows the churn drill's convention: warming N
     cold tenants is the scripted cold-start cost (``tenants.compiles``)
@@ -2002,6 +2026,17 @@ def replay_tenants(
             f"models list has {len(models)} entries, expected {n_tenants}"
         )
 
+    # -- chaos scenario: a seeded fault plan spliced into the drill --
+    plan = None
+    if chaos is not None:
+        from spark_bagging_tpu import faults as faults_mod
+
+        # a FRESH plan per run: hit counters start at zero, so every
+        # repeat injects the identical schedule (the determinism
+        # contract extends to the fault AND quarantine transcripts)
+        spec = chaos if isinstance(chaos, dict) else chaos.to_dict()
+        plan = faults_mod.FaultPlan.from_dict(spec)
+
     # the popularity law, exactly the churn drill's: one seeded draw
     # assigns every arrival a tenant; rank-1 (t0) gets the Zipf head
     ranks = np.arange(1, n_tenants + 1, dtype=np.float64)
@@ -2029,6 +2064,19 @@ def replay_tenants(
 
     def counter(name: str) -> float:
         return reg_counters.counter(name).value
+
+    # the chaos shed surface is the serving reasons PLUS the machine's
+    # own quarantine shed — the blast-radius evidence lives there
+    chaos_shed_reasons = ("overload", "deadline", "degraded")
+
+    def chaos_shed_counts() -> dict[str, float]:
+        d = {
+            r: reg_counters.counter("sbt_serving_shed_total",
+                                    labels={"reason": r}).value
+            for r in chaos_shed_reasons
+        }
+        d["quarantine"] = counter("sbt_tenant_quarantine_shed_total")
+        return d
 
     c0 = {
         name: counter(name)
@@ -2058,11 +2106,19 @@ def replay_tenants(
         plane=plane, threaded=False,
         refit_total_per_window=refit_total_per_window,
         refit_window_s=refit_window_s,
+        # quarantine scaled to the drill's sub-second virtual clock: a
+        # tripped tenant's backoff expires INSIDE the run, so the
+        # probe/recovery half of the transcript is exercised, not just
+        # the trip; seeded so the jittered backoff is reproducible
+        quarantine_window_s=0.25,
+        quarantine_backoff_s=0.05,
+        quarantine_seed=seed,
         batcher_opts=dict(
             max_delay_ms=max_delay_ms,
             idle_flush_ms=idle_flush_ms,
             max_batch_rows=max_batch_rows,
             max_queue=max_queue,
+            retries=retries,
         ),
     )
 
@@ -2114,6 +2170,33 @@ def replay_tenants(
             idle_flush_s=idle_flush_ms / 1e3,
         )
         c_warm = counter("sbt_serving_compiles_total")
+        # per-tenant compile baseline via the model-labeled twin: the
+        # bystander-containment gate needs attribution, not a total
+        c_warm_by_tenant = {
+            n: reg_counters.counter(
+                "sbt_serving_compiles_total", labels={"model": n},
+            ).value
+            for n in names
+        }
+        chaos_c0: dict[str, float] = {}
+        shed0: dict[str, float] = {}
+        if plan is not None:
+            chaos_c0 = {
+                name: counter(name)
+                for name in (
+                    "sbt_serving_retries_total",
+                    "sbt_serving_batch_bisects_total",
+                    "sbt_serving_request_failures_total",
+                    "sbt_serving_degraded_forwards_total",
+                )
+            }
+            shed0 = chaos_shed_counts()
+            # armed AFTER the register/warmup loop: cache-state inserts
+            # differ between a cold first repeat and warm later ones,
+            # and letting them advance the plan's hit counters would
+            # make the fault schedule depend on cache state instead of
+            # the workload (the replay() chaos convention)
+            faults_mod.arm(plan)
         for w_i, window in enumerate(windows):
             vt = requests[window[0]].t
             for idx in window:
@@ -2139,6 +2222,12 @@ def replay_tenants(
                 snap(w_i, vt)
         wall = time.perf_counter() - t_wall0
         post_warmup = int(counter("sbt_serving_compiles_total") - c_warm)
+        post_warmup_by_tenant = {
+            n: int(reg_counters.counter(
+                "sbt_serving_compiles_total", labels={"model": n},
+            ).value - c_warm_by_tenant[n])
+            for n in names
+        }
         # read every deterministic surface while the private cache and
         # plane are still installed — closing state is transcript
         led = plane.ledger()
@@ -2153,13 +2242,19 @@ def replay_tenants(
         served_rows = fleet.served_rows()
         wfq_served = fleet.wfq.service_totals()
         budget_counts = fleet.budget.counts()
+        quarantine_events = fleet.quarantine.events()
+        quarantine_counts = fleet.quarantine.counts()
     finally:
+        if plan is not None:
+            faults_mod.disarm()
         fleet.close()
         _pc.install(prev_cache)
         capacity_mod.install(prev_plane)
         shutil.rmtree(aot_root, ignore_errors=True)
 
-    collected = _collect_futures(futs, timeout_s)
+    collected = _collect_futures(
+        futs, timeout_s, owner=lambda idx: names[int(owner_of[idx])],
+    )
     latencies = collected["latencies"]
     # per-tenant wall latency (host band: exported, never digested)
     for rec in collected["records"]:
@@ -2194,6 +2289,13 @@ def replay_tenants(
         "wfq_served": wfq_served,
         "budget_log": budget_log,
         "budget_counts": budget_counts,
+        # the blast-radius transcript: every trip/probe/recover event
+        # (seq-ordered, seeded-jitter deadlines rounded) is digested,
+        # so quarantine behaviour is byte-identical across repeats
+        "quarantine": {
+            "events": quarantine_events,
+            "counts": quarantine_counts,
+        },
         "demand_final": demand_final,
         "evictions_by_owner": eviction_counts,
         "compiles": compiles,
@@ -2224,6 +2326,11 @@ def replay_tenants(
         "demand_final": demand_final,
         "evictions_by_owner": eviction_counts,
         "budget": budget_counts,
+        "quarantine": quarantine_counts,
+        # per-tenant containment evidence: bystanders must show the
+        # same digests and zero compiles whether or not a plan is armed
+        "post_warmup_compiles_by_tenant": post_warmup_by_tenant,
+        "output_digest_by_tenant": collected["out_h_by_owner"],
         "reconciled": bool(led["reconciled"]),
         "latency_p99_by_tenant": latency_by_tenant,
         "tail_p99_ms": tail_p99,
@@ -2231,6 +2338,39 @@ def replay_tenants(
             json.dumps(transcript, sort_keys=True).encode()
         ).hexdigest(),
     }
+
+    chaos_report = None
+    if plan is not None:
+        shed1 = chaos_shed_counts()
+        chaos_report = {
+            "plan": plan.name,
+            "seed": plan.seed,
+            "plan_digest": plan.digest(),
+            # the deterministic fault transcript: hits and fires per
+            # site (and per tenant for tenant-scoped specs), asserted
+            # IDENTICAL across replay_median repeats
+            "sites": plan.snapshot(),
+            "retries": int(counter("sbt_serving_retries_total")
+                           - chaos_c0["sbt_serving_retries_total"]),
+            "bisects": int(
+                counter("sbt_serving_batch_bisects_total")
+                - chaos_c0["sbt_serving_batch_bisects_total"]
+            ),
+            "request_failures": int(
+                counter("sbt_serving_request_failures_total")
+                - chaos_c0["sbt_serving_request_failures_total"]
+            ),
+            "degraded_forwards": int(
+                counter("sbt_serving_degraded_forwards_total")
+                - chaos_c0["sbt_serving_degraded_forwards_total"]
+            ),
+            "shed": {r: int(shed1[r] - shed0[r]) for r in shed1},
+            # no replica group in the tenancy drill: the generic keys
+            # pin their benign values so replay_median's cross-repeat
+            # chaos contract applies unchanged
+            "degraded": False,
+            "surviving_replicas": None,
+        }
 
     import jax
 
@@ -2280,7 +2420,7 @@ def replay_tenants(
         "composition_digest": collected["comp_h"].hexdigest(),
         "output_digest": collected["out_h"].hexdigest(),
         "drift": None,
-        "chaos": None,
+        "chaos": chaos_report,
         "attribution": None,
         "online": None,
         "churn": None,
@@ -2442,6 +2582,9 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             "demotions", "restores", "pin_violations",
                             "residents_final", "demand_final",
                             "evictions_by_owner", "budget",
+                            "quarantine",
+                            "post_warmup_compiles_by_tenant",
+                            "output_digest_by_tenant",
                             "reconciled"):
                     if r["tenants"][key] != head["tenants"][key]:
                         raise AssertionError(
@@ -2647,42 +2790,61 @@ def _tenants_checks(report: dict) -> list[dict]:
     the demand plane tracked the whole fleet, the eviction ledger
     reconciles, and the tail-tenant p99 stays inside a generous host
     band (``latency_`` prefix: a breach exits 3, not 2 — wall time is
-    host-conditional evidence, not a correctness fact)."""
+    host-conditional evidence, not a correctness fact).
+
+    When the report carries a chaos plan (the ``tenant-chaos`` drill),
+    the zero-compile pin moves from the fleet total to the BYSTANDERS:
+    a faulted tenant is allowed its recovery recompile (a corrupt AOT
+    entry is a counted miss, not an error), but tenants that never
+    tripped quarantine must still show zero post-warmup compiles —
+    that is the blast-radius containment claim. The gate additionally
+    requires the quarantine machine to have both tripped and recovered
+    at least once, so a plan that never bites cannot green-wash the
+    drill."""
     t = report.get("tenants") or {}
 
     def eq(name: str, actual, want) -> dict:
         return {"name": name, "actual": actual, "limit": want,
                 "op": "==", "ok": actual == want}
 
+    def ge(name: str, actual, floor: int) -> dict:
+        return {"name": name, "actual": actual, "limit": floor,
+                "op": ">=", "ok": bool((actual or 0) >= floor)}
+
     tail = t.get("tail_p99_ms")
-    return [
-        {
-            "name": "tenants_demotions",
-            "actual": t.get("demotions"),
-            "limit": 1, "op": ">=",
-            "ok": bool((t.get("demotions") or 0) >= 1),
-        },
-        {
-            "name": "tenants_restores",
-            "actual": t.get("restores"),
-            "limit": 1, "op": ">=",
-            "ok": bool((t.get("restores") or 0) >= 1),
-        },
+    checks = [
+        ge("tenants_demotions", t.get("demotions"), 1),
+        ge("tenants_restores", t.get("restores"), 1),
         eq("tenants_served_all", t.get("served_tenants"),
            t.get("tenants")),
         eq("tenants_models_tracked", t.get("models_tracked"),
            t.get("tenants")),
         eq("tenants_ledger_reconciled", t.get("reconciled"), True),
-        eq("tenants_post_warmup_compiles",
-           report.get("post_warmup_compiles"), 0),
         eq("tenants_errors", report.get("errors"), 0),
-        {
-            "name": "latency_tail_p99_ms",
-            "actual": tail,
-            "limit": 250.0, "op": "<=",
-            "ok": bool(tail is not None and tail <= 250.0),
-        },
     ]
+    q = t.get("quarantine") or {}
+    tripped = set(q.get("trips") or {})
+    if report.get("chaos") is None:
+        checks.append(eq("tenants_post_warmup_compiles",
+                         report.get("post_warmup_compiles"), 0))
+    else:
+        by_tenant = t.get("post_warmup_compiles_by_tenant") or {}
+        bystander = sum(v for k, v in by_tenant.items()
+                        if k not in tripped)
+        checks += [
+            eq("tenants_bystander_compiles", bystander, 0),
+            ge("tenants_quarantine_trips",
+               sum((q.get("trips") or {}).values()), 1),
+            ge("tenants_quarantine_recoveries",
+               sum((q.get("recoveries") or {}).values()), 1),
+        ]
+    checks.append({
+        "name": "latency_tail_p99_ms",
+        "actual": tail,
+        "limit": 250.0, "op": "<=",
+        "ok": bool(tail is not None and tail <= 250.0),
+    })
+    return checks
 
 
 def check_report(report: dict, *, spec=None, baseline: dict | None = None,
@@ -2810,7 +2972,8 @@ def main(argv: list[str] | None = None) -> int:
                      help="splice a seeded fault schedule into the "
                           "replay: a builtin plan name (blips, "
                           "poison, mixed, shard-loss, worker-crash, "
-                          "crash-loop) or a plan JSON path — "
+                          "crash-loop, peer-loss, tenant-chaos) or a "
+                          "plan JSON path — "
                           "fault/retry/shed/degraded counts and "
                           "output digests are asserted identical "
                           "across repeats")
@@ -2996,6 +3159,17 @@ def main(argv: list[str] | None = None) -> int:
                 "only fires under a fleet aggregator: combine with "
                 "--fleet N (>= 2)"
             )
+        tenancy_sites = sites & {
+            "fleet.dispatch", "wfq.pop", "budget.refit",
+            "residency.restore", "residency.demote_persist",
+        }
+        if tenancy_sites and not args.tenants:
+            ap.error(
+                f"--chaos {args.chaos!r} arms "
+                f"{', '.join(sorted(tenancy_sites))}, which only "
+                "fire inside the tenancy drill: combine with "
+                "--tenants N (>= 2)"
+            )
         if args.mode == "virtual":
             if sites <= {"batcher.worker"}:
                 # virtual mode runs a stepped batcher: no worker
@@ -3078,6 +3252,7 @@ def main(argv: list[str] | None = None) -> int:
             max_queue=args.max_queue,
             min_bucket_rows=args.min_bucket_rows,
             bucket_max_rows=args.bucket_max_rows,
+            chaos=chaos_spec, retries=retries,
             seed=args.seed,
         )
     elif args.churn:
@@ -3257,6 +3432,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.check:
         spec = (slo_mod.SLOSpec.load(args.slo) if args.slo
                 else slo_mod.SLOSpec())
+        if args.tenants and chaos_spec is not None and not args.slo:
+            # a tenant-scoped fault plan may legitimately cost the
+            # FAULTED tenant a recompile (corrupt AOT entry -> counted
+            # miss); containment is gated by the per-tenant
+            # bystander-compiles check instead of the fleet total
+            spec.max_post_warmup_compiles = None
         baseline = None
         if args.baseline:
             with open(args.baseline) as f:
@@ -3353,6 +3534,7 @@ def main(argv: list[str] | None = None) -> int:
             "restores": t["restores"],
             "pin_violations": t["pin_violations"],
             "sheds_by_tenant": t["sheds_by_tenant"],
+            "quarantine": t["quarantine"],
             "tail_p99_ms": t["tail_p99_ms"],
             "reconciled": t["reconciled"],
             "transcript_digest": t["transcript_digest"][:16],

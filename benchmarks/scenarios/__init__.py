@@ -325,6 +325,32 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name="tenant-chaos",
+    description="the blast-radius drill [ISSUE 18]: the tenancy "
+                "fleet under a tenant-scoped fault plan — scripted "
+                "dispatch failures plus one corrupt AOT cache entry, "
+                "all aimed at tenant t1 — must trip t1's quarantine "
+                "(sheds counted under its own reason), back off with "
+                "seeded jitter, probe, and recover, while every "
+                "bystander tenant's output digest stays bitwise "
+                "unchanged and its post-warmup compile count stays "
+                "exactly zero; the fault, shed, and quarantine "
+                "transcripts are all part of the digest identity",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.4,
+              "seed": 111, "width": 8, "bucket_bounds": (8, 32)},
+    drive={"chaos": "tenant-chaos", "retries": 2},
+    model={"n_estimators": 2, "seed": 0},
+    serving=dict(_SERVING),
+    tenants={"n_tenants": 6, "residency_capacity": 4, "zipf_s": 1.1},
+    # the fleet-total compile pin is explicitly DISABLED (None, not
+    # the spec default 0): the targeted tenant is allowed its one
+    # recovery recompile (corrupt AOT entry = counted miss); the
+    # bystander-zero pin lives in _tenants_checks instead
+    slo={"max_overloads": 0, "max_post_warmup_compiles": None},
+    tags=("tenancy", "chaos"),
+))
+
+register(Scenario(
     name="sharded-parity",
     description="replica-sharded serving parity: steady-poisson's "
                 "exact (workload, seed, model) served through a "

@@ -161,6 +161,7 @@ def run_scenario(sc: Scenario,
             residency_capacity=int(
                 tenants_kwargs.pop("residency_capacity")),
             zipf_s=float(tenants_kwargs.pop("zipf_s", 1.1)),
+            chaos=chaos_spec,
             seed=seed,
             min_bucket_rows=min_rows, bucket_max_rows=max_rows,
             **drive, **tenants_kwargs,

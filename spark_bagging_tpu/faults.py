@@ -30,7 +30,14 @@ Fault grammar (one :class:`FaultSpec` per entry)::
      "times": 2,                        # cap total fires (default inf)
      "shard": 1,                        # for action "shard"
      "delay_ms": 5.0,                   # for action "delay"
+     "tenant": "t1",                    # only fire for this tenant's hits
      "message": "injected"}             # carried on the raised fault
+
+A spec carrying ``tenant`` only considers probe hits whose call site
+passed a matching ``tenant=`` info kwarg, and its trigger indices
+(``at`` / ``every``) count THAT tenant's hits alone — the blast-radius
+drills aim a schedule at one tenant without having to predict how
+interleaved fleet traffic lands on the shared per-site counter.
 
 Actions:
 
@@ -91,6 +98,12 @@ SITES: dict[str, str] = {
     "trainer.refit": "per bounded update epoch run by the online trainer",
     "trainer.validate": "per candidate validation pass by the online trainer",
     "trainer.publish": "per candidate publish (swap + checkpoint) by the online trainer",
+    "residency.restore": "per tenant AOT restore inside the residency manager",
+    "residency.demote_persist": "before the demote-path save_executables persist",
+    "aot.load": "per bucket executable read inside restore_executables",
+    "fleet.dispatch": "per drained request dispatched by the tenant fleet",
+    "wfq.pop": "per weighted-fair-queue pop (request stays queued on fault)",
+    "budget.refit": "per refit-budget decision (refit_allowed)",
 }
 
 ACTIONS = ("error", "transient", "poison", "shard", "kill", "delay")
@@ -135,7 +148,7 @@ class FaultSpec:
     """One armed fault: a site, a trigger rule, and an action."""
 
     __slots__ = ("site", "action", "at", "every", "p", "times",
-                 "shard", "delay_ms", "message")
+                 "shard", "delay_ms", "tenant", "message")
 
     def __init__(
         self,
@@ -148,6 +161,7 @@ class FaultSpec:
         times: int | None = None,
         shard: int = 0,
         delay_ms: float = 0.0,
+        tenant: str | None = None,
         message: str | None = None,
     ):
         if site not in SITES:
@@ -179,6 +193,7 @@ class FaultSpec:
         self.times = int(times) if times is not None else None
         self.shard = int(shard)
         self.delay_ms = float(delay_ms)
+        self.tenant = str(tenant) if tenant is not None else None
         self.message = message or f"injected {action} at {site}"
 
     def to_dict(self) -> dict[str, Any]:
@@ -195,13 +210,15 @@ class FaultSpec:
             d["shard"] = self.shard
         if self.action == "delay":
             d["delay_ms"] = self.delay_ms
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
         d["message"] = self.message
         return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "FaultSpec":
         known = {"site", "action", "at", "every", "p", "times", "shard",
-                 "delay_ms", "message"}
+                 "delay_ms", "tenant", "message"}
         unknown = set(d) - known
         if unknown:
             # a typo'd key silently arming nothing would make a chaos
@@ -214,6 +231,7 @@ class FaultSpec:
                    at=d.get("at"), every=d.get("every"), p=d.get("p"),
                    times=d.get("times"), shard=d.get("shard", 0),
                    delay_ms=d.get("delay_ms", 0.0),
+                   tenant=d.get("tenant"),
                    message=d.get("message"))
 
 
@@ -241,6 +259,10 @@ class FaultPlan:
         self.name = str(name)
         self._lock = threading.Lock()
         self._hits: dict[str, int] = {}
+        #: per-(site, tenant) hit counters — only populated when a probe
+        #: passes ``tenant=`` info, which is what tenant-scoped specs
+        #: index their ``at``/``every`` triggers against
+        self._tenant_hits: dict[tuple[str, str], int] = {}
         self._fires: list[int] = [0] * len(self.specs)
         # one seeded stream per p-spec: probabilistic faults are a pure
         # function of (plan seed, site, spec index, hit sequence)
@@ -273,15 +295,29 @@ class FaultPlan:
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
+            tenant = info.get("tenant")
+            thit = 0
+            if tenant is not None:
+                tkey = (site, str(tenant))
+                thit = self._tenant_hits.get(tkey, 0) + 1
+                self._tenant_hits[tkey] = thit
             for i in self._by_site.get(site, ()):
                 spec = self.specs[i]
+                if spec.tenant is not None:
+                    # tenant-scoped spec: only this tenant's hits count,
+                    # and trigger indices run on its private counter
+                    if tenant is None or str(tenant) != spec.tenant:
+                        continue
+                    idx = thit
+                else:
+                    idx = hit
                 if spec.times is not None and self._fires[i] >= spec.times:
                     continue
                 due = False
-                if spec.at is not None and hit in spec.at:
+                if spec.at is not None and idx in spec.at:
                     due = True
                 if not due and spec.every is not None \
-                        and hit % spec.every == 0:
+                        and idx % spec.every == 0:
                     due = True
                 if not due and spec.p is not None:
                     # draw exactly once per hit so the stream position
@@ -293,7 +329,7 @@ class FaultPlan:
                 if spec.action == "poison":
                     marked = True
                 else:
-                    action = (spec, hit)
+                    action = (spec, idx)
                     break
         if action is None:
             if marked:
@@ -356,17 +392,26 @@ class FaultPlan:
         repeats."""
         with self._lock:
             hits = dict(sorted(self._hits.items()))
+            tenant_hits = {
+                f"{site}|{tenant}": n
+                for (site, tenant), n in sorted(self._tenant_hits.items())
+            }
             fires = list(self._fires)
         by_site: dict[str, int] = {}
         for i, s in enumerate(self.specs):
             by_site[s.site] = by_site.get(s.site, 0) + fires[i]
-        return {
+        snap = {
             "name": self.name,
             "seed": self.seed,
             "hits": hits,
             "fires": {k: v for k, v in sorted(by_site.items()) if v},
             "fired_total": sum(fires),
         }
+        if tenant_hits:
+            # only present when some probe passed tenant info, so the
+            # committed digests of tenant-blind chaos drills are stable
+            snap["tenant_hits"] = tenant_hits
+        return snap
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:
@@ -453,7 +498,14 @@ def builtin_plan_spec(name: str, seed: int = 0) -> dict[str, Any]:
       heals. Tuned for a 3-peer fleet scraped in construction order
       (``every=3`` lands on the last peer each tick; ``times=20``
       bounds the outage so recovery happens inside the replay):
-      ``replay.py --chaos peer-loss --fleet 3``.
+      ``replay.py --chaos peer-loss --fleet 3``;
+    - ``tenant-chaos``: a mixed plan aimed at one tenant (``t1``) of a
+      multi-tenant fleet — three consecutive dispatch failures trip its
+      quarantine, and its first post-recovery AOT restore hits a
+      corrupt bucket read (a counted miss-plus-recompile, never an
+      escaping exception). Bystander tenants must come through with
+      zero added recompiles and bitwise-identical outputs:
+      ``replay.py --tenants 6 --chaos tenant-chaos``.
 
     The worker drills need a THREADED batcher (``replay.py`` requires
     ``--mode timed`` for them — virtual replay steps a worker-less
@@ -489,6 +541,12 @@ def builtin_plan_spec(name: str, seed: int = 0) -> dict[str, Any]:
         "peer-loss": [
             {"site": "fleet.scrape", "action": "error",
              "every": 3, "times": 20},
+        ],
+        "tenant-chaos": [
+            {"site": "fleet.dispatch", "action": "error",
+             "tenant": "t1", "at": [2, 3, 4]},
+            {"site": "aot.load", "action": "error",
+             "tenant": "t1", "at": [1]},
         ],
     }
     if name not in plans:

@@ -238,6 +238,7 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
             manifest = json.load(f)
     except (OSError, ValueError) as e:
         _count(executor, "sbt_serving_aot_misses_total")
+        _count(executor, "sbt_aot_load_corrupt_total")
         warnings.warn(f"unreadable AOT manifest at {manifest_path!r} "
                       f"({e!r}); warm start falls back to lowering",
                       stacklevel=2)
@@ -280,9 +281,18 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
             stacklevel=2,
         )
         return ()
+    from spark_bagging_tpu import faults
+
     restored = []
+    tenant = getattr(executor, "model_name", None)
     for bucket, fname in ordered:
         try:
+            if faults.ACTIVE is not None:
+                # a fired fault lands in the per-bucket handler below:
+                # an injected corrupt/truncated read degrades to a
+                # counted miss-plus-recompile, never an escaping
+                # exception — same contract as real disk rot
+                faults.fire("aot.load", tenant=tenant, bucket=bucket)
             with open(os.path.join(path, fname), "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
             compiled = serialize_executable.deserialize_and_load(
@@ -290,6 +300,7 @@ def restore_executables(executor: Any, path: str) -> tuple[int, ...]:
             )
         except Exception as e:  # noqa: BLE001 — per-bucket fallback
             _count(executor, "sbt_serving_aot_misses_total")
+            _count(executor, "sbt_aot_load_corrupt_total")
             warnings.warn(
                 f"failed to restore bucket {bucket} executable from "
                 f"{path!r} ({e!r}); it will lower on demand",

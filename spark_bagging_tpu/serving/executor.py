@@ -282,6 +282,12 @@ class EnsembleExecutor:
                 telemetry.inc("sbt_serving_degraded_compiles_total")
             else:
                 telemetry.inc("sbt_serving_compiles_total")
+                name = getattr(self, "model_name", None)
+                if name is not None:
+                    # labeled twin: per-model compile attribution so a
+                    # chaos drill can prove bystanders paid zero compiles
+                    telemetry.inc("sbt_serving_compiles_total",
+                                  labels={"model": str(name)})
             if self.mesh is not None and not self._failed_shards:
                 telemetry.inc(
                     "sbt_shardmap_traces_total",

@@ -536,7 +536,12 @@ def default_capacity_rules(
       rejecting traffic, not just backstopping a burst;
     - **tenancy-pin-violation** — a residency/cache eviction had to
       sacrifice a hot-pinned tenant: the residency budget (or cache
-      capacity) is smaller than the hot set.
+      capacity) is smaller than the hot set;
+    - **tenancy-quarantine-flapping** — two or more quarantine trips
+      (``sbt_tenant_quarantine_trips_total``) inside the fast window
+      [ISSUE 18]: a tenant is cycling trip → probe → re-trip instead
+      of recovering, so its backoff ladder (or the underlying fault)
+      needs an operator.
     """
     tenancy_rules = [
         AlertRule(
@@ -567,6 +572,19 @@ def default_capacity_rules(
             cooldown_s=cooldown_s,
             description="hot-pinned tenants being evicted: the "
                         "residency budget is smaller than the hot set",
+        ),
+        AlertRule(
+            f"{name_prefix}tenancy-quarantine-flapping",
+            "sbt_tenant_quarantine_trips_total", labels=labels,
+            # ≥2 trips inside the fast window, expressed as the burn
+            # rate the engine evaluates (strictly above 1.5 trips per
+            # fast window tolerates no flapping but ignores a single
+            # contained trip-and-recover)
+            threshold=1.5 / fast_window_s, kind="rate", op=">",
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            cooldown_s=cooldown_s,
+            description="quarantine flapping: a tenant is cycling "
+                        "trip/probe/re-trip instead of recovering",
         ),
     ] if tenancy else []
     return [

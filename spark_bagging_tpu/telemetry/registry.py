@@ -185,7 +185,7 @@ SERIES_HELP: dict[str, str] = {
     "sbt_capacity_cold_resident_entries": "Program-cache entries owned by cold-demand-class models (gauge; the reclaim candidates)",
     "sbt_tenancy_tenants": "Tenants configured in the installed TenantFleet (gauge)",
     "sbt_tenancy_admitted_total": "Requests admitted by the tenancy admission controller (label tenant)",
-    "sbt_tenancy_shed_total": "Requests shed by admission policy (labels tenant + reason: quota or priority)",
+    "sbt_tenancy_shed_total": "Requests shed by admission policy (labels tenant + reason: quota, priority, or quarantine)",
     "sbt_tenancy_overloads_total": "Downstream Overloaded sheds fed into the admission pressure window",
     "sbt_tenancy_pressure_level": "Admission pressure state: 0 normal / 1 shed batch class / 2 shed standard too (gauge)",
     "sbt_tenancy_demotions_total": "Tenants demoted from residency (programs released, AOT-persisted; label tenant)",
@@ -195,6 +195,13 @@ SERIES_HELP: dict[str, str] = {
     "sbt_tenancy_refit_denied_total": "Online-refit triggers denied by the per-tenant refit budget (label tenant)",
     "sbt_tenancy_latency_p99_ms": "Per-tenant served-request p99 latency in ms (gauge, label tenant; host-band, never digested)",
     "sbt_tenancy_tail_p99_ms": "p99 latency in ms over the tail tenants - everyone but the Zipf head (gauge; the fleet SLO burn signal)",
+    "sbt_tenant_quarantine_trips_total": "Tenants tripped into quarantine by the failure window (unlabeled total + label tenant)",
+    "sbt_tenant_quarantine_shed_total": "Requests shed because their tenant is quarantined (unlabeled total + label tenant)",
+    "sbt_tenant_quarantine_probes_total": "Single recovery probes admitted for quarantined tenants (label tenant)",
+    "sbt_tenant_quarantine_recoveries_total": "Quarantined tenants recovered by a successful probe (label tenant)",
+    "sbt_tenant_quarantine_failures_total": "Tenant-attributed failures fed into the quarantine window (labels tenant + kind)",
+    "sbt_tenant_quarantine_active": "Tenants currently quarantined or probing (gauge)",
+    "sbt_aot_load_corrupt_total": "Corrupt/truncated AOT cache reads degraded to a counted miss-plus-recompile (optional model label)",
     "sbt_serving_programs_released_total": "Compiled bucket executables dropped by executor release_programs (tenant demotion)",
     "sbt_online_refits_budget_denied_total": "Refit triggers dropped by the per-tenant refit budget hook (label model)",
     "sbt_process_device_bytes_in_use": "Device memory currently allocated, where the backend reports it (gauge, label device)",

@@ -48,7 +48,8 @@ half — a zero-dependency stdlib ``http.server`` endpoint an operator
   (``spark_bagging_tpu/tenancy/``): per-tenant specs, admission
   pressure state + decision counts, WFQ service audit, residency
   residents/demotions/restores/pin violations, refit-budget state,
-  per-tenant latency p99s;
+  per-tenant quarantine state (trips/backoff/probes), per-tenant
+  latency p99s;
 - ``GET /debug/profile?seconds=N`` — on-demand live device profiling:
   starts a single-flight ``jax.profiler`` capture that auto-stops
   after N seconds (hard-capped) into ``telemetry_dir()/profiles/``;
@@ -316,9 +317,9 @@ def _debug_capacity(query: dict[str, list[str]]) -> dict[str, Any]:
 def _debug_tenancy() -> dict[str, Any]:
     """The installed :class:`~spark_bagging_tpu.tenancy.fleet.
     TenantFleet`'s full policy report — admission state machine, WFQ
-    audit, residency transcript counts, refit budget. An honest
-    explicit shape when no fleet is installed (a single-model process
-    is the common case, not an error)."""
+    audit, residency transcript counts, refit budget, quarantine
+    machine state. An honest explicit shape when no fleet is installed
+    (a single-model process is the common case, not an error)."""
     from spark_bagging_tpu import tenancy
 
     fleet = tenancy.get()

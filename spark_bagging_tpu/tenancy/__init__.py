@@ -33,9 +33,15 @@ existing replay/digest discipline (every decision a pure function of
   online bootstrap, applied fleet-wide).
 
 :class:`~spark_bagging_tpu.tenancy.fleet.TenantFleet` composes them
-over one registry; ``install()`` publishes a fleet for the telemetry
-server's ``/debug/tenancy`` route. The gate is
-``benchmarks/replay.py --tenants N`` (scenario ``multi-tenant-zipf``).
+over one registry — plus a
+:class:`~spark_bagging_tpu.tenancy.fleet.QuarantineMachine` [ISSUE 18]
+that contains a failing tenant's blast radius (requests shed with
+:class:`~spark_bagging_tpu.tenancy.admission.TenantQuarantined`,
+seeded-backoff single-probe recovery) without touching its neighbours.
+``install()`` publishes a fleet for the telemetry server's
+``/debug/tenancy`` route. The gates are
+``benchmarks/replay.py --tenants N`` (scenario ``multi-tenant-zipf``)
+and ``--tenants N --chaos tenant-chaos`` (scenario ``tenant-chaos``).
 """
 
 from __future__ import annotations
@@ -45,9 +51,10 @@ from spark_bagging_tpu.tenancy.admission import (
     AdmissionController,
     AdmissionShed,
     QuotaExceeded,
+    TenantQuarantined,
 )
 from spark_bagging_tpu.tenancy.budget import RefitBudgeter
-from spark_bagging_tpu.tenancy.fleet import TenantFleet
+from spark_bagging_tpu.tenancy.fleet import QuarantineMachine, TenantFleet
 from spark_bagging_tpu.tenancy.residency import ResidencyManager
 from spark_bagging_tpu.tenancy.spec import (
     PRIORITY_CLASSES,
@@ -61,10 +68,12 @@ __all__ = [
     "PRIORITY_LEVEL",
     "AdmissionController",
     "AdmissionShed",
+    "QuarantineMachine",
     "QuotaExceeded",
     "RefitBudgeter",
     "ResidencyManager",
     "TenantFleet",
+    "TenantQuarantined",
     "TenantSpec",
     "WFQScheduler",
     "get",

@@ -63,6 +63,17 @@ class QuotaExceeded(AdmissionShed):
         super().__init__(tenant, "quota", msg)
 
 
+class TenantQuarantined(AdmissionShed):
+    """The tenant is quarantined (blast-radius containment): its
+    requests are shed at the edge with reason ``"quarantine"`` until
+    the seeded backoff elapses and a single probe request recovers it.
+    Distinct from quota/priority sheds so clients can tell "slow down"
+    from "your tenant is being contained"."""
+
+    def __init__(self, tenant: str, msg: str):
+        super().__init__(tenant, "quarantine", msg)
+
+
 class _Bucket:
     """Deterministic token bucket: linear refill on the passed clock."""
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 import math
 from typing import Iterable
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.tenancy.spec import TenantSpec
 
@@ -53,19 +53,34 @@ class RefitBudgeter:
         specs = list(specs)
         if not specs:
             raise ValueError("RefitBudgeter needs at least one tenant")
-        weight_sum = sum(s.effective_refit_weight for s in specs)
-        #: per-tenant refits allowed per window (floor of 1: the tail
-        #: must never be rounded out of retraining entirely)
-        self._quota: dict[str, int] = {
-            s.name: max(1, math.ceil(
-                self.total_per_window
-                * s.effective_refit_weight / weight_sum))
-            for s in specs
-        }
+        self._specs: dict[str, TenantSpec] = {s.name: s for s in specs}
+        #: tenants whose budget has been released back to the pool
+        #: (quarantined) — quota 0 until readmitted
+        self._released: set[str] = set()
+        self._quota: dict[str, int] = {}
+        self._recompute_locked()
         self._window_start: float | None = None
         self._used: dict[str, int] = {}
         self._allowed: dict[str, int] = {}
         self._denied: dict[str, int] = {}
+
+    def _recompute_locked(self) -> None:
+        """Reallocate the window total over non-released tenants,
+        weight-proportional with the floor-of-1 anti-starvation rule;
+        released tenants hold quota 0 (their share flows to the pool)."""
+        live = [s for n, s in sorted(self._specs.items())
+                if n not in self._released]
+        quota = {n: 0 for n in self._specs}
+        if live:
+            weight_sum = sum(s.effective_refit_weight for s in live)
+            for s in live:
+                #: floor of 1: the tail must never be rounded out of
+                #: retraining entirely
+                quota[s.name] = max(1, math.ceil(
+                    self.total_per_window
+                    * s.effective_refit_weight / weight_sum))
+        # sbt-lint: disable=shared-state-unlocked — _locked helper: callers hold self._lock (or run pre-publication in __init__)
+        self._quota = quota
 
     def quota(self, name: str) -> int:
         try:
@@ -79,6 +94,8 @@ class RefitBudgeter:
         """May ``name`` start a refit at ``now``? Deterministic:
         windows are ``[start, start + window_s)`` anchored at the
         first decision's clock, and allowances reset at each turn."""
+        if faults.ACTIVE is not None:
+            faults.fire("budget.refit", tenant=name)
         with self._lock:
             quota = self._quota.get(name)
             if quota is None:
@@ -102,6 +119,34 @@ class RefitBudgeter:
                           labels={"tenant": name})
         return ok
 
+    def release(self, name: str) -> None:
+        """Return ``name``'s refit entitlement to the pool (quarantine
+        trip): its quota drops to 0 and every surviving tenant's share
+        is recomputed over the remaining weight mass. Idempotent."""
+        with self._lock:
+            if name not in self._specs:
+                raise KeyError(
+                    f"unknown tenant {name!r}; have "
+                    f"{sorted(self._specs)}"
+                )
+            if name in self._released:
+                return
+            self._released.add(name)
+            self._recompute_locked()
+
+    def readmit(self, name: str) -> None:
+        """Undo :meth:`release` after quarantine recovery. Idempotent."""
+        with self._lock:
+            if name not in self._specs:
+                raise KeyError(
+                    f"unknown tenant {name!r}; have "
+                    f"{sorted(self._specs)}"
+                )
+            if name not in self._released:
+                return
+            self._released.discard(name)
+            self._recompute_locked()
+
     def for_tenant(self, name: str):
         """A zero-arg-style hook bound to one tenant — the exact shape
         ``OnlineTrainer(refit_budget=...)`` consumes: called with the
@@ -123,6 +168,7 @@ class RefitBudgeter:
                 "total_per_window": self.total_per_window,
                 "window_s": self.window_s,
                 "quota": dict(sorted(self._quota.items())),
+                "released": sorted(self._released),
                 "window_used": dict(sorted(self._used.items())),
                 "allowed": dict(sorted(self._allowed.items())),
                 "denied": dict(sorted(self._denied.items())),

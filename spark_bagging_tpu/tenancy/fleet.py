@@ -22,17 +22,35 @@ Stepped batchers (``threaded=False``, the default) make the whole
 fleet a pure function of (workload, specs, seed) under a virtual
 clock — the replay drill's mode. Threaded batchers serve live
 traffic with identical policy decisions; only batch timing differs.
+
+Blast-radius containment [ISSUE 18]: a :class:`QuarantineMachine`
+rides every fleet. Repeated failures attributed to ONE tenant
+(dispatch faults, degraded batchers, restore failures) trip that
+tenant into quarantine — its requests shed with a distinct
+:class:`~spark_bagging_tpu.tenancy.admission.TenantQuarantined`, its
+refit budget released back to the pool, its residency slot freed —
+while every other tenant's traffic proceeds untouched (zero added
+recompiles, bitwise-identical outputs: the tenant-chaos drill's
+asserted invariant). Recovery is seeded exponential backoff plus a
+single probe request; a failed probe re-trips with escalated backoff.
 """
 
 from __future__ import annotations
 
 import bisect
+import hashlib
+import random
 from typing import Any, Iterable
 
+from spark_bagging_tpu import faults as faults_mod
 from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
+from spark_bagging_tpu.faults import FaultError
 from spark_bagging_tpu.serving.batcher import Degraded, Overloaded
-from spark_bagging_tpu.tenancy.admission import AdmissionController
+from spark_bagging_tpu.tenancy.admission import (
+    AdmissionController,
+    TenantQuarantined,
+)
 from spark_bagging_tpu.tenancy.budget import RefitBudgeter
 from spark_bagging_tpu.tenancy.residency import ResidencyManager
 from spark_bagging_tpu.tenancy.spec import TenantSpec
@@ -40,6 +58,288 @@ from spark_bagging_tpu.tenancy.wfq import WFQScheduler
 
 #: bounded per-tenant latency reservoir (sorted insert; p99 export)
 _LATENCY_KEEP = 2048
+
+
+class _TenantHealth:
+    """One tenant's containment state (owned by QuarantineMachine)."""
+
+    __slots__ = ("state", "failures", "until", "trips",
+                 "consecutive_trips", "probes", "recoveries", "sheds",
+                 "kinds", "rng")
+
+    def __init__(self, rng: random.Random):
+        self.state = "healthy"  # healthy | quarantined | probing
+        self.failures: list[float] = []
+        self.until = 0.0
+        self.trips = 0
+        self.consecutive_trips = 0
+        self.probes = 0
+        self.recoveries = 0
+        self.sheds = 0
+        self.kinds: dict[str, int] = {}
+        self.rng = rng
+
+
+# sbt-lint: shared-state
+class QuarantineMachine:
+    """Per-tenant failure-window circuit breaker with seeded backoff.
+
+    ``threshold`` failures inside ``window_s`` (on the caller-passed
+    clock — no wall reads, so replay transcripts are byte-identical)
+    trip a tenant into ``quarantined``. While quarantined its requests
+    are shed with :class:`TenantQuarantined`. Once the backoff elapses
+    the FIRST request through :meth:`admit` becomes the single probe
+    (state ``probing``; everything else keeps shedding): a successful
+    probe recovers the tenant and resets the backoff ladder, a failed
+    one re-trips with the next rung. Backoff is
+    ``min(max_backoff_s, backoff_s * factor**consecutive_trips)``
+    jittered by a per-tenant ``random.Random`` seeded from
+    ``(seed, tenant)`` — reproducible, but two tenants tripping at the
+    same instant never synchronize their recovery stampedes.
+
+    The machine is pure bookkeeping: the trip's fleet-level side
+    effects (refit-budget release, residency eviction) belong to the
+    :class:`TenantFleet`, keyed off the booleans returned here. Its
+    lock is a leaf — nothing is called back under it.
+    """
+
+    def __init__(
+        self,
+        names: Iterable[str],
+        *,
+        threshold: int = 3,
+        window_s: float = 1.0,
+        backoff_s: float = 0.5,
+        backoff_factor: float = 2.0,
+        max_backoff_s: float = 30.0,
+        seed: int = 0,
+    ) -> None:
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if backoff_s <= 0:
+            raise ValueError(f"backoff_s must be > 0, got {backoff_s}")
+        if backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor must be >= 1, got {backoff_factor}"
+            )
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff_s = float(max_backoff_s)
+        self.seed = int(seed)
+        self._lock = make_lock("tenancy.quarantine")
+        self._t: dict[str, _TenantHealth] = {
+            str(n): _TenantHealth(random.Random(
+                int.from_bytes(
+                    hashlib.sha256(
+                        f"{self.seed}|quarantine|{n}".encode()
+                    ).digest()[:8],
+                    "big",
+                )
+            ))
+            for n in names
+        }
+        self._events: list[dict] = []
+        self._seq = 0
+
+    def _h(self, name: str) -> _TenantHealth:
+        # sbt-lint: disable=shared-state-unlocked — _locked-path helper, every caller holds self._lock
+        try:
+            return self._t[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown tenant {name!r}; have {sorted(self._t)}"
+            ) from None
+
+    def _event(self, kind: str, tenant: str, **extra: Any) -> None:
+        # sbt-lint: disable=shared-state-unlocked — _locked-path helper, every caller holds self._lock
+        self._seq += 1
+        self._events.append({"kind": kind, "tenant": tenant,
+                             "seq": self._seq, **extra})
+
+    # -- the decision seams ---------------------------------------------
+
+    def admit(self, name: str, now: float) -> str:
+        """Gate one request: ``"healthy"`` (proceed), ``"probe"``
+        (proceed, and this request's outcome decides recovery), or
+        raises :class:`TenantQuarantined` (shed, counted)."""
+        probe = False
+        with self._lock:
+            h = self._h(name)
+            if h.state == "healthy":
+                return "healthy"
+            if h.state == "quarantined" and now >= h.until:
+                h.state = "probing"
+                h.probes += 1
+                self._event("probe", name)
+                probe = True
+            else:
+                h.sheds += 1
+        if probe:
+            telemetry.inc("sbt_tenant_quarantine_probes_total",
+                          labels={"tenant": name})
+            return "probe"
+        # unlabeled total first, then the attribution twin — the same
+        # idiom as every tenancy shed counter
+        telemetry.inc("sbt_tenancy_shed_total")
+        telemetry.inc("sbt_tenancy_shed_total",
+                      labels={"tenant": name, "reason": "quarantine"})
+        telemetry.inc("sbt_tenant_quarantine_shed_total")
+        telemetry.inc("sbt_tenant_quarantine_shed_total",
+                      labels={"tenant": name})
+        raise TenantQuarantined(
+            name, f"tenant {name!r} is quarantined (blast-radius "
+            "containment); retry after backoff")
+
+    def record_failure(self, name: str, now: float, kind: str) -> bool:
+        """Feed one tenant-attributed failure into the window. Returns
+        True iff THIS failure tripped quarantine (the caller then runs
+        the fleet-level side effects)."""
+        tripped = False
+        with self._lock:
+            h = self._h(name)
+            h.kinds[kind] = h.kinds.get(kind, 0) + 1
+            if h.state == "healthy":
+                cutoff = now - self.window_s
+                h.failures = [t for t in h.failures if t > cutoff]
+                h.failures.append(float(now))
+                if len(h.failures) >= self.threshold:
+                    self._trip_locked(h, name, now)
+                    tripped = True
+        telemetry.inc("sbt_tenant_quarantine_failures_total",
+                      labels={"tenant": name, "kind": kind})
+        if tripped:
+            self._count_trip(name)
+        return tripped
+
+    def probe_result(self, name: str, now: float, ok: bool) -> bool:
+        """Settle the in-flight probe. Returns True iff a failed probe
+        re-tripped quarantine (escalated backoff)."""
+        retripped = False
+        recovered = False
+        with self._lock:
+            h = self._h(name)
+            if h.state != "probing":
+                return False
+            if ok:
+                h.state = "healthy"
+                h.consecutive_trips = 0
+                h.failures = []
+                h.recoveries += 1
+                self._event("recover", name)
+                recovered = True
+            else:
+                self._trip_locked(h, name, now)
+                retripped = True
+        if recovered:
+            telemetry.inc("sbt_tenant_quarantine_recoveries_total",
+                          labels={"tenant": name})
+            self._export_active()
+        if retripped:
+            self._count_trip(name)
+        return retripped
+
+    def probe_aborted(self, name: str) -> None:
+        """The probe request never reached a verdict (shed upstream of
+        the tenant's own path, e.g. by admission): back to quarantined
+        with the SAME deadline, so the next eligible request probes."""
+        with self._lock:
+            h = self._h(name)
+            if h.state == "probing":
+                h.state = "quarantined"
+                self._event("probe_aborted", name)
+
+    def _trip_locked(self, h: _TenantHealth, name: str,
+                     now: float) -> None:
+        # sbt-lint: disable=shared-state-unlocked — _locked helper, every caller holds self._lock
+        delay = min(self.max_backoff_s,
+                    self.backoff_s
+                    * self.backoff_factor ** h.consecutive_trips)
+        # jitter from the tenant's private seeded stream: deterministic
+        # per (seed, tenant, trip index), never synchronized across
+        # tenants
+        delay *= 0.75 + 0.5 * h.rng.random()
+        h.consecutive_trips += 1
+        h.trips += 1
+        h.state = "quarantined"
+        h.until = float(now) + delay
+        h.failures = []
+        self._event("trip", name, backoff_s=round(delay, 9),
+                     until=round(h.until, 9))
+
+    def _count_trip(self, name: str) -> None:
+        telemetry.inc("sbt_tenant_quarantine_trips_total")
+        telemetry.inc("sbt_tenant_quarantine_trips_total",
+                      labels={"tenant": name})
+        telemetry.emit_event({
+            "kind": "tenant_quarantine_trip", "tenant": name,
+        })
+        self._export_active()
+
+    def _export_active(self) -> None:
+        with self._lock:
+            n = sum(1 for h in self._t.values() if h.state != "healthy")
+        telemetry.set_gauge("sbt_tenant_quarantine_active", float(n))
+
+    # -- reporting ------------------------------------------------------
+
+    def healthy(self, name: str) -> bool:
+        with self._lock:
+            return self._h(name).state == "healthy"
+
+    def events(self) -> list[dict]:
+        """The full transition log (copy), seq-ordered — the
+        quarantine transcript the tenant-chaos drill digests."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        """{"trips"|"sheds"|"probes"|"recoveries": {tenant: n}},
+        name-sorted, zero-count tenants omitted — transcript-ready."""
+        with self._lock:
+            out: dict[str, dict[str, int]] = {
+                "trips": {}, "sheds": {}, "probes": {}, "recoveries": {},
+            }
+            for name in sorted(self._t):
+                h = self._t[name]
+                for key, val in (("trips", h.trips), ("sheds", h.sheds),
+                                 ("probes", h.probes),
+                                 ("recoveries", h.recoveries)):
+                    if val:
+                        out[key][name] = val
+            return out
+
+    def state(self) -> dict:
+        """Deterministic report (``/debug/tenancy``): config + every
+        tenant the machine has ever acted on."""
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "window_s": self.window_s,
+                "backoff_s": self.backoff_s,
+                "backoff_factor": self.backoff_factor,
+                "max_backoff_s": self.max_backoff_s,
+                "seed": self.seed,
+                "events": len(self._events),
+                "tenants": {
+                    name: {
+                        "state": h.state,
+                        "trips": h.trips,
+                        "consecutive_trips": h.consecutive_trips,
+                        "probes": h.probes,
+                        "recoveries": h.recoveries,
+                        "sheds": h.sheds,
+                        "until": (round(h.until, 9)
+                                  if h.state != "healthy" else None),
+                        "failures": dict(sorted(h.kinds.items())),
+                    }
+                    for name, h in sorted(self._t.items())
+                    if h.trips or h.sheds or h.kinds
+                },
+            }
 
 
 # sbt-lint: shared-state
@@ -58,6 +358,12 @@ class TenantFleet:
         escalate_after: int = 3,
         refit_total_per_window: int = 4,
         refit_window_s: float = 60.0,
+        quarantine_threshold: int = 3,
+        quarantine_window_s: float = 1.0,
+        quarantine_backoff_s: float = 0.5,
+        quarantine_backoff_factor: float = 2.0,
+        quarantine_max_backoff_s: float = 30.0,
+        quarantine_seed: int = 0,
         threaded: bool = False,
         batcher_opts: dict | None = None,
     ) -> None:
@@ -81,6 +387,15 @@ class TenantFleet:
         self.budget = RefitBudgeter(
             specs, total_per_window=refit_total_per_window,
             window_s=refit_window_s,
+        )
+        self.quarantine = QuarantineMachine(
+            names,
+            threshold=quarantine_threshold,
+            window_s=quarantine_window_s,
+            backoff_s=quarantine_backoff_s,
+            backoff_factor=quarantine_backoff_factor,
+            max_backoff_s=quarantine_max_backoff_s,
+            seed=quarantine_seed,
         )
         self.residency: ResidencyManager | None = None
         if residency_capacity is not None:
@@ -157,14 +472,28 @@ class TenantFleet:
 
         Raises :class:`~spark_bagging_tpu.tenancy.admission.QuotaExceeded`
         / :class:`~spark_bagging_tpu.tenancy.admission.AdmissionShed`
-        when admission sheds it (already counted). The request reaches
-        its batcher at the next :meth:`dispatch`."""
+        when admission sheds it (already counted), and
+        :class:`~spark_bagging_tpu.tenancy.admission.TenantQuarantined`
+        while the tenant is contained. The request reaches its batcher
+        at the next :meth:`dispatch`."""
+        # quarantine gates BEFORE admission: a contained tenant's
+        # traffic must not even drain its own quota buckets, and its
+        # single recovery probe is chosen here
+        verdict = self.quarantine.admit(name, now)
+        probe = verdict == "probe"
         rows = int(getattr(X, "shape", (1,))[0])
-        self.admission.check(name, rows, now)
+        try:
+            self.admission.check(name, rows, now)
+        except Exception:
+            if probe:
+                # the probe never reached the tenant's own path — keep
+                # the quarantine deadline, probe again next request
+                self.quarantine.probe_aborted(name)
+            raise
         with self._lock:
             self._submitted[name] = self._submitted.get(name, 0) + rows
         return self.wfq.enqueue(
-            name, (X, mode, deadline_ms), cost=float(rows))
+            name, (X, mode, deadline_ms, probe), cost=float(rows))
 
     def dispatch(self, *, now: float,
                  run_pending: bool = True) -> list[dict]:
@@ -191,13 +520,31 @@ class TenantFleet:
         out: list[dict] = []
         touched: set[str] = set()
         stepped = run_pending and not self._threaded
-        for tenant, (X, mode, deadline_ms) in self.wfq.drain():
+        while len(self.wfq):
+            head = self.wfq.head_tenant()
+            try:
+                tenant, (X, mode, deadline_ms, probe) = self.wfq.pop()
+            except FaultError:
+                # the pop probe fired BEFORE the heap mutation: the
+                # head request stays queued for the next dispatch.
+                # Attribute the fault to the head tenant and end this
+                # drain pass — containment, never an escaping fault
+                self._note_failure(head, now, "wfq")
+                break
             if self.residency is not None and not stepped:
-                self.residency.touch(tenant)
+                try:
+                    self.residency.touch(tenant)
+                except FaultError:
+                    # an injected restore fault costs THIS tenant a
+                    # lower-on-demand, never the dispatch pass
+                    self._note_failure(tenant, now, "restore")
             rows = int(getattr(X, "shape", (1,))[0])
             rec: dict[str, Any] = {"tenant": tenant, "future": None,
                                    "rows": rows, "shed": None}
+            failure_kind: str | None = None
             try:
+                if faults_mod.ACTIVE is not None:
+                    faults_mod.fire("fleet.dispatch", tenant=tenant)
                 rec["future"] = self.batcher(tenant).submit(
                     X, mode=mode, deadline_ms=deadline_ms)
                 touched.add(tenant)
@@ -209,6 +556,30 @@ class TenantFleet:
                 self.admission.observe_overload(now)
             except Degraded:
                 rec["shed"] = "degraded"
+                failure_kind = "degraded"
+            except FaultError:
+                # the tenant-scoped dispatch fault: shed THIS request
+                # with a distinct reason and feed the quarantine
+                # window — the blast radius is one tenant's record,
+                # not the drain loop
+                rec["shed"] = "fault"
+                failure_kind = "dispatch"
+            if probe:
+                if rec["future"] is not None:
+                    # the single recovery probe made it through the
+                    # tenant's own path: recover + re-pool its budget
+                    self.quarantine.probe_result(tenant, now, True)
+                    self.budget.readmit(tenant)
+                elif failure_kind is not None:
+                    # the probe failed on the tenant's own path:
+                    # re-trip with escalated backoff
+                    self.quarantine.probe_result(tenant, now, False)
+                else:
+                    # overload is the fleet's weather, not the
+                    # tenant's health — probe again next request
+                    self.quarantine.probe_aborted(tenant)
+            elif failure_kind is not None:
+                self._note_failure(tenant, now, failure_kind)
             if rec["shed"] is not None:
                 with self._lock:
                     key = (tenant, rec["shed"])
@@ -224,17 +595,56 @@ class TenantFleet:
         if stepped:
             for tenant in sorted(touched):
                 if self.residency is not None:
-                    self.residency.touch(tenant)
+                    try:
+                        self.residency.touch(tenant)
+                    except FaultError:
+                        self._note_failure(tenant, now, "restore")
                 self.batcher(tenant).run_pending()
         return out
+
+    def _note_failure(self, tenant: str | None, now: float,
+                      kind: str) -> None:
+        """Feed one tenant-attributed failure into the quarantine
+        window; on a trip, run the fleet-level containment edges."""
+        if tenant is None:
+            return
+        if self.quarantine.record_failure(tenant, now, kind):
+            self._on_trip(tenant, now)
+
+    def _on_trip(self, tenant: str, now: float) -> None:
+        # release the refit entitlement back to the pool: survivors'
+        # quotas recompute over the remaining weight mass
+        self.budget.release(tenant)
+        if self.residency is not None:
+            try:
+                # free the residency slot NOW (non-destructive demote:
+                # the AOT cache keeps the tenant restorable)
+                self.residency.evict(tenant)
+            except FaultError:
+                # an injected demote_persist fault may not strand the
+                # trip: the slot is reclaimed by normal LRU
+                # enforcement at the next touch, and the previous
+                # on-disk cache entry is still intact
+                self._note_failure(tenant, now, "demote")
 
     # -- refit budgeting -------------------------------------------------
 
     def refit_allowed(self, name: str, now: float) -> bool:
         """The :class:`RefitBudgeter` decision for ``name`` — also the
         hook to pass an ``OnlineTrainer`` as ``refit_budget=``
-        (via :meth:`RefitBudgeter.for_tenant`)."""
-        return self.budget.allow(name, now)
+        (via :meth:`RefitBudgeter.for_tenant`). A quarantined tenant
+        never refits (its budget is pooled), and an injected
+        ``budget.refit`` fault is a counted denial, not an escape."""
+        if not self.quarantine.healthy(name):
+            telemetry.inc("sbt_tenancy_refit_denied_total",
+                          labels={"tenant": name})
+            return False
+        try:
+            return self.budget.allow(name, now)
+        except FaultError:
+            telemetry.inc("sbt_tenancy_refit_denied_total",
+                          labels={"tenant": name})
+            return False
 
     # -- latency accounting ----------------------------------------------
 
@@ -319,6 +729,7 @@ class TenantFleet:
             "residency": (None if self.residency is None
                           else self.residency.state()),
             "refit_budget": self.budget.state(),
+            "quarantine": self.quarantine.state(),
             "downstream_sheds": self.shed_counts(),
             "served_rows": self.served_rows(),
             "latency_p99_ms": self.latency_p99_ms(),

@@ -37,7 +37,7 @@ import os
 from collections import OrderedDict
 from typing import Any, Callable
 
-from spark_bagging_tpu import telemetry
+from spark_bagging_tpu import faults, telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.telemetry import capacity as _capacity
 
@@ -146,6 +146,19 @@ class ResidencyManager:
             self._export_locked()
             return "restored"
 
+    def evict(self, name: str) -> bool:
+        """Force one tenant out of residency NOW (the quarantine trip's
+        slot-freeing edge — not a budget decision, so no victim walk
+        and no pin check). Demotes through the normal non-destructive
+        path; a no-op for tenants that are not resident. Returns
+        whether a demotion happened."""
+        with self._lock:
+            if name not in self._resident:
+                return False
+            self._demote_locked(name)
+            self._export_locked()
+            return True
+
     def _enforce_locked(self, *, keep: str) -> None:
         while len(self._resident) > self.capacity:
             victim = self._pick_victim_locked(keep=keep)
@@ -177,6 +190,10 @@ class ResidencyManager:
         ex = self.registry.executor(name)
         if ex.compiled_buckets and not aot_cache.covers(
                 ex, self.tenant_dir(name)):
+            if faults.ACTIVE is not None:
+                # before the persist I/O: a kill here is the torn-demote
+                # drill — the previous on-disk entry must survive
+                faults.fire("residency.demote_persist", tenant=name)
             # persist BEFORE releasing: demotion must never strand a
             # tenant without a restore path. Skipped when the on-disk
             # cache already covers the compiled ladder — NOT as an
@@ -195,6 +212,8 @@ class ResidencyManager:
 
     def _restore_locked(self, name: str) -> None:
         ex = self.registry.executor(name)
+        if faults.ACTIVE is not None:
+            faults.fire("residency.restore", tenant=name)
         restored = ex.restore_executables(self.tenant_dir(name))
         # sbt-lint: disable=shared-state-unlocked — _locked helper, every caller holds self._lock
         self._restores[name] = self._restores.get(name, 0) + 1

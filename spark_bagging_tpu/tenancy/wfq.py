@@ -35,6 +35,8 @@ from __future__ import annotations
 import heapq
 from typing import Any, Iterator
 
+from spark_bagging_tpu import faults
+
 
 class WFQScheduler:
     """Virtual-finish-time fair queue over named tenants."""
@@ -64,6 +66,11 @@ class WFQScheduler:
     def vtime(self) -> float:
         return self._vtime
 
+    def head_tenant(self) -> str | None:
+        """The tenant whose request would pop next (None when empty) —
+        the fleet's attribution handle when the pop itself faults."""
+        return self._heap[0][1] if self._heap else None
+
     def enqueue(self, tenant: str, item: Any, cost: float = 1.0) -> float:
         """Tag and queue one request; returns its finish tag.
 
@@ -92,6 +99,11 @@ class WFQScheduler:
         """Next (tenant, item) in fair order; advances virtual time."""
         if not self._heap:
             raise IndexError("pop from an empty WFQScheduler")
+        if faults.ACTIVE is not None:
+            # probe BEFORE the heap mutation: an injected pop fault
+            # leaves the head request queued, so containment never
+            # silently drops a request
+            faults.fire("wfq.pop", tenant=self._heap[0][1])
         finish, tenant, _seq, cost, item = heapq.heappop(self._heap)
         # self-clocking: v jumps to the tag in service, so a tenant
         # that idled cannot bank credit from the past

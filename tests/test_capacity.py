@@ -399,6 +399,7 @@ class TestSurfaces:
             "tenancy-tail-latency-burn",
             "tenancy-quota-shed-rate",
             "tenancy-pin-violation",
+            "tenancy-quarantine-flapping",
         ]
         assert [r.name for r in alerts.default_capacity_rules(
             tenancy=False)] == [

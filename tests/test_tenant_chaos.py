@@ -1,0 +1,528 @@
+"""Tenant blast-radius containment [ISSUE 18]: fault sites for the
+tenancy/residency plane (tenant-scoped ``FaultSpec``s, the two-way
+site-table invariant), the per-tenant quarantine machine (failure
+window, seeded jittered backoff, single-probe recovery), graceful
+degradation of corrupt per-tenant AOT cache entries (counted miss,
+never an escaping exception), torn demote-path writes that leave the
+previous entry loadable, the quarantine telemetry/alert/debug
+surfaces, and the ``tenant-chaos`` drill whose contract is that
+bystander tenants are provably untouched — bitwise-identical outputs
+and zero added recompiles — while one tenant trips, backs off, and
+recovers.
+"""
+
+import json
+import os
+import re
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    faults,
+    telemetry,
+)
+from spark_bagging_tpu.serving import ModelRegistry
+from spark_bagging_tpu.serving import program_cache as _pc
+from spark_bagging_tpu.tenancy import (
+    QuarantineMachine,
+    TenantQuarantined,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_bagging_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    prev_cache = _pc.install(_pc.ProgramCache(capacity=64))
+    yield
+    faults.disarm()  # no chaos plan may leak into later tests
+    _pc.install(prev_cache)
+    telemetry.reset()
+    telemetry.enable()
+
+
+def _counter(name, labels=None):
+    return telemetry.registry().counter(name, labels=labels).value
+
+
+def _problem(n=96, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w > 0).astype(np.int32)
+    return X, y
+
+
+def _fit(seed=0, n_estimators=2):
+    X, y = _problem(seed=seed)
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=n_estimators, seed=seed,
+    ).fit(X, y)
+
+
+# -- the site table is an invariant, not documentation ------------------
+
+_FIRE_RE = re.compile(r"faults(?:_mod)?\.fire\(\s*[\"']([\w.]+)[\"']")
+
+
+def _fired_sites():
+    """Every site name passed to ``faults.fire`` anywhere in the
+    package (faults.py itself excluded: it defines the probe)."""
+    sites = {}
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if os.path.basename(path) == "faults.py":
+                continue
+            with open(path) as f:
+                for m in _FIRE_RE.finditer(f.read()):
+                    sites.setdefault(m.group(1), []).append(
+                        os.path.relpath(path, REPO))
+    return sites
+
+
+class TestSiteTable:
+    def test_every_fired_site_is_registered(self):
+        """Satellite [ISSUE 18]: a ``faults.fire("x")`` call with no
+        SITES entry is a silent no-op plan key — static analysis, so
+        the drift is caught at test time, not mid-incident."""
+        fired = _fired_sites()
+        unknown = set(fired) - set(faults.SITES)
+        assert not unknown, (
+            f"fire() call sites not registered in faults.SITES: "
+            f"{ {s: fired[s] for s in sorted(unknown)} }"
+        )
+
+    def test_every_registered_site_has_a_live_call_site(self):
+        """The other direction: a SITES key nobody fires is a dead
+        entry in the documented fault surface."""
+        fired = _fired_sites()
+        dead = set(faults.SITES) - set(fired)
+        assert not dead, (
+            f"faults.SITES entries with no live fire() call: "
+            f"{sorted(dead)}"
+        )
+
+
+# -- tenant-scoped fault specs ------------------------------------------
+
+class TestTenantScopedSpecs:
+    def test_roundtrip_and_builtin_plan(self):
+        spec = {"schema": 1, "name": "p", "seed": 7, "faults": [
+            {"site": "fleet.dispatch", "action": "error",
+             "tenant": "t1", "at": [2]},
+        ]}
+        plan = faults.FaultPlan.from_dict(spec)
+        assert plan.to_dict()["faults"][0]["tenant"] == "t1"
+        assert (faults.FaultPlan.from_dict(plan.to_dict()).digest()
+                == plan.digest())
+        builtin = faults.builtin_plan_spec("tenant-chaos", seed=111)
+        assert {f["tenant"] for f in builtin["faults"]} == {"t1"}
+
+    def test_tenant_filter_counts_on_its_own_clock(self):
+        """A tenant-scoped spec fires on the per-(site, tenant) hit
+        counter: heavy traffic from OTHER tenants must not advance —
+        or consume — the target's schedule."""
+        plan = faults.FaultPlan([
+            {"site": "fleet.dispatch", "action": "error",
+             "tenant": "t1", "at": [2]},
+        ])
+        with faults.armed(plan):
+            for _ in range(5):  # t0's hits are not t1's hits
+                faults.fire("fleet.dispatch", tenant="t0")
+            faults.fire("fleet.dispatch", tenant="t1")  # t1 hit 1
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("fleet.dispatch", tenant="t1")  # hit 2
+            faults.fire("fleet.dispatch", tenant="t1")  # hit 3: done
+        snap = plan.snapshot()
+        assert snap["fired_total"] == 1
+        assert snap["tenant_hits"] == {
+            "fleet.dispatch|t0": 5, "fleet.dispatch|t1": 3,
+        }
+
+    def test_tenant_blind_snapshots_stay_stable(self):
+        """No ``tenant=`` info ever passed -> no ``tenant_hits`` key:
+        the committed digests of the pre-existing chaos baselines
+        (mixed, peer-loss, ...) must not grow a key."""
+        plan = faults.FaultPlan([
+            {"site": "batcher.submit", "action": "error", "at": [999]},
+        ])
+        with faults.armed(plan):
+            faults.fire("batcher.submit")
+        assert "tenant_hits" not in plan.snapshot()
+
+
+# -- the quarantine machine (jax-free) ----------------------------------
+
+def _drive_cycle(q, now=0.0):
+    """threshold failures -> trip; returns the trip event."""
+    for i in range(3):
+        tripped = q.record_failure("t1", now + i * 0.01, "dispatch")
+    assert tripped
+    return [e for e in q.events() if e["kind"] == "trip"][-1]
+
+
+class TestQuarantineMachine:
+    def test_trip_shed_probe_recover_cycle(self):
+        q = QuarantineMachine(["t0", "t1"], threshold=3, window_s=1.0,
+                              backoff_s=0.5, seed=0)
+        trip = _drive_cycle(q)
+        assert not q.healthy("t1") and q.healthy("t0")
+        # inside the backoff: shed with the distinct exception type
+        with pytest.raises(TenantQuarantined):
+            q.admit("t1", trip["until"] - 1e-6)
+        assert q.admit("t0", 0.1) == "healthy"  # bystander untouched
+        # past the deadline: exactly one probe, everything else sheds
+        t = trip["until"] + 0.01
+        assert q.admit("t1", t) == "probe"
+        with pytest.raises(TenantQuarantined):
+            q.admit("t1", t)
+        assert q.probe_result("t1", t, ok=True) is False
+        assert q.healthy("t1")
+        c = q.counts()
+        assert c["trips"] == {"t1": 1} and c["recoveries"] == {"t1": 1}
+        assert c["sheds"]["t1"] == 2 and c["probes"] == {"t1": 1}
+        assert _counter("sbt_tenant_quarantine_shed_total") == 2.0
+        assert _counter("sbt_tenancy_shed_total",
+                        {"tenant": "t1", "reason": "quarantine"}) == 2.0
+
+    def test_failed_probe_retrips_with_escalated_backoff(self):
+        q = QuarantineMachine(["t1"], threshold=3, window_s=1.0,
+                              backoff_s=0.5, backoff_factor=2.0, seed=3)
+        first = _drive_cycle(q)
+        t = first["until"] + 0.01
+        assert q.admit("t1", t) == "probe"
+        assert q.probe_result("t1", t, ok=False) is True
+        second = [e for e in q.events() if e["kind"] == "trip"][-1]
+        # rung 2 of the ladder: nominal 1.0s vs 0.5s; jitter spans
+        # [0.75, 1.25), so the escalated rung is strictly longer
+        assert second["backoff_s"] > first["backoff_s"]
+        assert not q.healthy("t1")
+
+    def test_probe_aborted_keeps_the_deadline(self):
+        q = QuarantineMachine(["t1"], threshold=3, seed=0)
+        trip = _drive_cycle(q)
+        t = trip["until"] + 0.01
+        assert q.admit("t1", t) == "probe"
+        q.probe_aborted("t1")  # shed upstream: no verdict reached
+        assert q.admit("t1", t) == "probe"  # next request re-probes
+        assert q.counts()["probes"] == {"t1": 2}
+        assert q.counts()["trips"] == {"t1": 1}  # an abort is no trip
+
+    def test_window_prunes_stale_failures(self):
+        q = QuarantineMachine(["t1"], threshold=3, window_s=0.5, seed=0)
+        assert not q.record_failure("t1", 0.0, "dispatch")
+        assert not q.record_failure("t1", 0.2, "dispatch")
+        # both earlier failures aged out of the 0.5s window by 0.8:
+        # without the prune this third failure would already trip
+        assert not q.record_failure("t1", 0.8, "dispatch")
+        assert not q.record_failure("t1", 0.85, "dispatch")
+        assert q.healthy("t1")
+        assert q.record_failure("t1", 0.9, "dispatch")  # 3 in-window
+        assert not q.healthy("t1")
+
+    def test_backoff_is_seeded_and_tenant_decorrelated(self):
+        def events_for(seed):
+            q = QuarantineMachine(["t1", "t2"], threshold=1,
+                                  backoff_s=0.5, seed=seed)
+            q.record_failure("t1", 0.0, "dispatch")
+            q.record_failure("t2", 0.0, "dispatch")
+            return q.events()
+
+        a, b = events_for(42), events_for(42)
+        assert a == b  # same seed: byte-identical transcript
+        c = events_for(43)
+        assert [e["backoff_s"] for e in a] != [e["backoff_s"] for e in c]
+        # two tenants tripping at the same instant never share a rung
+        until = {e["tenant"]: e["until"] for e in a if e["kind"] == "trip"}
+        assert until["t1"] != until["t2"]
+
+    def test_unknown_tenant_and_bad_config_rejected(self):
+        q = QuarantineMachine(["t1"], seed=0)
+        with pytest.raises(KeyError, match="unknown tenant"):
+            q.admit("ghost", 0.0)
+        with pytest.raises(ValueError, match="threshold"):
+            QuarantineMachine(["t1"], threshold=0)
+        with pytest.raises(ValueError, match="backoff_factor"):
+            QuarantineMachine(["t1"], backoff_factor=0.5)
+
+
+# -- the unarmed hot path pays nothing ----------------------------------
+
+def test_unarmed_tenancy_paths_never_call_fire(monkeypatch, tmp_path):
+    """The new probes follow the framework's founding rule: with no
+    plan armed, ``faults.fire`` is never even called (one module-
+    attribute read per probe). Patching fire() to raise proves it
+    across WFQ pop, the refit budgeter, and a residency demote/restore
+    round-trip (jax-free stand-ins)."""
+    from spark_bagging_tpu.tenancy import RefitBudgeter, WFQScheduler
+    from spark_bagging_tpu.tenancy.residency import ResidencyManager
+    from spark_bagging_tpu.tenancy.spec import TenantSpec
+
+    def boom(*a, **k):  # pragma: no cover — reaching it IS the failure
+        raise AssertionError("faults.fire called while unarmed")
+
+    monkeypatch.setattr(faults, "fire", boom)
+    assert faults.ACTIVE is None
+
+    wfq = WFQScheduler({"a": 2.0, "b": 1.0})
+    wfq.enqueue("a", "x")
+    assert wfq.pop() == ("a", "x")
+
+    specs = [TenantSpec(name="a", weight=2.0), TenantSpec(name="b")]
+    budget = RefitBudgeter(specs, total_per_window=2, window_s=1.0)
+    assert budget.allow("a", now=0.0) in (True, False)
+
+    class _Reg:
+        def executor(self, name):
+            class _Ex:
+                compiled_buckets = (8,)
+
+                def release_programs(self):
+                    return ()
+
+                def save_executables(self, path):
+                    os.makedirs(path, exist_ok=True)
+                    return (8,)
+
+                def restore_executables(self, path):
+                    return (8,)
+
+            return _Ex()
+
+    r = ResidencyManager(_Reg(), capacity=1, aot_root=str(tmp_path))
+    r.adopt("a")
+    r.adopt("b")      # demotes "a" (persist path)
+    r.touch("a")      # restores "a" (restore path)
+
+
+# -- graceful degradation: corrupt per-tenant AOT entries ---------------
+
+class TestCorruptAotEntry:
+    def test_corrupt_bucket_is_a_counted_miss_not_an_error(self, tmp_path):
+        """Satellite [ISSUE 18]: an unreadable/truncated executable
+        blob restores as a miss — warning + corrupt counter + lower-
+        on-demand — never an exception out of the restore path."""
+        path = str(tmp_path / "aot")
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        reg.register("m", _fit(seed=0), warmup=True)
+        ex = reg.executor("m")
+        saved = ex.save_executables(path)
+        assert saved
+        # tear ONE bucket blob; the manifest still promises it
+        from spark_bagging_tpu.serving.aot_cache import MANIFEST
+
+        blobs = sorted(f for f in os.listdir(path) if f != MANIFEST)
+        with open(os.path.join(path, blobs[0]), "wb") as f:
+            f.write(b"\x00garbage\x00")
+
+        _pc.clear()
+        reg2 = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        reg2.register("m", _fit(seed=0), warmup=False)
+        ex2 = reg2.executor("m")
+        c0 = _counter("sbt_aot_load_corrupt_total")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            restored = ex2.restore_executables(path)
+        assert _counter("sbt_aot_load_corrupt_total") == c0 + 1
+        assert any("restore" in str(w.message) for w in caught)
+        assert len(restored) == len(saved) - 1
+        # the miss lowers on demand and still serves
+        X = np.zeros((3, 8), np.float32)
+        assert np.asarray(ex2.forward(X)).shape[0] == 3
+
+    def test_unreadable_manifest_is_counted(self, tmp_path):
+        from spark_bagging_tpu.serving.aot_cache import MANIFEST
+
+        path = str(tmp_path / "aot")
+        os.makedirs(path)
+        with open(os.path.join(path, MANIFEST), "w") as f:
+            f.write("{not json")
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        reg.register("m", _fit(seed=0), warmup=False)
+        c0 = _counter("sbt_aot_load_corrupt_total")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert reg.executor("m").restore_executables(path) == ()
+        assert _counter("sbt_aot_load_corrupt_total") == c0 + 1
+
+
+# -- torn demote-path writes --------------------------------------------
+
+@pytest.mark.parametrize("site", ["residency.demote_persist",
+                                  "aot.save"])
+def test_torn_demote_persist_leaves_previous_entry_intact(
+        site, tmp_path):
+    """Satellite [ISSUE 18]: a kill at either seam of the demote-path
+    persist — before ``save_executables`` runs, or inside it before
+    the atomic install — must leave the PREVIOUS committed per-tenant
+    entry on disk, loadable, and the tenant restorable from it."""
+    from spark_bagging_tpu.tenancy.residency import ResidencyManager
+
+    reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    reg.register("a", _fit(seed=0), warmup=False)
+    reg.register("b", _fit(seed=1), warmup=False)
+    ex = reg.executor("a")
+    X = np.zeros((3, 8), np.float32)
+    ex.forward(X)  # compiles bucket 8 only
+    mgr = ResidencyManager(reg, capacity=1, aot_root=str(tmp_path))
+    dir_a = mgr.tenant_dir("a")
+    saved = ex.save_executables(dir_a)  # the previous committed entry
+    assert saved == (8,)
+    ex.warmup()  # full ladder -> covers() false -> demote re-persists
+    mgr.adopt("a")
+
+    plan = faults.FaultPlan([
+        {"site": site, "action": "kill", "at": [1]},
+    ])
+    with faults.armed(plan):
+        with pytest.raises(faults.SimulatedKill):
+            mgr.adopt("b")  # victim "a": demote persist is killed
+
+    # the previous entry is intact: a fresh process restores and serves
+    _pc.clear()
+    reg2 = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+    reg2.register("a", _fit(seed=0), warmup=False)
+    ex2 = reg2.executor("a")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert ex2.restore_executables(dir_a) == (8,)
+    np.testing.assert_array_equal(
+        np.asarray(ex2.forward(X)), np.asarray(ex.forward(X)))
+
+
+# -- telemetry, alert rule, debug surface -------------------------------
+
+class TestQuarantineSurfaces:
+    def test_series_help_covers_the_quarantine_family(self):
+        from spark_bagging_tpu.telemetry.registry import SERIES_HELP
+
+        for name in ("sbt_tenant_quarantine_trips_total",
+                     "sbt_tenant_quarantine_shed_total",
+                     "sbt_tenant_quarantine_probes_total",
+                     "sbt_tenant_quarantine_recoveries_total",
+                     "sbt_tenant_quarantine_failures_total",
+                     "sbt_tenant_quarantine_active",
+                     "sbt_aot_load_corrupt_total"):
+            assert name in SERIES_HELP, name
+
+    def test_flapping_rule_needs_two_trips_per_window(self):
+        """Satellite [ISSUE 18]: the quarantine-flapping rule burns on
+        the trips rate — one isolated trip stays quiet, >= 2 per fast
+        window (sustained across the slow window) pages."""
+        from spark_bagging_tpu.telemetry import alerts
+
+        rules = {r.name: r for r in alerts.default_capacity_rules(
+            fast_window_s=2.0, slow_window_s=5.0, cooldown_s=0.0)}
+        rule = rules["tenancy-quarantine-flapping"]
+        assert rule.series == "sbt_tenant_quarantine_trips_total"
+        assert rule.kind == "rate"
+        eng = alerts.AlertEngine([rule])
+        assert eng.evaluate(now=0.0) == []
+        telemetry.inc("sbt_tenant_quarantine_trips_total")  # one trip
+        quiet = [e for t in (2.0, 4.0, 5.5, 7.0)
+                 for e in eng.evaluate(now=t)]
+        assert [e for e in quiet if e["kind"] == "alert_fired"] == []
+        fired = []
+        for i in range(1, 12):  # 2 trips per evaluation tick: flapping
+            telemetry.inc("sbt_tenant_quarantine_trips_total", 2.0)
+            fired += [e for e in eng.evaluate(now=7.0 + i / 2)
+                      if e["kind"] == "alert_fired"]
+        assert [e["rule"] for e in fired] == [
+            "tenancy-quarantine-flapping"]
+
+    def test_debug_tenancy_carries_quarantine_state(self):
+        import spark_bagging_tpu.tenancy as tenancy
+        from spark_bagging_tpu.telemetry.server import _debug_tenancy
+        from spark_bagging_tpu.tenancy import TenantFleet, TenantSpec
+
+        fleet = TenantFleet([TenantSpec(name="t0"),
+                             TenantSpec(name="t1")])
+        tenancy.install(fleet)
+        try:
+            fleet.quarantine.record_failure("t1", 0.0, "dispatch")
+            body = _debug_tenancy()
+            q = body["quarantine"]
+            assert q["threshold"] == 3
+            assert q["tenants"]["t1"]["state"] == "healthy"
+            json.dumps(body)  # the document must stay JSON-clean
+        finally:
+            tenancy.uninstall()
+
+
+# -- the tenant-chaos drill ---------------------------------------------
+
+class TestTenantChaosDrill:
+    def test_blast_radius_containment_in_process(self):
+        """The tentpole's acceptance gate, in-process: the builtin
+        ``tenant-chaos`` plan through ``replay_median(tenants=True,
+        repeats=2)`` — cross-repeat byte identity (fault + quarantine
+        transcripts included) asserted by the harness — trips t1's
+        quarantine and recovers it, while every bystander's output
+        digest is bitwise-equal to a no-chaos control run and its
+        post-warmup compile count is exactly zero."""
+        from benchmarks import replay as R
+        from spark_bagging_tpu.telemetry import workload as workload_mod
+
+        wl = workload_mod.synthetic_workload(
+            "poisson", rate_rps=300.0, duration_s=0.4, seed=111,
+            width=8, bucket_bounds=(8, 32),
+        )
+        chaos = faults.builtin_plan_spec("tenant-chaos", seed=111)
+        kw = dict(n_tenants=6, residency_capacity=4, zipf_s=1.1,
+                  width=8, n_estimators=2, seed=111,
+                  min_bucket_rows=8, bucket_max_rows=32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            report = R.replay_median(wl, repeats=2, tenants=True,
+                                     chaos=chaos, retries=2, **kw)
+            control = R.replay_tenants(wl, **kw)
+
+        from spark_bagging_tpu.telemetry import slo
+
+        # the fleet-total compile pin is disabled exactly as the
+        # committed scenario does it: the targeted tenant is allowed
+        # its recovery recompile; _tenants_checks pins the bystanders
+        result = R.check_report(report, spec=slo.SLOSpec(
+            max_overloads=0, max_post_warmup_compiles=None))
+        assert result.ok, result.render()
+        t, c = report["tenants"], report["chaos"]
+        assert c["plan"] == "tenant-chaos"
+        assert c["sites"]["fired_total"] >= 4
+        assert c["shed"]["quarantine"] >= 1
+        assert t["quarantine"]["trips"] == {"t1": 1}
+        assert t["quarantine"]["recoveries"] == {"t1": 1}
+        assert report["errors"] == 0  # contained, not crashed
+
+        # zero ADDED recompiles: only the faulted tenant re-lowers its
+        # one corrupt-entry bucket; bystanders pay nothing
+        by = t["post_warmup_compiles_by_tenant"]
+        assert by["t1"] == 1
+        assert all(v == 0 for n, v in by.items() if n != "t1")
+        assert control["post_warmup_compiles"] == 0
+
+        # bitwise-unchanged bystander outputs vs the no-chaos control
+        dig = t["output_digest_by_tenant"]
+        dig0 = control["tenants"]["output_digest_by_tenant"]
+        for name in dig0:
+            if name == "t1":
+                assert dig[name] != dig0[name]  # t1 DID lose requests
+            else:
+                assert dig[name] == dig0[name], name
+
+    def test_cli_rejects_tenancy_sites_without_tenants(self):
+        from benchmarks import replay as R
+
+        with pytest.raises(SystemExit):
+            R.main(["--chaos", "tenant-chaos"])
