@@ -15,11 +15,36 @@ unit tests and only surface under production load:
 - :mod:`~spark_bagging_tpu.analysis.locks`: instrumented locks that
   record the acquisition graph and flag order cycles and
   held-across-device-sync hazards (``SBT_LOCK_DEBUG=1``).
+- :mod:`~spark_bagging_tpu.analysis.determinism`: AST dataflow pass
+  tracking nondeterminism sources (wall-clock, unseeded RNG, object
+  identity, unordered iteration) into determinism sinks (digests,
+  event logs, snapshots, sort keys).
+- :mod:`~spark_bagging_tpu.analysis.contracts`: whole-repo
+  cross-artifact checks — SERIES_HELP completeness, faults.fire ↔
+  SITES, recorder kinds, alert-rule series, HTTP routes ↔ docs,
+  scenario ↔ baseline pairing.
+- :mod:`~spark_bagging_tpu.analysis.locks_static`: static extraction
+  of the make_lock acquisition graph with inversion and
+  check-then-act findings, cross-validated against the dynamic
+  detector.
 
 This module imports no jax at top level: linting runs anywhere, fast.
 """
 
 from spark_bagging_tpu.analysis import locks
+from spark_bagging_tpu.analysis.contracts import CONTRACT_CHECKS, check_repo
+from spark_bagging_tpu.analysis.determinism import DET_RULES
+from spark_bagging_tpu.analysis.determinism import (
+    analyze_paths as determinism_paths,
+)
+from spark_bagging_tpu.analysis.determinism import (
+    analyze_source as determinism_source,
+)
+from spark_bagging_tpu.analysis.locks_static import (
+    LOCK_RULES,
+    edge_sites,
+    static_edges,
+)
 from spark_bagging_tpu.analysis.jaxpr_audit import (
     AuditError,
     AuditReport,
@@ -44,8 +69,15 @@ __all__ = [
     "audit_estimator",
     "audit_executor",
     "audit_fn",
+    "CONTRACT_CHECKS",
+    "DET_RULES",
     "Finding",
+    "LOCK_RULES",
     "RULES",
+    "check_repo",
+    "determinism_paths",
+    "determinism_source",
+    "edge_sites",
     "lint_file",
     "lint_paths",
     "lint_source",
@@ -53,4 +85,5 @@ __all__ = [
     "locks",
     "render_json",
     "render_text",
+    "static_edges",
 ]

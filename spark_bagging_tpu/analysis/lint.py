@@ -387,6 +387,8 @@ DEFAULT_CONFIG = {
     "paths": ["spark_bagging_tpu", "benchmarks", "examples"],
     "exclude": [],
     "disable": [],
+    # Engine selection for the unified CLI; empty means "all engines".
+    "engines": [],
 }
 
 

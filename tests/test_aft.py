@@ -225,6 +225,7 @@ def test_streamed_aux_col_rejected_for_non_aux_learner():
         ).fit_stream((Xs, y), chunk_rows=128, aux_col=-1)
 
 
+@pytest.mark.slow  # [PR 19 budget offset] ~2.0s aux-col warning-path soak; the stream-fit seam stays tier-1 via test_streamed_aft_scores_its_own_training_source
 def test_streamed_aft_without_aux_col_warns():
     """Streaming a uses_aux learner with no aux_col is legal (genuinely
     fully-observed data) but easy to do by accident — it must warn."""

@@ -1,0 +1,241 @@
+"""Cross-artifact contract engine tests [ISSUE 19]: a miniature repo
+skeleton that satisfies every contract, plus one BAD mutation per
+check — the BAD/GOOD fixture convention of test_analysis.py applied to
+whole-repo artifacts instead of single sources. The real-tree gate
+lives in test_analysis.py (test_repo_tree_is_contract_clean); here we
+prove each check actually fires on the drift it claims to catch.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from spark_bagging_tpu.analysis.contracts import (
+    CONTRACT_CHECKS,
+    check_repo,
+)
+
+# -- miniature repo skeleton -------------------------------------------
+
+_SKELETON = {
+    "spark_bagging_tpu/telemetry/registry.py": '''\
+SERIES_HELP = {
+    "sbt_requests_total": "requests (unlabeled total + label tenant)",
+    "sbt_queue_depth": "queue depth",
+}
+''',
+    "spark_bagging_tpu/faults.py": '''\
+SITES = {
+    "serving.submit": "the submit path",
+}
+''',
+    "spark_bagging_tpu/telemetry/recorder.py": '''\
+TRIGGER_KINDS = ("drift_alert",)
+TIMELINE_KINDS = TRIGGER_KINDS + ("model_swapped",)
+''',
+    "spark_bagging_tpu/telemetry/alerts.py": '''\
+def default_drift_rules():
+    return [AlertRule("queue-deep", "sbt_queue_depth", 10.0)]
+''',
+    "spark_bagging_tpu/telemetry/server.py": '''\
+def do_GET(self, url):
+    if url.path == "/metrics":
+        return self._metrics()
+    return {"endpoints": ["/metrics"]}
+''',
+    "spark_bagging_tpu/app.py": '''\
+def work(telemetry, faults):
+    telemetry.inc("sbt_requests_total")
+    telemetry.inc("sbt_requests_total", labels={"tenant": "a"})
+    telemetry.set_gauge("sbt_queue_depth", 1)
+    faults.fire("serving.submit")
+    return [{"kind": "drift_alert"}, {"kind": "model_swapped"}]
+''',
+    "benchmarks/scenarios/__init__.py": '''\
+def _register_all(register, Scenario):
+    register(Scenario(name="smoke"))
+''',
+    "benchmarks/baselines/scenarios/smoke.json": "{}\n",
+    "ARCHITECTURE.md": """\
+# mini
+
+| route | serves | semantics |
+|---|---|---|
+| `/metrics` | text | the scrape endpoint |
+""",
+}
+
+
+def build_repo(root, overrides=None):
+    files = dict(_SKELETON)
+    files.update(overrides or {})
+    for rel, content in files.items():
+        path = os.path.join(str(root), rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(content)
+    return str(root)
+
+
+def findings_of(root, check):
+    return check_repo(root, checks=[check])
+
+
+# -- GOOD: the skeleton satisfies every contract -----------------------
+
+
+def test_skeleton_is_clean_under_every_check(tmp_path):
+    root = build_repo(tmp_path)
+    findings = check_repo(root)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# -- BAD: one mutation per check ---------------------------------------
+
+# check name -> (overrides, expected message fragment)
+BAD_CASES = {
+    "contract-series-help": [
+        # an emitted series with no help entry
+        ({"spark_bagging_tpu/app.py":
+          _SKELETON["spark_bagging_tpu/app.py"]
+          + '\n\ndef more(telemetry):\n'
+            '    telemetry.inc("sbt_ghost_total")\n'},
+         "no SERIES_HELP entry"),
+        # a help entry nothing emits — dead documentation
+        ({"spark_bagging_tpu/telemetry/registry.py": '''\
+SERIES_HELP = {
+    "sbt_requests_total": "requests (unlabeled total + label tenant)",
+    "sbt_queue_depth": "queue depth",
+    "sbt_dead_series": "documented, never emitted",
+}
+'''},
+         "no emit site"),
+    ],
+    "contract-series-twins": [
+        # labeled emit gone: the per-key breakdown the help promises
+        ({"spark_bagging_tpu/app.py": '''\
+def work(telemetry, faults):
+    telemetry.inc("sbt_requests_total")
+    telemetry.set_gauge("sbt_queue_depth", 1)
+    faults.fire("serving.submit")
+    return [{"kind": "drift_alert"}, {"kind": "model_swapped"}]
+'''},
+         "no LABELED emit site"),
+        # unlabeled emit gone: the fleet-merge total reads 0
+        ({"spark_bagging_tpu/app.py": '''\
+def work(telemetry, faults):
+    telemetry.inc("sbt_requests_total", labels={"tenant": "a"})
+    telemetry.set_gauge("sbt_queue_depth", 1)
+    faults.fire("serving.submit")
+    return [{"kind": "drift_alert"}, {"kind": "model_swapped"}]
+'''},
+         "no UNLABELED emit site"),
+    ],
+    "contract-fault-sites": [
+        # fire() of an unregistered site — a silent no-op plan key
+        ({"spark_bagging_tpu/app.py":
+          _SKELETON["spark_bagging_tpu/app.py"]
+          + '\n\ndef more(faults):\n'
+            '    faults.fire("serving.ghost")\n'},
+         "no faults.SITES entry"),
+        # a SITES entry nobody fires — dead fault surface
+        ({"spark_bagging_tpu/faults.py": '''\
+SITES = {
+    "serving.submit": "the submit path",
+    "serving.dead": "registered, never fired",
+}
+'''},
+         "no live fire() call"),
+    ],
+    "contract-recorder-kinds": [
+        ({"spark_bagging_tpu/telemetry/recorder.py": '''\
+TRIGGER_KINDS = ("drift_alert", "ghost_kind")
+TIMELINE_KINDS = TRIGGER_KINDS + ("model_swapped",)
+'''},
+         "never emitted"),
+    ],
+    "contract-alert-rules": [
+        ({"spark_bagging_tpu/telemetry/alerts.py": '''\
+def default_drift_rules():
+    return [AlertRule("ghost", "sbt_missing_series", 1.0)]
+'''},
+         "does not exist"),
+    ],
+    "contract-http-routes": [
+        # served but neither documented nor index-advertised
+        ({"spark_bagging_tpu/telemetry/server.py": '''\
+def do_GET(self, url):
+    if url.path == "/metrics":
+        return self._metrics()
+    if url.path == "/hidden":
+        return self._hidden()
+    return {"endpoints": ["/metrics"]}
+'''},
+         "missing from the ARCHITECTURE.md route table"),
+        # documented but 404s
+        ({"ARCHITECTURE.md": _SKELETON["ARCHITECTURE.md"]
+          + "| `/ghost` | json | promised, never dispatched |\n"},
+         "not dispatched"),
+        # advertised on / but 404s
+        ({"spark_bagging_tpu/telemetry/server.py": '''\
+def do_GET(self, url):
+    if url.path == "/metrics":
+        return self._metrics()
+    return {"endpoints": ["/metrics", "/phantom"]}
+'''},
+         "advertises an endpoint"),
+    ],
+    "contract-scenario-baselines": [
+        # registered with no committed baseline
+        ({"benchmarks/scenarios/__init__.py": '''\
+def _register_all(register, Scenario):
+    register(Scenario(name="smoke"))
+    register(Scenario(name="orphan"))
+'''},
+         "no committed baseline"),
+        # a baseline matching no scenario — stale artifact
+        ({"benchmarks/baselines/scenarios/stale.json": "{}\n"},
+         "matches no registered scenario"),
+    ],
+}
+
+_CASES = [(check, i) for check in sorted(BAD_CASES)
+          for i in range(len(BAD_CASES[check]))]
+
+
+@pytest.mark.parametrize(
+    "check,i", _CASES, ids=[f"{c}-{i}" for c, i in _CASES]
+)
+def test_bad_mutation_is_flagged(tmp_path, check, i):
+    overrides, fragment = BAD_CASES[check][i]
+    root = build_repo(tmp_path, overrides)
+    found = findings_of(root, check)
+    assert found, f"{check} missed its BAD mutation #{i}"
+    assert any(fragment in f.message for f in found), (
+        f"{check} fired, but not for the expected reason:\n"
+        + "\n".join(f.render() for f in found)
+    )
+
+
+def test_every_registered_check_has_bad_fixture():
+    """Registry-completeness guard: a contract check that never proved
+    it fires is not trusted."""
+    assert set(CONTRACT_CHECKS) == set(BAD_CASES), (
+        "update BAD_CASES in test_analysis_contracts.py when adding "
+        "contract checks"
+    )
+
+
+def test_unknown_check_name_raises(tmp_path):
+    build_repo(tmp_path)
+    with pytest.raises(KeyError):
+        check_repo(str(tmp_path), checks=["no-such-check"])
+
+
+def test_disabled_check_is_skipped(tmp_path):
+    overrides, _ = BAD_CASES["contract-fault-sites"][0]
+    root = build_repo(tmp_path, overrides)
+    assert findings_of(root, "contract-fault-sites")
+    assert not check_repo(root, disabled=set(CONTRACT_CHECKS))

@@ -1,0 +1,258 @@
+"""Determinism dataflow engine tests [ISSUE 19]: per-rule BAD/GOOD
+fixture pairs, the clock-seam marker, the timestamp-key sanction, the
+sorted() laundering rule, and the suppression grammar — the same
+fixture convention as test_analysis.py (a rule without a known-BAD it
+flags and a known-GOOD twin it stays silent on is not trusted).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from spark_bagging_tpu.analysis.determinism import (
+    DET_RULES,
+    analyze_source,
+)
+
+
+def hits(src: str, rule: str) -> list:
+    return [f for f in analyze_source(src) if f.rule == rule]
+
+
+# -- fixture pairs -----------------------------------------------------
+
+BAD_GOOD = {
+    "det-wallclock-sink": (
+        # BAD: wall clock hashed into a digest — same inputs, different
+        # bytes every run
+        """
+import hashlib
+import time
+
+
+def transcript_digest(events):
+    h = hashlib.sha256()
+    h.update(str(time.time()).encode())
+    for e in events:
+        h.update(repr(e).encode())
+    return h.hexdigest()
+""",
+        # GOOD: the clock is an injectable parameter; the digest hashes
+        # only what the caller chose to pass
+        """
+import hashlib
+
+
+def transcript_digest(events, now):
+    h = hashlib.sha256()
+    h.update(str(now).encode())
+    for e in events:
+        h.update(repr(e).encode())
+    return h.hexdigest()
+""",
+    ),
+    "det-unseeded-rng-sink": (
+        # BAD: module-level RNG (process-seeded) feeds a digest
+        """
+import hashlib
+import random
+
+
+def sample_digest():
+    h = hashlib.sha256()
+    h.update(str(random.random()).encode())
+    return h.hexdigest()
+""",
+        # GOOD: an explicitly seeded Random is reproducible by
+        # construction
+        """
+import hashlib
+import random
+
+
+def sample_digest(seed):
+    rng = random.Random(seed)
+    h = hashlib.sha256()
+    h.update(str(rng.random()).encode())
+    return h.hexdigest()
+""",
+    ),
+    "det-identity-sink": (
+        # BAD: id() as a sort key — memory layout decides the order
+        """
+def stable_order(objs):
+    return sorted(objs, key=id)
+""",
+        # GOOD: sort by a value the objects carry
+        """
+def stable_order(objs):
+    return sorted(objs, key=lambda o: o.name)
+""",
+    ),
+    "det-unordered-sink": (
+        # BAD: set iteration order feeds a digest
+        """
+import hashlib
+
+
+def digest(names):
+    h = hashlib.sha256()
+    for n in set(names):
+        h.update(n.encode())
+    return h.hexdigest()
+""",
+        # GOOD: sorted() pins the order — the canonical fix
+        """
+import hashlib
+
+
+def digest(names):
+    h = hashlib.sha256()
+    for n in sorted(set(names)):
+        h.update(n.encode())
+    return h.hexdigest()
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_GOOD))
+def test_bad_fixture_is_flagged(rule):
+    bad, _ = BAD_GOOD[rule]
+    found = hits(bad, rule)
+    assert found, f"{rule} did not flag its BAD fixture"
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_GOOD))
+def test_good_fixture_is_clean(rule):
+    _, good = BAD_GOOD[rule]
+    assert not hits(good, rule), (
+        f"{rule} flagged its GOOD fixture:\n"
+        + "\n".join(f.render() for f in hits(good, rule))
+    )
+
+
+def test_every_registered_rule_has_fixtures():
+    """Registry-completeness guard: a determinism rule without its
+    BAD/GOOD pair is not trusted."""
+    assert set(DET_RULES) == set(BAD_GOOD), (
+        "update BAD_GOOD in test_analysis_determinism.py when adding "
+        "determinism rules"
+    )
+
+
+# -- sanctions: the legitimate patterns must stay silent ---------------
+
+
+def test_timestamp_key_in_event_payload_is_sanctioned():
+    """Events legitimately carry wall-clock under timestamp-named keys
+    (digests hash deterministic projections that strip them) — the
+    engine must not cry wolf on the repo's own idiom."""
+    src = """
+import time
+
+
+def note(emit_event):
+    emit_event({"kind": "drift_alert", "ts": time.time()})
+"""
+    assert not analyze_source(src)
+
+
+def test_wallclock_under_value_key_in_snapshot_is_flagged():
+    """The sanction is keyed on the NAME: wall clock under a
+    non-timestamp key in a snapshot export is a real leak."""
+    src = """
+import time
+
+
+def snapshot():
+    return {"value": time.time()}
+"""
+    assert hits(src, "det-wallclock-sink")
+
+
+def test_clock_seam_marker_sanctions_the_function():
+    """`# sbt-lint: clock-seam` marks the injectable-clock pattern used
+    by admission/quarantine/alerts: inside it, wall-clock reads are the
+    function's PURPOSE."""
+    src = """
+import time
+
+
+# sbt-lint: clock-seam
+def snapshot():
+    return {"value": time.time()}
+"""
+    assert not analyze_source(src)
+
+
+def test_now_parameter_default_fill_is_sanctioned():
+    """`now = time.time() if now is None else now` is the repo's
+    clock-injection idiom — the fill itself must not be flagged."""
+    src = """
+import time
+
+
+def snapshot(now=None):
+    if now is None:
+        now = time.time()
+    return {"value": now}
+"""
+    assert not analyze_source(src)
+
+
+def test_sorted_launders_unordered_taint():
+    src = """
+import hashlib
+
+
+def digest(names):
+    h = hashlib.sha256()
+    canon = sorted(set(names))
+    for n in canon:
+        h.update(n.encode())
+    return h.hexdigest()
+"""
+    assert not analyze_source(src)
+
+
+# -- suppression grammar -----------------------------------------------
+
+_BAD_WALLCLOCK = BAD_GOOD["det-wallclock-sink"][0]
+
+
+def test_same_line_suppression():
+    src = _BAD_WALLCLOCK.replace(
+        "h.update(str(time.time()).encode())",
+        "h.update(str(time.time()).encode())"
+        "  # sbt-lint: disable=det-wallclock-sink",
+    )
+    assert not analyze_source(src)
+
+
+def test_comment_above_suppression():
+    src = _BAD_WALLCLOCK.replace(
+        "    h.update(str(time.time()).encode())",
+        "    # sbt-lint: disable=det-wallclock-sink\n"
+        "    h.update(str(time.time()).encode())",
+    )
+    assert not analyze_source(src)
+
+
+def test_disable_all_wildcard():
+    src = _BAD_WALLCLOCK.replace(
+        "h.update(str(time.time()).encode())",
+        "h.update(str(time.time()).encode())  # sbt-lint: disable=all",
+    )
+    assert not analyze_source(src)
+
+
+def test_disabled_kwarg_filters_rule():
+    assert not analyze_source(
+        _BAD_WALLCLOCK, disabled=("det-wallclock-sink",)
+    )
+
+
+def test_unknown_enabled_rule_raises():
+    with pytest.raises(KeyError):
+        analyze_source("x = 1\n", enabled=("no-such-rule",))

@@ -620,6 +620,7 @@ def test_warm_start_rejects_mutated_base_learner(breast_cancer):
         clf.fit(X, y)
 
 
+@pytest.mark.slow  # [PR 19 budget offset] ~3.1s warm-start rejection soak; the warm-start fingerprint guard stays tier-1 via TestLibraryAuditFixes::test_warm_start_rejects_mesh_layout_change
 def test_warm_start_rejects_changed_sample_weight(breast_cancer):
     """A warm fit must use the same per-row weights as the original —
     splicing replicas trained on a different weighted objective would

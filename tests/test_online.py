@@ -146,6 +146,7 @@ class TestOnlineUpdater:
         with pytest.raises(ValueError, match="must be"):
             upd.partial_fit(X[:4, :5], y[:4])
 
+    @pytest.mark.slow  # [PR 19 budget offset] ~2.3s accuracy-band soak; stream-fit correctness stays tier-1 via test_partial_fit_matches_batch_fit_bitwise
     def test_regressor_stream_r2(self):
         """The regression half of the streaming OOB estimate: R² over
         OOB-voted rows on a stationary stream lands near the batch
@@ -255,6 +256,7 @@ def _serving_stack(X, y, **est_kw):
 
 
 class TestOnlineTrainer:
+    @pytest.mark.slow  # [PR 19 budget offset] ~3.4s trigger->publish soak; the path stays tier-1 via the online-refit scenario in the conformance smoke (test_scenarios), plus the validation and min-rows tests here
     def test_publishes_on_trigger(self, tmp_path):
         X, y, _ = _problem()
         est, reg = _serving_stack(X, y)

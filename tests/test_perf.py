@@ -594,6 +594,7 @@ class TestProfileRouteAndCLI:
         assert rc == 0
         assert json.loads(capsys.readouterr().out)["stopped"] is True
 
+    @pytest.mark.slow  # [PR 19 budget offset] ~5.3s end-to-end profiler capture/render soak; the profiler control plane stays tier-1 via the route-contract, single-flight, and CLI-remote tests in this class
     def test_real_capture_produces_viewable_artifact(
         self, server_port, tmp_path, monkeypatch
     ):

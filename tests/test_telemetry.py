@@ -282,37 +282,18 @@ class TestSeriesHelpCompleteness:
         package, benchmarks, or bench.py registers must carry a
         ``SERIES_HELP`` entry (or ride the ``sbt_fit_*`` dynamic
         prefix) — a scraper's UI shows these next to the graph, and a
-        help-less series is an undocumented instrument. Walks string
-        literals, so a new `telemetry.inc("sbt_new_total")` anywhere
-        fails here until its entry lands."""
+        help-less series is an undocumented instrument. Since ISSUE 19
+        this is a thin wrapper over the contracts engine's
+        ``contract-series-help`` check, which walks the same literal
+        scope AND adds the reverse direction (no dead SERIES_HELP
+        entries) — strictly stronger than the original grep."""
         import os
-        import re
 
-        from spark_bagging_tpu.telemetry.registry import SERIES_HELP
+        from spark_bagging_tpu.analysis.contracts import check_repo
 
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        sources = []
-        for root in ("spark_bagging_tpu", "benchmarks"):
-            for dirpath, _, files in os.walk(os.path.join(repo, root)):
-                if "__pycache__" in dirpath:
-                    continue
-                sources += [os.path.join(dirpath, f) for f in files
-                            if f.endswith(".py")]
-        sources.append(os.path.join(repo, "bench.py"))
-        pat = re.compile(r'["\'](sbt_[a-z0-9_]+)["\']')
-        missing: dict[str, str] = {}
-        for path in sources:
-            with open(path) as f:
-                src = f.read()
-            for name in pat.findall(src):
-                if name.endswith("_"):
-                    continue  # a prefix fragment, not a series name
-                if name not in SERIES_HELP \
-                        and not name.startswith("sbt_fit_"):
-                    missing[name] = os.path.relpath(path, repo)
-        assert not missing, (
-            f"sbt_* series without a SERIES_HELP entry: {missing}"
-        )
+        findings = check_repo(repo, checks=["contract-series-help"])
+        assert not findings, "\n".join(f.render() for f in findings)
 
 
 class TestHelpAndEscaping:

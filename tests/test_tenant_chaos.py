@@ -13,7 +13,6 @@ recovers.
 
 import json
 import os
-import re
 import sys
 import warnings
 
@@ -34,7 +33,6 @@ from spark_bagging_tpu.tenancy import (
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "spark_bagging_tpu")
 
 
 @pytest.fixture(autouse=True)
@@ -71,25 +69,14 @@ def _fit(seed=0, n_estimators=2):
 
 # -- the site table is an invariant, not documentation ------------------
 
-_FIRE_RE = re.compile(r"faults(?:_mod)?\.fire\(\s*[\"']([\w.]+)[\"']")
+def _site_findings():
+    """Thin wrapper [ISSUE 19] over the contracts engine's two-way
+    ``contract-fault-sites`` check — the AST walk subsumes the old
+    ``faults.fire(`` regex (it also catches aliased ``*.fire("x")``
+    forms the regex missed), faults.py itself still excluded."""
+    from spark_bagging_tpu.analysis.contracts import check_repo
 
-
-def _fired_sites():
-    """Every site name passed to ``faults.fire`` anywhere in the
-    package (faults.py itself excluded: it defines the probe)."""
-    sites = {}
-    for dirpath, _dirnames, filenames in os.walk(PKG):
-        for fname in filenames:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            if os.path.basename(path) == "faults.py":
-                continue
-            with open(path) as f:
-                for m in _FIRE_RE.finditer(f.read()):
-                    sites.setdefault(m.group(1), []).append(
-                        os.path.relpath(path, REPO))
-    return sites
+    return check_repo(REPO, checks=["contract-fault-sites"])
 
 
 class TestSiteTable:
@@ -97,21 +84,21 @@ class TestSiteTable:
         """Satellite [ISSUE 18]: a ``faults.fire("x")`` call with no
         SITES entry is a silent no-op plan key — static analysis, so
         the drift is caught at test time, not mid-incident."""
-        fired = _fired_sites()
-        unknown = set(fired) - set(faults.SITES)
+        unknown = [f for f in _site_findings()
+                   if "no faults.SITES entry" in f.message]
         assert not unknown, (
-            f"fire() call sites not registered in faults.SITES: "
-            f"{ {s: fired[s] for s in sorted(unknown)} }"
+            "fire() call sites not registered in faults.SITES:\n"
+            + "\n".join(f.render() for f in unknown)
         )
 
     def test_every_registered_site_has_a_live_call_site(self):
         """The other direction: a SITES key nobody fires is a dead
         entry in the documented fault surface."""
-        fired = _fired_sites()
-        dead = set(faults.SITES) - set(fired)
+        dead = [f for f in _site_findings()
+                if "no live fire() call" in f.message]
         assert not dead, (
-            f"faults.SITES entries with no live fire() call: "
-            f"{sorted(dead)}"
+            "faults.SITES entries with no live fire() call:\n"
+            + "\n".join(f.render() for f in dead)
         )
 
 
