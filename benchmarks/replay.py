@@ -410,6 +410,79 @@ def _attribution_section(
     }
 
 
+def _journey_section(records: list[dict], *, max_delay_ms: float,
+                     tail_k: int = 8) -> dict:
+    """Build the tenancy replay's ``journey`` section: virtual-clock
+    stage attribution + tail verdicts for every request's trip through
+    admission → WFQ → residency → batcher [ISSUE 20].
+
+    Stage timings are a pure function of the schedule: a request's WFQ
+    wait is its cost-weighted position in its window's drain order
+    (``served-rows-ahead / window-rows × max_delay_ms`` — drained
+    behind more than half the window's service it verdicts
+    ``wfq-starved`` under the coalescing-window-half threshold), and a
+    residency restore charges each of the restored tenant's served
+    requests one full coalescing delay (the virtual stand-in for the
+    AOT adopt cost the live path measures into ``restore_ms``). Sheds
+    keep their admission reason; only served and quarantine-shed
+    records are verdicted — quota/priority sheds are admission policy,
+    not tail weather. The ``digest`` covers the whole section, so
+    ``replay_median`` pins stage sums, verdict counts, and the tail
+    set byte-identically across repeats.
+    """
+    from spark_bagging_tpu.telemetry import perf as perf_mod
+
+    stage_by_tenant: dict[str, dict] = {}
+    for r in records:
+        acc = stage_by_tenant.setdefault(
+            r["tenant"],
+            {"requests": 0, "sheds": 0, "wfq_ms": 0.0,
+             "restore_ms": 0.0},
+        )
+        acc["requests"] += 1
+        if r.get("shed") is not None:
+            acc["sheds"] += 1
+        acc["wfq_ms"] += r.get("wfq_ms") or 0.0
+        acc["restore_ms"] += r.get("restore_ms") or 0.0
+    for acc in stage_by_tenant.values():
+        acc["wfq_ms"] = round(acc["wfq_ms"], 6)
+        acc["restore_ms"] = round(acc["restore_ms"], 6)
+    verdictable = [r for r in records
+                   if r.get("shed") in (None, "quarantine")]
+    # window_s=0 + clock_key="t": same convention as the attribution
+    # section — record-level evidence only, on the virtual clock
+    tail_all = perf_mod.correlate_tail(
+        verdictable, [], window_s=0.0,
+        queue_threshold_ms=max_delay_ms * 0.5, clock_key="t",
+    )
+    verdict_counts: dict[str, int] = {}
+    for t in tail_all:
+        verdict_counts[t["verdict"]] = (
+            verdict_counts.get(t["verdict"], 0) + 1)
+    tail = sorted(
+        tail_all,
+        key=lambda t: (-((t.get("wfq_ms") or 0.0)
+                         + (t.get("restore_ms") or 0.0)),
+                       t.get("idx", 0)),
+    )[:tail_k]
+    section = {
+        "requests": len(records),
+        "stage_ms_by_tenant": {
+            t: stage_by_tenant[t] for t in sorted(stage_by_tenant)},
+        "verdicts": verdict_counts,
+        "tail": [
+            {k: e[k] for k in ("idx", "tenant", "verdict", "factors",
+                               "wfq_ms", "restore_ms", "shed")
+             if k in e}
+            for e in tail
+        ],
+    }
+    section["digest"] = hashlib.sha256(
+        json.dumps(section, sort_keys=True).encode()
+    ).hexdigest()
+    return section
+
+
 class ThrottledExecutor:
     """Executor wrapper adding a fixed host-side delay per forward —
     the scripted 'someone slowed the hot path' regression the SLO gate
@@ -2130,6 +2203,12 @@ def replay_tenants(
     #: per-tenant FIFO of submitted request indices — WFQ is FIFO
     #: WITHIN a tenant, so dispatch order maps back to request ids
     pending: dict[str, deque] = {n: deque() for n in names}
+    #: virtual-clock journey records — admission sheds at submit, WFQ
+    #: wait + restore charge at drain — fed to _journey_section
+    journey_records: list[dict] = []
+    #: request idx → the fleet-minted trace id, so wall latencies can
+    #: carry their exemplar into the tenancy histogram [ISSUE 20]
+    trace_of: dict[int, str | None] = {}
 
     def snap(window_i: int, vt: float) -> None:
         plane.classify(now=vt)
@@ -2206,13 +2285,38 @@ def replay_tenants(
                         name, payload(idx, requests[idx].rows), now=vt,
                     )
                     pending[name].append(idx)
-                except AdmissionShed:
-                    pass  # counted per (tenant, reason) by admission
+                except AdmissionShed as exc:
+                    # counted per (tenant, reason) by admission; the
+                    # journey record keeps the reason so quarantine
+                    # sheds verdict ``quarantine-shed`` [ISSUE 20]
+                    journey_records.append({
+                        "idx": idx, "t": vt, "tenant": name,
+                        "shed": exc.reason,
+                    })
             drained = fleet.dispatch(now=vt)
+            window_rows = float(sum(
+                r["rows"] for r in drained if r["future"] is not None
+            )) or 1.0
+            rows_ahead = 0.0
             for rec in drained:
                 r_idx = pending[rec["tenant"]].popleft()
+                jr = {
+                    "idx": r_idx, "t": vt, "tenant": rec["tenant"],
+                    # cost-weighted drain position: the virtual WFQ
+                    # wait, a pure function of the schedule
+                    "wfq_ms": round(
+                        rows_ahead / window_rows * max_delay_ms, 9),
+                    "restore_ms": (
+                        float(max_delay_ms) if rec.get("restored")
+                        else 0.0),
+                }
+                if rec["shed"] is not None:
+                    jr["shed"] = rec["shed"]
+                journey_records.append(jr)
+                trace_of[r_idx] = rec.get("trace_id")
                 if rec["future"] is not None:
                     futs[r_idx] = rec["future"]
+                    rows_ahead += rec["rows"]
                 elif rec["shed"] == "overload":
                     overloads += 1
             # pop order IS downstream batch composition: record it so
@@ -2260,7 +2364,8 @@ def replay_tenants(
     for rec in collected["records"]:
         if rec.get("total_ms") is not None:
             fleet.note_latency(
-                names[int(owner_of[rec["idx"]])], rec["total_ms"])
+                names[int(owner_of[rec["idx"]])], rec["total_ms"],
+                trace_id=trace_of.get(rec["idx"]))
     latency_by_tenant = fleet.latency_p99_ms()
     tail_p99 = fleet.tail_p99_ms()
     fleet.export_gauges()
@@ -2291,9 +2396,13 @@ def replay_tenants(
         "budget_counts": budget_counts,
         # the blast-radius transcript: every trip/probe/recover event
         # (seq-ordered, seeded-jitter deadlines rounded) is digested,
-        # so quarantine behaviour is byte-identical across repeats
+        # so quarantine behaviour is byte-identical across repeats.
+        # Trace ids are scrubbed first: they join incidents across
+        # debug surfaces but carry a random process prefix, and the
+        # digest may only see deterministic projections [ISSUE 20]
         "quarantine": {
-            "events": quarantine_events,
+            "events": [{k: v for k, v in e.items() if k != "trace_id"}
+                       for e in quarantine_events],
             "counts": quarantine_counts,
         },
         "demand_final": demand_final,
@@ -2334,6 +2443,10 @@ def replay_tenants(
         "reconciled": bool(led["reconciled"]),
         "latency_p99_by_tenant": latency_by_tenant,
         "tail_p99_ms": tail_p99,
+        # the request-journey forensics: virtual stage attribution +
+        # tail verdicts, digest-pinned across repeats [ISSUE 20]
+        "journey": _journey_section(
+            journey_records, max_delay_ms=max_delay_ms),
         "transcript_digest": hashlib.sha256(
             json.dumps(transcript, sort_keys=True).encode()
         ).hexdigest(),
@@ -2582,7 +2695,7 @@ def replay_median(workload, *, repeats: int = 3, **kwargs) -> dict:
                             "demotions", "restores", "pin_violations",
                             "residents_final", "demand_final",
                             "evictions_by_owner", "budget",
-                            "quarantine",
+                            "quarantine", "journey",
                             "post_warmup_compiles_by_tenant",
                             "output_digest_by_tenant",
                             "reconciled"):

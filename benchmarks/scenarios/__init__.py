@@ -351,6 +351,25 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name="tenant-tail-attribution",
+    description="the request-journey forensics drill [ISSUE 20]: 8 "
+                "tenants under a steep Zipf skew share a residency "
+                "budget of 2, so tail tenants are perpetually demoted "
+                "and drain behind the head tenant's rows; the journey "
+                "section must attribute their slow requests to "
+                "wfq-starved / restore-absorbed on the virtual clock, "
+                "and its stage sums, verdict counts, and tail set are "
+                "digest-pinned byte-identical across repeats",
+    workload={"kind": "poisson", "rate_rps": 300.0, "duration_s": 0.3,
+              "seed": 112, "width": 8, "bucket_bounds": (8, 32)},
+    model={"n_estimators": 2, "seed": 0},
+    serving=dict(_SERVING),
+    tenants={"n_tenants": 8, "residency_capacity": 2, "zipf_s": 1.8},
+    slo={"max_overloads": 0, "max_post_warmup_compiles": 0},
+    tags=("tenancy", "observability", "serving"),
+))
+
+register(Scenario(
     name="sharded-parity",
     description="replica-sharded serving parity: steady-poisson's "
                 "exact (workload, seed, model) served through a "

@@ -246,6 +246,11 @@ def digests_of(report: dict[str, Any]) -> dict[str, str]:
     tenants = report.get("tenants")
     if tenants is not None:
         d["tenants_transcript"] = tenants["transcript_digest"]
+        journey = tenants.get("journey")
+        if journey is not None:
+            # the request-journey forensics digest [ISSUE 20]: stage
+            # sums, verdict counts, and the tail set, all virtual
+            d["tenants_journey"] = journey["digest"]
     return d
 
 

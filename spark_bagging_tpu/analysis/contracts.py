@@ -212,6 +212,7 @@ _RECORDER_PY = os.path.join("spark_bagging_tpu", "telemetry",
                             "recorder.py")
 _ALERTS_PY = os.path.join("spark_bagging_tpu", "telemetry", "alerts.py")
 _SERVER_PY = os.path.join("spark_bagging_tpu", "telemetry", "server.py")
+_PERF_PY = os.path.join("spark_bagging_tpu", "telemetry", "perf.py")
 _SCENARIOS_PY = os.path.join("benchmarks", "scenarios", "__init__.py")
 _BASELINES_DIR = os.path.join("benchmarks", "baselines", "scenarios")
 
@@ -537,6 +538,60 @@ def http_routes(ctx: RepoContext) -> Iterator[Finding]:
             f"index-advertised route {route!r} is not dispatched — "
             "the server advertises an endpoint that 404s",
         )
+
+
+def _documented_verdicts(ctx: RepoContext) -> dict[str, int]:
+    """First-cell backticked verdicts of the ARCHITECTURE.md table
+    whose header row is ``| verdict | evidence |``."""
+    lines = ctx.source("ARCHITECTURE.md").splitlines()
+    out: dict[str, int] = {}
+    in_table = False
+    for i, text in enumerate(lines, 1):
+        stripped = text.strip()
+        if re.match(r"^\|\s*verdict\s*\|", stripped):
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            m = re.match(r"^\|\s*`([a-z][a-z-]*)`", stripped)
+            if m:
+                out.setdefault(m.group(1), i)
+    return out
+
+
+@contract_check("contract-tail-verdicts")
+def tail_verdicts(ctx: RepoContext) -> Iterator[Finding]:
+    """telemetry/perf.py VERDICTS ↔ the ARCHITECTURE.md
+    `| verdict | evidence |` ladder table, two-way [ISSUE 20]"""
+    verdicts = ctx.tuple_strings(_PERF_PY, "VERDICTS")
+    value = ctx.assigned_literal(_PERF_PY, "VERDICTS")
+    documented = _documented_verdicts(ctx)
+    if not documented:
+        yield _finding(
+            "contract-tail-verdicts", "ARCHITECTURE.md", 1,
+            "could not locate the `| verdict | evidence |` table — "
+            "the tail-verdict contract check has nothing to verify",
+        )
+        return
+    for v in verdicts:
+        if v not in documented:
+            yield _finding(
+                "contract-tail-verdicts", _PERF_PY, value.lineno,
+                f"tail verdict {v!r} is missing from the "
+                "ARCHITECTURE.md verdict-ladder table — an operator "
+                "reading /debug/tail meets a verdict the docs never "
+                "explain",
+            )
+    for v, line in sorted(documented.items()):
+        if v not in verdicts:
+            yield _finding(
+                "contract-tail-verdicts", "ARCHITECTURE.md", line,
+                f"documented verdict {v!r} is not in "
+                "telemetry/perf.py VERDICTS — the docs promise an "
+                "explanation correlate_tail can never emit",
+            )
 
 
 @contract_check("contract-scenario-baselines")

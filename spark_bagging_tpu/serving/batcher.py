@@ -352,7 +352,8 @@ class MicroBatcher:
 
     # sbt-lint: hot-path
     def submit(self, X, *, mode: str = "aggregate",
-               deadline_ms: float | None = None) -> Future:
+               deadline_ms: float | None = None,
+               trace: "tracing.TraceContext | None" = None) -> Future:
         """Enqueue one request; returns a ``concurrent.futures.Future``.
 
         ``mode="aggregate"`` resolves to the executor's raw aggregated
@@ -363,7 +364,11 @@ class MicroBatcher:
         deadline, its future fails with :class:`DeadlineExceeded`
         instead of being served late. Raises :class:`Overloaded` when
         the queue is full, :class:`Degraded` in crash-loop reject
-        mode, and ``RuntimeError`` after :meth:`close`.
+        mode, and ``RuntimeError`` after :meth:`close`. ``trace``
+        threads an upstream-minted :class:`~..telemetry.tracing.
+        TraceContext` (the tenancy fleet's, carrying pre-batcher
+        journey timings) through instead of minting a fresh one here
+        — one request, one trace, across every pipeline stage.
 
         With direct dispatch enabled (the threaded-mode default), an
         idle batcher serves the request INLINE before returning — the
@@ -402,8 +407,9 @@ class MicroBatcher:
             raise ValueError(
                 f"deadline_ms must be > 0, got {deadline_ms}"
             )
-        trace = (tracing.request_context() if telemetry.enabled()
-                 else None)
+        if trace is None:
+            trace = (tracing.request_context() if telemetry.enabled()
+                     else None)
         deadline_t = (self._clock() + deadline_ms / 1e3
                       if deadline_ms is not None else None)
         req = _Request(X, mode, trace, deadline_t)
@@ -1278,6 +1284,29 @@ class MicroBatcher:
         }
         if error is not None:
             bd["error"] = error
+        j = r.trace.journey
+        if j is not None:
+            # tenancy journey: the fleet minted this trace before
+            # admission, so re-anchor the decomposition at the fleet
+            # boundary. An AOT restore the request absorbed is carved
+            # OUT of its host interval — queue wait for a stepped
+            # restore (touch runs between submit and run_pending),
+            # dispatch for a threaded one (touch runs before submit)
+            # — and surfaced as its own stage, keeping the tiling
+            # exact: admission + wfq + dispatch + restore + queue +
+            # batch == total (re-based to the fleet submit instant).
+            pre = float(j.get("restore_pre_ms", 0.0))
+            post = float(j.get("restore_post_ms", 0.0))
+            bd["queue_ms"] = bd["queue_ms"] - post
+            bd["tenant"] = j.get("tenant")
+            bd["admission_ms"] = j.get("admission_ms", 0.0)
+            bd["wfq_ms"] = j.get("wfq_ms", 0.0)
+            bd["restore_ms"] = pre + post
+            bd["dispatch_ms"] = (
+                (r.t_submit - j["t_pop"]) * 1e3 - pre
+                if "t_pop" in j else 0.0)
+            if "t0" in j:
+                bd["total_ms"] = (t_done - j["t0"]) * 1e3
         r.trace.breakdown.update(bd)
         # performance-attribution probe (telemetry/perf.py): rides the
         # breakdown that was just built — one module-attribute read

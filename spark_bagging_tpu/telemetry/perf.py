@@ -58,10 +58,21 @@ from spark_bagging_tpu.analysis.locks import make_lock
 #: the request wall-clock decomposition (exact: they sum to total_ms)
 STAGES = ("queue", "forward", "scatter")
 
+#: the tenancy journey's pre-batcher stages [ISSUE 20]: together with
+#: :data:`STAGES` they tile a fleet request's wall-clock exactly
+#: (admission + wfq + restore + dispatch + queue + forward + scatter
+#: == total, re-based to the fleet submit instant)
+JOURNEY_STAGES = ("admission", "wfq", "restore", "dispatch")
+
 #: the tail explainer's verdict grammar, in priority order — the first
-#: rule whose evidence is present wins
+#: rule whose evidence is present wins. The tenancy rungs
+#: (quarantine-shed / restore-absorbed / wfq-starved) sit above
+#: queue-dominated: a tail-tenant request that waited behind a
+#: heavier tenant or absorbed a cold restore must not be misfiled as
+#: generic queueing [ISSUE 20]
 VERDICTS = ("failed", "degraded-path", "retry-inflated",
-            "compile-absorbed", "queue-dominated",
+            "compile-absorbed", "quarantine-shed", "restore-absorbed",
+            "wfq-starved", "queue-dominated",
             "genuinely-slow-forward")
 
 # event kinds (and span names) each verdict's evidence join matches
@@ -77,6 +88,11 @@ _COMPILE_KINDS = frozenset(("serving_compile", "model_swapped",
 _COMPILE_SPAN_NAMES = frozenset(("serving_compile",
                                  "quality_replica_compile"))
 _OVERLOAD_KINDS = frozenset(("serving_overloaded",))
+# tenancy_shed events are reason-qualified at join time (kind:reason)
+# so an overload shed never counts as quarantine evidence
+_QUARANTINE_KINDS = frozenset(("tenant_quarantine_trip",
+                               "tenancy_shed:quarantine"))
+_RESTORE_KINDS = frozenset(("tenancy_restore",))
 
 
 # sbt-lint: shared-state
@@ -106,6 +122,10 @@ class PerfAttribution:
         # (path, model) -> {"requests", "queue_s", "forward_s",
         #                   "scatter_s", "total_s"}
         self._keys: dict[tuple, dict[str, float]] = {}
+        # tenant -> per-stage seconds over the FULL journey
+        # (admission/wfq/restore/dispatch + queue/forward/scatter),
+        # plus requests/sheds/total_s — same max_keys cap [ISSUE 20]
+        self._tenants: dict[str, dict[str, float]] = {}
         self._dropped = 0
         self._dropped_exported = 0
         # bucket -> {"forwards", "rows", "seconds", "flops", "bytes"}
@@ -131,9 +151,14 @@ class PerfAttribution:
         total_s = (bd.get("total_ms") or 0.0) / 1e3
         path = bd.get("path") or "coalesced"
         model = bd.get("model_name")
+        tenant = bd.get("tenant")
+        journey_s = {
+            s: (bd.get(f"{s}_ms") or 0.0) / 1e3 for s in JOURNEY_STAGES
+        } if tenant is not None else None
         key = (path, str(model) if model is not None else None)
         export = False
         accepted = True
+        tenant_accepted = False
         with self._lock:
             acc = self._keys.get(key)
             if acc is None:
@@ -152,6 +177,29 @@ class PerfAttribution:
                 acc["forward_s"] += forward_s
                 acc["scatter_s"] += scatter_s
                 acc["total_s"] += total_s
+            if tenant is not None:
+                tacc = self._tenants.get(tenant)
+                if tacc is None:
+                    if len(self._tenants) >= self.max_keys:
+                        self._dropped += 1
+                    else:
+                        tacc = self._tenants[tenant] = {
+                            "requests": 0.0, "sheds": 0.0,
+                            "total_s": 0.0,
+                            **{f"{s}_s": 0.0
+                               for s in JOURNEY_STAGES + STAGES},
+                        }
+                if tacc is not None:
+                    tenant_accepted = True
+                    tacc["requests"] += 1
+                    if bd.get("shed") is not None:
+                        tacc["sheds"] += 1
+                    tacc["total_s"] += total_s
+                    for s, v in journey_s.items():
+                        tacc[f"{s}_s"] += v
+                    tacc["queue_s"] += queue_s
+                    tacc["forward_s"] += forward_s
+                    tacc["scatter_s"] += scatter_s
             # deterministic top-K by duration: strictly-greater evicts
             # the current minimum, ties keep the incumbent
             record = {
@@ -167,6 +215,16 @@ class PerfAttribution:
                 "model_name": bd.get("model_name"),
                 "model_version": bd.get("model_version"),
             }
+            if tenant is not None:
+                # the journey fields ride into the reservoir so the
+                # tail explainer can verdict wfq-starved /
+                # restore-absorbed / quarantine-shed and /debug/tail
+                # can filter by tenant [ISSUE 20]
+                record["tenant"] = tenant
+                for s in JOURNEY_STAGES:
+                    record[f"{s}_ms"] = bd.get(f"{s}_ms")
+                if bd.get("shed") is not None:
+                    record["shed"] = bd["shed"]
             if bd.get("error") is not None:
                 record["error"] = bd["error"]
             slow = self._slow
@@ -200,6 +258,18 @@ class PerfAttribution:
                 telemetry.observe("sbt_perf_stage_seconds", v,
                                   labels={"stage": stage, **labels},
                                   exemplar=trace_id)
+        if tenant_accepted and telemetry.enabled():
+            # the tenant-labeled journey twins — same series, tenant
+            # dimension, full stage set (capped by the SAME max_keys
+            # gate as the accumulators) [ISSUE 20]
+            pairs = [(s, journey_s[s]) for s in JOURNEY_STAGES]
+            pairs += [("queue", queue_s), ("forward", forward_s),
+                      ("scatter", scatter_s)]
+            for stage, v in pairs:
+                telemetry.observe(
+                    "sbt_perf_stage_seconds", v,
+                    labels={"stage": stage, "tenant": tenant},
+                    exemplar=trace_id)
 
     def observe_forward(self, bucket: int, fill: int, seconds: float,
                         cost: dict | None = None) -> None:
@@ -276,6 +346,7 @@ class PerfAttribution:
         MFU, and the slow reservoir."""
         with self._lock:
             keys = {k: dict(v) for k, v in self._keys.items()}
+            tenants = {t: dict(v) for t, v in self._tenants.items()}
             n = self._n
             dropped = self._dropped
         stages_total = {s: 0.0 for s in STAGES}
@@ -316,6 +387,14 @@ class PerfAttribution:
                 for s in STAGES
             },
             "by_key": by_key,
+            "tenants": {
+                t: {
+                    "requests": int(acc["requests"]),
+                    "sheds": int(acc["sheds"]),
+                    "stages": _journey_shares(acc),
+                }
+                for t, acc in sorted(tenants.items())
+            },
             "cost_model": cost,
             "achieved_flops": overall,
             "peak_tflops_bf16": peak,
@@ -341,6 +420,7 @@ class PerfAttribution:
             return
         with self._lock:
             keys = {k: dict(v) for k, v in self._keys.items()}
+            tenants = {t: dict(v) for t, v in self._tenants.items()}
             dropped_delta = self._dropped - self._dropped_exported
             self._dropped_exported = self._dropped
         for (path, model), acc in keys.items():
@@ -352,6 +432,13 @@ class PerfAttribution:
                     telemetry.set_gauge(
                         "sbt_perf_stage_share", share["share"],
                         labels={"stage": stage, **labels},
+                    )
+        for tenant, acc in tenants.items():
+            for stage, share in _journey_shares(acc).items():
+                if share["share"] is not None:
+                    telemetry.set_gauge(
+                        "sbt_perf_stage_share", share["share"],
+                        labels={"stage": stage, "tenant": tenant},
                     )
         if dropped_delta > 0:
             telemetry.inc("sbt_perf_dropped_total", dropped_delta)
@@ -387,6 +474,20 @@ def _shares(acc: dict[str, float]) -> dict[str, dict]:
     }
 
 
+def _journey_shares(acc: dict[str, float]) -> dict[str, dict]:
+    """Per-stage seconds + shares over the FULL tenancy journey
+    (pre-batcher stages included) — the tenant twin of
+    :func:`_shares`."""
+    total = acc["total_s"]
+    return {
+        s: {
+            "seconds": round(acc[f"{s}_s"], 6),
+            "share": (acc[f"{s}_s"] / total if total > 0 else None),
+        }
+        for s in JOURNEY_STAGES + STAGES
+    }
+
+
 # -- the tail explainer ------------------------------------------------
 
 def correlate_tail(
@@ -418,11 +519,21 @@ def correlate_tail(
        window;
     4. ``compile-absorbed`` — a serving compile (or a swap, whose warm
        pre-compiles are the usual carrier) in the window;
-    5. ``queue-dominated`` — queue wait over ``queue_frac`` of the
+    5. ``quarantine-shed`` — the record IS a quarantine shed (its
+       ``shed`` field says so) or a quarantine trip / quarantine shed
+       event lands in the window [ISSUE 20];
+    6. ``restore-absorbed`` — the record carries ``restore_ms > 0``
+       (it paid a cold tenant's AOT restore) or a ``tenancy_restore``
+       event for its window [ISSUE 20];
+    7. ``wfq-starved`` — fair-queue wait over ``queue_frac`` of the
+       total (or over ``queue_threshold_ms`` when the total is
+       unknown): the request waited behind heavier tenants, not
+       behind its own batcher [ISSUE 20];
+    8. ``queue-dominated`` — queue wait over ``queue_frac`` of the
        total (or over ``queue_threshold_ms`` when the total is
        unknown — the replay harness passes the coalescing window's
        half, making the verdict a pure function of the schedule);
-    6. ``genuinely-slow-forward`` — none of the above: the device
+    9. ``genuinely-slow-forward`` — none of the above: the device
        forward itself was the time.
     """
     evs = []
@@ -437,6 +548,10 @@ def correlate_tail(
             if e.get("name") not in _COMPILE_SPAN_NAMES:
                 continue
             kind = "serving_compile"
+        elif kind == "tenancy_shed":
+            # reason-qualified so only quarantine sheds count as
+            # quarantine evidence (an overload shed is queue weather)
+            kind = f"tenancy_shed:{e.get('reason')}"
         evs.append((float(t), kind))
     evs.sort()
     out = []
@@ -460,14 +575,29 @@ def correlate_tail(
             factors.append("compiles")
         if kinds & _OVERLOAD_KINDS:
             factors.append("overload-burst")
+        if (r.get("shed") == "quarantine"
+                or kinds & _QUARANTINE_KINDS):
+            factors.append("quarantine")
+        if ((r.get("restore_ms") or 0.0) > 0
+                or kinds & _RESTORE_KINDS):
+            factors.append("restore")
         queue_ms = r.get("queue_ms")
         total_ms = r.get("total_ms")
+        wfq_ms = r.get("wfq_ms")
         queue_heavy = False
         if queue_ms is not None:
             if total_ms:
                 queue_heavy = queue_ms / total_ms >= queue_frac
             elif queue_threshold_ms is not None:
                 queue_heavy = queue_ms >= queue_threshold_ms
+        wfq_heavy = False
+        if wfq_ms is not None:
+            if total_ms:
+                wfq_heavy = wfq_ms / total_ms >= queue_frac
+            elif queue_threshold_ms is not None:
+                wfq_heavy = wfq_ms >= queue_threshold_ms
+        if wfq_heavy:
+            factors.append("wfq")
         if queue_heavy or "overload-burst" in factors:
             factors.append("queue")
         if "error" in factors:
@@ -478,6 +608,12 @@ def correlate_tail(
             verdict = "retry-inflated"
         elif "compiles" in factors:
             verdict = "compile-absorbed"
+        elif "quarantine" in factors:
+            verdict = "quarantine-shed"
+        elif "restore" in factors:
+            verdict = "restore-absorbed"
+        elif "wfq" in factors:
+            verdict = "wfq-starved"
         elif "queue" in factors:
             verdict = "queue-dominated"
         else:
@@ -492,7 +628,8 @@ def correlate_tail(
         }
         for k in ("trace_id", "idx", "total_ms", "queue_ms",
                   "forward_ms", "path", "bucket", "batch_size",
-                  "error"):
+                  "error", "tenant", "admission_ms", "wfq_ms",
+                  "restore_ms", "dispatch_ms", "shed"):
             if r.get(k) is not None:
                 entry[k] = r[k]
         if t is not None:
@@ -501,20 +638,27 @@ def correlate_tail(
     return out
 
 
-def tail_report(*, limit: int = 8, window_s: float = 1.0) -> dict:
+def tail_report(*, limit: int = 8, window_s: float = 1.0,
+                tenant: str | None = None) -> dict:
     """The ``/debug/tail`` body: the slowest retained requests (the
     perf plane's reservoir when installed, else the latency
     histogram's exemplars + top-K reservoir) each explained against
-    the flight recorder's event ring."""
+    the flight recorder's event ring. ``tenant`` filters to one
+    tenant's records (``/debug/tail?tenant=``) — fleet records carry
+    the tenant on the breakdown, so the tail forensics answer "why is
+    THIS tenant slow" directly [ISSUE 20]."""
     from spark_bagging_tpu import telemetry
     from spark_bagging_tpu.telemetry import recorder
 
     plane = ACTIVE
     source = "perf-reservoir"
-    records = plane.slow_records(limit) if plane is not None else []
+    records = plane.slow_records() if plane is not None else []
     if not records:
         source = "latency-exemplars"
         records = _exemplar_records(limit)
+    if tenant is not None:
+        records = [r for r in records if r.get("tenant") == tenant]
+    records = records[:limit]
     rec = recorder.get()
     events = rec.events() if rec is not None else []
     tail = correlate_tail(records, events, window_s=window_s)
@@ -522,13 +666,17 @@ def tail_report(*, limit: int = 8, window_s: float = 1.0) -> dict:
     out = {
         "source": source,
         "window_s": window_s,
+        "tenant": tenant,
         "perf_plane_active": plane is not None,
         "flight_recorder_armed": rec is not None and rec.armed,
         "tail": tail,
     }
     if plane is not None:
         plane.export()
-        out["stages"] = plane.summary()["stages"]
+        summary = plane.summary()
+        out["stages"] = summary["stages"]
+        if tenant is not None:
+            out["tenant_stages"] = summary["tenants"].get(tenant)
     if not tail:
         out["note"] = (
             "no slow-request records retained yet; enable the perf "

@@ -139,8 +139,8 @@ SERIES_HELP: dict[str, str] = {
     "sbt_fleet_version": "Live model version reported by one peer (gauge, labels model+process)",
     "sbt_fleet_version_skew": "Max minus min live model version across fresh peers (gauge, label model; 0 = converged)",
     "sbt_fleet_convergence_seconds": "Rolling-swap convergence time: version skew rising above 0 until back to 0 (histogram, label model)",
-    "sbt_perf_stage_seconds": "Per-request wall-clock attributed to one pipeline stage (histogram, labels stage=queue/forward/scatter + path)",
-    "sbt_perf_stage_share": "Share of total request wall-clock spent in one stage (gauge, labels stage + path)",
+    "sbt_perf_stage_seconds": "Per-request wall-clock attributed to one pipeline stage (histogram, labels stage + path, or stage + tenant over the full journey: admission/wfq/restore/dispatch/queue/forward/scatter)",
+    "sbt_perf_stage_share": "Share of total request wall-clock spent in one stage (gauge, labels stage + path, or stage + tenant for the journey twin)",
     "sbt_perf_bucket_seconds_per_row": "Measured forward seconds per served row at this bucket (gauge, label bucket — the live cost model)",
     "sbt_perf_bucket_achieved_flops": "Achieved FLOP/s of this bucket's forward: compiled FLOPs over measured seconds (gauge, label bucket)",
     "sbt_perf_mfu": "Serving model-FLOPs utilization: achieved FLOP/s over the device bf16 peak (gauge; absent on unknown device kinds)",
@@ -194,6 +194,7 @@ SERIES_HELP: dict[str, str] = {
     "sbt_tenancy_pin_violations_total": "Evictions/demotions that had to sacrifice a hot-pinned entry (label tenant, or level=cache)",
     "sbt_tenancy_refit_denied_total": "Online-refit triggers denied by the per-tenant refit budget (label tenant)",
     "sbt_tenancy_latency_p99_ms": "Per-tenant served-request p99 latency in ms (gauge, label tenant; host-band, never digested)",
+    "sbt_tenancy_latency_seconds": "Per-tenant served-request wall latency (log-scale histogram, label tenant, exemplar trace ids; bucket counts merge exactly across the fleet)",
     "sbt_tenancy_tail_p99_ms": "p99 latency in ms over the tail tenants - everyone but the Zipf head (gauge; the fleet SLO burn signal)",
     "sbt_tenant_quarantine_trips_total": "Tenants tripped into quarantine by the failure window (unlabeled total + label tenant)",
     "sbt_tenant_quarantine_shed_total": "Requests shed because their tenant is quarantined (unlabeled total + label tenant)",

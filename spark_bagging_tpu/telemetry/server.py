@@ -301,7 +301,9 @@ def _debug_tail(query: dict[str, list[str]]) -> dict[str, Any]:
         window_s = float((query.get("window_s") or ["1.0"])[0])
     except ValueError:
         window_s = 1.0
-    return perf.tail_report(limit=limit, window_s=window_s)
+    tenant = (query.get("tenant") or [None])[0]
+    return perf.tail_report(limit=limit, window_s=window_s,
+                            tenant=tenant)
 
 
 def _debug_capacity(query: dict[str, list[str]]) -> dict[str, Any]:

@@ -76,7 +76,7 @@ class TraceContext:
 
     __slots__ = (
         "trace_id", "request_id", "links", "annotations",
-        "breakdown", "_span_stack",
+        "breakdown", "journey", "_span_stack",
     )
 
     def __init__(
@@ -91,6 +91,12 @@ class TraceContext:
         self.links = tuple(links)
         self.annotations: dict[str, list] = {}
         self.breakdown: dict[str, Any] = {}
+        # pre-batcher journey stage timings (``admission_ms``,
+        # ``wfq_ms``, ``restore_ms``, ``dispatch_ms`` + ``tenant``),
+        # stamped by the tenancy fleet; None for traces minted by the
+        # batcher itself — the breakdown fix-up gates on this so a
+        # single-model process pays one attribute read, nothing more
+        self.journey: dict[str, Any] | None = None
         # span ids open on THIS context, innermost last; only the
         # installing thread touches it (contexts are installed on one
         # thread at a time — the submit thread, then the worker)

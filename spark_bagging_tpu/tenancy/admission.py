@@ -54,6 +54,10 @@ class AdmissionShed(RuntimeError):
         super().__init__(msg)
         self.tenant = tenant
         self.reason = reason
+        #: the shed request's trace id (stamped by the fleet when
+        #: telemetry minted one) — joins the shed against
+        #: ``/debug/tail`` and flight dumps [ISSUE 20]
+        self.trace_id: str | None = None
 
 
 class QuotaExceeded(AdmissionShed):
@@ -70,8 +74,10 @@ class TenantQuarantined(AdmissionShed):
     Distinct from quota/priority sheds so clients can tell "slow down"
     from "your tenant is being contained"."""
 
-    def __init__(self, tenant: str, msg: str):
+    def __init__(self, tenant: str, msg: str,
+                 trace_id: str | None = None):
         super().__init__(tenant, "quarantine", msg)
+        self.trace_id = trace_id
 
 
 class _Bucket:

@@ -40,6 +40,8 @@ from __future__ import annotations
 import bisect
 import hashlib
 import random
+import time
+from collections import deque
 from typing import Any, Iterable
 
 from spark_bagging_tpu import faults as faults_mod
@@ -47,8 +49,11 @@ from spark_bagging_tpu import telemetry
 from spark_bagging_tpu.analysis.locks import make_lock
 from spark_bagging_tpu.faults import FaultError
 from spark_bagging_tpu.serving.batcher import Degraded, Overloaded
+from spark_bagging_tpu.telemetry import perf as _perf
+from spark_bagging_tpu.telemetry import tracing
 from spark_bagging_tpu.tenancy.admission import (
     AdmissionController,
+    AdmissionShed,
     TenantQuarantined,
 )
 from spark_bagging_tpu.tenancy.budget import RefitBudgeter
@@ -58,6 +63,12 @@ from spark_bagging_tpu.tenancy.wfq import WFQScheduler
 
 #: bounded per-tenant latency reservoir (sorted insert; p99 export)
 _LATENCY_KEEP = 2048
+
+#: bounded recent-quarantine-shed ring: trace ids for the
+#: ``/debug/tenancy`` ↔ ``/debug/tail`` incident join [ISSUE 20] —
+#: a ring, not the event log, so a hammering quarantined tenant
+#: cannot grow the transition transcript without bound
+_SHED_LOG_KEEP = 256
 
 
 class _TenantHealth:
@@ -144,6 +155,11 @@ class QuarantineMachine:
         }
         self._events: list[dict] = []
         self._seq = 0
+        # recent quarantine sheds with the shedding request's trace id
+        # (bounded ring, newest last) — joins /debug/tenancy incidents
+        # against /debug/tail and flight dumps [ISSUE 20 satellite]
+        self._shed_log: deque[dict] = deque(maxlen=_SHED_LOG_KEEP)
+        self._shed_seq = 0
 
     def _h(self, name: str) -> _TenantHealth:
         # sbt-lint: disable=shared-state-unlocked — _locked-path helper, every caller holds self._lock
@@ -162,10 +178,13 @@ class QuarantineMachine:
 
     # -- the decision seams ---------------------------------------------
 
-    def admit(self, name: str, now: float) -> str:
+    def admit(self, name: str, now: float, *,
+              trace_id: str | None = None) -> str:
         """Gate one request: ``"healthy"`` (proceed), ``"probe"``
         (proceed, and this request's outcome decides recovery), or
-        raises :class:`TenantQuarantined` (shed, counted)."""
+        raises :class:`TenantQuarantined` (shed, counted).
+        ``trace_id`` stamps the probe event and the shed — the join
+        key between quarantine incidents and the tail explainer."""
         probe = False
         with self._lock:
             h = self._h(name)
@@ -174,10 +193,18 @@ class QuarantineMachine:
             if h.state == "quarantined" and now >= h.until:
                 h.state = "probing"
                 h.probes += 1
-                self._event("probe", name)
+                if trace_id is not None:
+                    self._event("probe", name, trace_id=trace_id)
+                else:
+                    self._event("probe", name)
                 probe = True
             else:
                 h.sheds += 1
+                self._shed_seq += 1
+                self._shed_log.append({
+                    "tenant": name, "shed_seq": self._shed_seq,
+                    "trace_id": trace_id,
+                })
         if probe:
             telemetry.inc("sbt_tenant_quarantine_probes_total",
                           labels={"tenant": name})
@@ -192,12 +219,14 @@ class QuarantineMachine:
                       labels={"tenant": name})
         raise TenantQuarantined(
             name, f"tenant {name!r} is quarantined (blast-radius "
-            "containment); retry after backoff")
+            "containment); retry after backoff", trace_id=trace_id)
 
-    def record_failure(self, name: str, now: float, kind: str) -> bool:
+    def record_failure(self, name: str, now: float, kind: str, *,
+                       trace_id: str | None = None) -> bool:
         """Feed one tenant-attributed failure into the window. Returns
         True iff THIS failure tripped quarantine (the caller then runs
-        the fleet-level side effects)."""
+        the fleet-level side effects). ``trace_id`` identifies the
+        failing request on the trip event when known."""
         tripped = False
         with self._lock:
             h = self._h(name)
@@ -207,7 +236,7 @@ class QuarantineMachine:
                 h.failures = [t for t in h.failures if t > cutoff]
                 h.failures.append(float(now))
                 if len(h.failures) >= self.threshold:
-                    self._trip_locked(h, name, now)
+                    self._trip_locked(h, name, now, trace_id=trace_id)
                     tripped = True
         telemetry.inc("sbt_tenant_quarantine_failures_total",
                       labels={"tenant": name, "kind": kind})
@@ -253,7 +282,7 @@ class QuarantineMachine:
                 self._event("probe_aborted", name)
 
     def _trip_locked(self, h: _TenantHealth, name: str,
-                     now: float) -> None:
+                     now: float, trace_id: str | None = None) -> None:
         # sbt-lint: disable=shared-state-unlocked — _locked helper, every caller holds self._lock
         delay = min(self.max_backoff_s,
                     self.backoff_s
@@ -267,8 +296,12 @@ class QuarantineMachine:
         h.state = "quarantined"
         h.until = float(now) + delay
         h.failures = []
-        self._event("trip", name, backoff_s=round(delay, 9),
-                     until=round(h.until, 9))
+        if trace_id is not None:
+            self._event("trip", name, backoff_s=round(delay, 9),
+                        until=round(h.until, 9), trace_id=trace_id)
+        else:
+            self._event("trip", name, backoff_s=round(delay, 9),
+                        until=round(h.until, 9))
 
     def _count_trip(self, name: str) -> None:
         telemetry.inc("sbt_tenant_quarantine_trips_total")
@@ -324,6 +357,9 @@ class QuarantineMachine:
                 "max_backoff_s": self.max_backoff_s,
                 "seed": self.seed,
                 "events": len(self._events),
+                # trace-stamped quarantine sheds (bounded ring) — the
+                # /debug/tail join surface [ISSUE 20 satellite]
+                "recent_sheds": [dict(s) for s in self._shed_log],
                 "tenants": {
                     name: {
                         "state": h.state,
@@ -475,25 +511,61 @@ class TenantFleet:
         when admission sheds it (already counted), and
         :class:`~spark_bagging_tpu.tenancy.admission.TenantQuarantined`
         while the tenant is contained. The request reaches its batcher
-        at the next :meth:`dispatch`."""
+        at the next :meth:`dispatch`.
+
+        With telemetry enabled the fleet mints the request's
+        :class:`~spark_bagging_tpu.telemetry.tracing.TraceContext`
+        HERE — before the quarantine gate — so the journey covers
+        every stage the request actually traverses (admission → WFQ →
+        residency → batcher) and a shed resolves the trace with a
+        terminal shed span instead of vanishing [ISSUE 20]. The
+        quarantine/admission gate interval lands in the breakdown as
+        ``admission_ms``; sheds carry ``trace_id`` on the raised
+        exception."""
+        # the journey starts here: one trace per request, tenant on
+        # every span — minted before the quarantine gate so even a
+        # contained tenant's sheds are joinable by trace id. Disabled
+        # telemetry mints nothing: the whole journey plumbing below
+        # is `if trace is not None` (the zero-cost-unarmed contract).
+        trace = (tracing.request_context()
+                 if telemetry.enabled() else None)
+        tid = trace.trace_id if trace is not None else None
+        if trace is not None:
+            trace.journey = {"tenant": name, "t0": time.perf_counter()}
         # quarantine gates BEFORE admission: a contained tenant's
         # traffic must not even drain its own quota buckets, and its
         # single recovery probe is chosen here
-        verdict = self.quarantine.admit(name, now)
+        try:
+            verdict = self.quarantine.admit(name, now, trace_id=tid)
+        except TenantQuarantined:
+            self._resolve_shed(trace, name, "quarantine")
+            raise
         probe = verdict == "probe"
         rows = int(getattr(X, "shape", (1,))[0])
         try:
-            self.admission.check(name, rows, now)
-        except Exception:
+            with tracing.use(trace):
+                with telemetry.span("tenancy_admission", tenant=name,
+                                    rows=rows):
+                    self.admission.check(name, rows, now)
+        except Exception as exc:
             if probe:
                 # the probe never reached the tenant's own path — keep
                 # the quarantine deadline, probe again next request
                 self.quarantine.probe_aborted(name)
+            if isinstance(exc, AdmissionShed):
+                exc.trace_id = tid
+                self._resolve_shed(trace, name, exc.reason)
             raise
+        if trace is not None:
+            j = trace.journey
+            t1 = time.perf_counter()
+            j["admission_ms"] = (t1 - j["t0"]) * 1e3
+            j["t1"] = t1
         with self._lock:
             self._submitted[name] = self._submitted.get(name, 0) + rows
         return self.wfq.enqueue(
-            name, (X, mode, deadline_ms, probe), cost=float(rows))
+            name, (X, mode, deadline_ms, probe, trace),
+            cost=float(rows))
 
     def dispatch(self, *, now: float,
                  run_pending: bool = True) -> list[dict]:
@@ -523,7 +595,8 @@ class TenantFleet:
         while len(self.wfq):
             head = self.wfq.head_tenant()
             try:
-                tenant, (X, mode, deadline_ms, probe) = self.wfq.pop()
+                tenant, (X, mode, deadline_ms, probe, trace) = (
+                    self.wfq.pop())
             except FaultError:
                 # the pop probe fired BEFORE the heap mutation: the
                 # head request stays queued for the next dispatch.
@@ -531,22 +604,45 @@ class TenantFleet:
                 # drain pass — containment, never an escaping fault
                 self._note_failure(head, now, "wfq")
                 break
+            tid = trace.trace_id if trace is not None else None
+            if trace is not None:
+                # the WFQ stage closes at the pop: fair-queue wait is
+                # pop minus enqueue, exactly
+                j = trace.journey
+                t_pop = time.perf_counter()
+                j["wfq_ms"] = (t_pop - j.get("t1", j["t0"])) * 1e3
+                j["t_pop"] = t_pop
             if self.residency is not None and not stepped:
+                t_r0 = time.perf_counter()
                 try:
-                    self.residency.touch(tenant)
+                    status = self.residency.touch(tenant)
                 except FaultError:
                     # an injected restore fault costs THIS tenant a
                     # lower-on-demand, never the dispatch pass
-                    self._note_failure(tenant, now, "restore")
+                    self._note_failure(tenant, now, "restore",
+                                       trace_id=tid)
+                else:
+                    if status == "restored":
+                        # threaded mode restores BEFORE the batcher
+                        # submit: the cost sits inside the dispatch
+                        # interval, carved out as its own stage
+                        self._note_restore(
+                            tenant, (time.perf_counter() - t_r0) * 1e3,
+                            (trace,), pre_submit=True)
             rows = int(getattr(X, "shape", (1,))[0])
             rec: dict[str, Any] = {"tenant": tenant, "future": None,
-                                   "rows": rows, "shed": None}
+                                   "rows": rows, "shed": None,
+                                   "trace_id": tid}
             failure_kind: str | None = None
             try:
                 if faults_mod.ACTIVE is not None:
                     faults_mod.fire("fleet.dispatch", tenant=tenant)
-                rec["future"] = self.batcher(tenant).submit(
-                    X, mode=mode, deadline_ms=deadline_ms)
+                with tracing.use(trace):
+                    with telemetry.span("tenancy_dispatch",
+                                        tenant=tenant, rows=rows):
+                        rec["future"] = self.batcher(tenant).submit(
+                            X, mode=mode, deadline_ms=deadline_ms,
+                            trace=trace)
                 touched.add(tenant)
                 with self._lock:
                     self._served_rows[tenant] = (
@@ -579,7 +675,8 @@ class TenantFleet:
                     # tenant's health — probe again next request
                     self.quarantine.probe_aborted(tenant)
             elif failure_kind is not None:
-                self._note_failure(tenant, now, failure_kind)
+                self._note_failure(tenant, now, failure_kind,
+                                   trace_id=tid)
             if rec["shed"] is not None:
                 with self._lock:
                     key = (tenant, rec["shed"])
@@ -591,24 +688,112 @@ class TenantFleet:
                     "sbt_serving_shed_total",
                     labels={"reason": rec["shed"], "tenant": tenant},
                 )
+                self._resolve_shed(trace, tenant, rec["shed"])
             out.append(rec)
         if stepped:
             for tenant in sorted(touched):
                 if self.residency is not None:
+                    t_r0 = time.perf_counter()
                     try:
-                        self.residency.touch(tenant)
+                        status = self.residency.touch(tenant)
                     except FaultError:
                         self._note_failure(tenant, now, "restore")
+                    else:
+                        if status == "restored":
+                            # stepped mode restores while the window's
+                            # requests wait in their batcher queues:
+                            # the cost would otherwise masquerade as
+                            # queue wait — stamp it onto this window's
+                            # pending traces so the breakdown carves
+                            # it out as restore_ms [ISSUE 20]
+                            dt_ms = (time.perf_counter() - t_r0) * 1e3
+                            traces = []
+                            for r in out:
+                                if (r["tenant"] == tenant
+                                        and r["future"] is not None):
+                                    r["restored"] = True
+                                    traces.append(getattr(
+                                        r["future"], "trace", None))
+                            self._note_restore(tenant, dt_ms, traces,
+                                               pre_submit=False)
                 self.batcher(tenant).run_pending()
         return out
 
+    def _note_restore(self, tenant: str, dt_ms: float,
+                      traces: Iterable[Any], *,
+                      pre_submit: bool) -> None:
+        """Attribute one measured AOT restore to the requests that
+        absorbed it: ``restore_pre_ms`` sits inside the dispatch
+        interval (threaded mode touches before the batcher submit),
+        ``restore_post_ms`` inside the batcher queue wait (stepped
+        mode touches before ``run_pending``) — the breakdown fix-up
+        subtracts each from its host stage, keeping the decomposition
+        exact."""
+        key = "restore_pre_ms" if pre_submit else "restore_post_ms"
+        stamped = []
+        for tr in traces:
+            if tr is not None and tr.journey is not None:
+                tr.journey[key] = tr.journey.get(key, 0.0) + dt_ms
+                stamped.append(tr.trace_id)
+        if telemetry.enabled():
+            telemetry.emit_event({
+                "kind": "tenancy_restore", "tenant": tenant,
+                "restore_ms": round(dt_ms, 3),
+                "trace_ids": stamped[:8],
+            })
+
+    def _resolve_shed(self, trace: Any, tenant: str,
+                      reason: str) -> None:
+        """Resolve a shed request's trace with a terminal shed span
+        and a stage-exact breakdown: quota/priority/quarantine sheds
+        end at admission (the gate interval IS the request), overload/
+        degraded/fault sheds end at dispatch — either way the journey
+        stages tile the request's whole wall-clock and the record is
+        fed to the perf plane so ``/debug/tail`` can verdict it."""
+        if trace is None:
+            return
+        t_shed = time.perf_counter()
+        j = trace.journey if trace.journey is not None else {}
+        j["shed"] = reason
+        pre = float(j.get("restore_pre_ms", 0.0))
+        bd: dict[str, Any] = {
+            "tenant": tenant, "path": "shed", "shed": reason,
+            "queue_ms": 0.0, "batch_ms": 0.0, "forward_ms": 0.0,
+            "batch_size": 0, "restore_ms": pre, "model_name": tenant,
+        }
+        if "t_pop" in j:
+            bd["admission_ms"] = j.get("admission_ms", 0.0)
+            bd["wfq_ms"] = j.get("wfq_ms", 0.0)
+            bd["dispatch_ms"] = (t_shed - j["t_pop"]) * 1e3 - pre
+        else:
+            bd["admission_ms"] = ((t_shed - j["t0"]) * 1e3
+                                  if "t0" in j else 0.0)
+            bd["wfq_ms"] = 0.0
+            bd["dispatch_ms"] = 0.0
+        if "t0" in j:
+            bd["total_ms"] = (t_shed - j["t0"]) * 1e3
+        trace.breakdown.update(bd)
+        with tracing.use(trace):
+            with telemetry.span("tenancy_shed", tenant=tenant,
+                                reason=reason):
+                pass
+        telemetry.emit_event({
+            "kind": "tenancy_shed", "tenant": tenant,
+            "reason": reason, "trace_id": trace.trace_id,
+        })
+        ap = _perf.ACTIVE
+        if ap is not None:
+            ap.observe_breakdown(bd, trace_id=trace.trace_id)
+
     def _note_failure(self, tenant: str | None, now: float,
-                      kind: str) -> None:
+                      kind: str, *,
+                      trace_id: str | None = None) -> None:
         """Feed one tenant-attributed failure into the quarantine
         window; on a trip, run the fleet-level containment edges."""
         if tenant is None:
             return
-        if self.quarantine.record_failure(tenant, now, kind):
+        if self.quarantine.record_failure(tenant, now, kind,
+                                          trace_id=trace_id):
             self._on_trip(tenant, now)
 
     def _on_trip(self, tenant: str, now: float) -> None:
@@ -648,14 +833,25 @@ class TenantFleet:
 
     # -- latency accounting ----------------------------------------------
 
-    def note_latency(self, name: str, ms: float) -> None:
+    def note_latency(self, name: str, ms: float, *,
+                     trace_id: str | None = None) -> None:
         """Record one served request's wall latency (host-band data:
-        exported as gauges, never digested)."""
+        exported as gauges, never digested). Besides the in-object
+        p99 reservoir this feeds the real log-scale
+        ``sbt_tenancy_latency_seconds{tenant=}`` histogram (exemplar:
+        ``trace_id``), so fleet merge and ``/fleet/varz`` quantiles
+        cover tenant tails exactly — bucket counts merge across
+        processes, in-object p99s cannot [ISSUE 20 satellite]."""
         with self._lock:
             res = self._latency_ms.setdefault(name, [])
             bisect.insort(res, float(ms))
             if len(res) > _LATENCY_KEEP:
                 res.pop()  # drop the max: keep the reservoir bounded
+        if telemetry.enabled():
+            telemetry.observe("sbt_tenancy_latency_seconds",
+                              float(ms) / 1e3,
+                              labels={"tenant": name},
+                              exemplar=trace_id)
 
     @staticmethod
     def _p99(sorted_ms: list[float]) -> float | None:

@@ -45,6 +45,9 @@ def do_GET(self, url):
         return self._metrics()
     return {"endpoints": ["/metrics"]}
 ''',
+    "spark_bagging_tpu/telemetry/perf.py": '''\
+VERDICTS = ("failed", "queue-dominated")
+''',
     "spark_bagging_tpu/app.py": '''\
 def work(telemetry, faults):
     telemetry.inc("sbt_requests_total")
@@ -64,6 +67,11 @@ def _register_all(register, Scenario):
 | route | serves | semantics |
 |---|---|---|
 | `/metrics` | text | the scrape endpoint |
+
+| verdict | evidence |
+|---|---|
+| `failed` | the record carries an error |
+| `queue-dominated` | queue wait dominates |
 """,
 }
 
@@ -175,8 +183,10 @@ def do_GET(self, url):
 '''},
          "missing from the ARCHITECTURE.md route table"),
         # documented but 404s
-        ({"ARCHITECTURE.md": _SKELETON["ARCHITECTURE.md"]
-          + "| `/ghost` | json | promised, never dispatched |\n"},
+        ({"ARCHITECTURE.md": _SKELETON["ARCHITECTURE.md"].replace(
+            "| `/metrics` | text | the scrape endpoint |",
+            "| `/metrics` | text | the scrape endpoint |\n"
+            "| `/ghost` | json | promised, never dispatched |")},
          "not dispatched"),
         # advertised on / but 404s
         ({"spark_bagging_tpu/telemetry/server.py": '''\
@@ -186,6 +196,17 @@ def do_GET(self, url):
     return {"endpoints": ["/metrics", "/phantom"]}
 '''},
          "advertises an endpoint"),
+    ],
+    "contract-tail-verdicts": [
+        # a verdict the ladder emits but the docs never explain
+        ({"spark_bagging_tpu/telemetry/perf.py": '''\
+VERDICTS = ("failed", "queue-dominated", "wfq-starved")
+'''},
+         "missing from the ARCHITECTURE.md verdict-ladder table"),
+        # a documented verdict correlate_tail can never emit
+        ({"ARCHITECTURE.md": _SKELETON["ARCHITECTURE.md"]
+          + "| `ghost-verdict` | promised, never emitted |\n"},
+         "is not in"),
     ],
     "contract-scenario-baselines": [
         # registered with no committed baseline

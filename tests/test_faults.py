@@ -719,6 +719,7 @@ def test_chaos_replay_is_deterministic_across_repeats(models):
     assert faults.ACTIVE is None  # replay disarmed on the way out
 
 
+@pytest.mark.slow  # [PR 20 budget offset] ~3.9s subprocess CLI gate; chaos-replay semantics stay tier-1 via the in-process chaos tests above plus the chaos-mixed registered scenario in the conformance smoke
 def test_chaos_replay_cli_gate(tmp_path):
     """`python -m benchmarks.replay --chaos mixed --check` exits 0:
     byte-identical digests + identical fault transcripts across

@@ -70,6 +70,7 @@ class TestGBTRegressor:
         sk_r2 = sk.score(X, y)
         assert r2 > sk_r2 - 0.05  # binned splits vs exact: near parity
 
+    @pytest.mark.slow  # [PR 20 budget offset] ~3.5s Poisson-weight dual-fit soak; the weight-column semantics stay tier-1 via test_bagging's test_weighted_equals_duplicated_rows
     def test_weighted_equals_duplicated(self):
         X, y = _friedman(n=300)
         rng = np.random.default_rng(1)

@@ -1,0 +1,367 @@
+"""End-to-end request journey [ISSUE 20]: the tenancy fleet mints one
+trace per request (tenant on every span), admission/WFQ/residency/
+dispatch contribute exact stage timings that TILE the total
+(admission + wfq + dispatch + restore + queue + batch == total), sheds
+resolve the trace with a terminal ``tenancy_shed`` span instead of
+vanishing, traces survive a mid-traffic ``registry.swap()`` and a
+demote→restore cycle, and the unarmed journey probe stays one
+attribute read.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from spark_bagging_tpu import (
+    BaggingClassifier,
+    LogisticRegression,
+    telemetry,
+)
+from spark_bagging_tpu.serving import ModelRegistry
+from spark_bagging_tpu.serving import program_cache as _pc
+from spark_bagging_tpu.telemetry import perf, tracing
+from spark_bagging_tpu.tenancy import TenantFleet, TenantSpec
+from spark_bagging_tpu.tenancy.admission import (
+    QuotaExceeded,
+    TenantQuarantined,
+)
+
+JOURNEY_KEYS = ("admission_ms", "wfq_ms", "dispatch_ms", "restore_ms")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_clock():
+    """Wall-clock anchor for the budget test (module import happens at
+    collection, long before the first test runs)."""
+    return time.perf_counter()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.enable()
+    # a private unified cache per test (the test_tenancy convention):
+    # restored executables must not leak across tests
+    prev_cache = _pc.install(_pc.ProgramCache(capacity=64))
+    yield
+    _pc.install(prev_cache)
+    telemetry.reset()
+    telemetry.enable()
+
+
+def _problem(n=96, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w > 0).astype(np.int32)
+    return X, y
+
+
+def _fit(seed=0, n_estimators=2):
+    X, y = _problem(seed=seed)
+    return BaggingClassifier(
+        base_learner=LogisticRegression(max_iter=5),
+        n_estimators=n_estimators, seed=seed,
+    ).fit(X, y)
+
+
+def _assert_tiles_exactly(bd, tol_ms=1e-6):
+    """The decomposition contract: the six journey + batcher stages
+    telescope to the fleet-anchored total (float noise only)."""
+    parts = (bd.get("admission_ms", 0.0) + bd.get("wfq_ms", 0.0)
+             + bd.get("dispatch_ms", 0.0) + bd.get("restore_ms", 0.0)
+             + bd.get("queue_ms", 0.0) + bd.get("batch_ms", 0.0))
+    assert parts == pytest.approx(bd["total_ms"], abs=tol_ms), bd
+
+
+class _BreakdownRecorder:
+    """Stand-in perf plane: records every breakdown the probes feed
+    (duck-typed — the probe calls only ``observe_breakdown``)."""
+
+    def __init__(self):
+        self.breakdowns = []
+
+    def observe_breakdown(self, bd, trace_id=None):
+        self.breakdowns.append((dict(bd), trace_id))
+
+
+# -- the exact-decomposition property ----------------------------------
+
+class TestExactDecomposition:
+    @pytest.mark.parametrize("threaded", [False, True])
+    def test_served_requests_tile_exactly(self, tmp_path, threaded):
+        """Tentpole property [ISSUE 20]: across stepped AND threaded
+        drive (restore carved from queue wait vs dispatch interval)
+        every served request's breakdown tiles its total exactly, with
+        the tenant stamped and every journey stage present."""
+        specs = [TenantSpec(name="t0"), TenantSpec(name="t1")]
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        fleet = TenantFleet(specs, registry=reg, residency_capacity=1,
+                            aot_root=str(tmp_path), threaded=threaded)
+        try:
+            for i in range(2):
+                fleet.register(f"t{i}", _fit(seed=i), warmup=True,
+                               version=1)
+            X = np.asarray(_problem(seed=3)[0][:8])
+            futs = []
+            # alternating tenants against residency capacity 1: every
+            # window restores someone, so restore_ms > 0 paths are
+            # exercised in both drive modes
+            for step in range(4):
+                fleet.submit(f"t{step % 2}", X, now=float(step))
+                futs += [r["future"]
+                         for r in fleet.dispatch(now=float(step))
+                         if r["future"] is not None]
+            assert len(futs) == 4
+            restored = 0
+            for f in futs:
+                f.result(30)
+                bd = f.trace.breakdown
+                assert bd["tenant"] in ("t0", "t1")
+                assert bd["path"] in ("direct", "coalesced")
+                for k in JOURNEY_KEYS:
+                    assert k in bd, k
+                if bd["restore_ms"] > 0:
+                    restored += 1
+                _assert_tiles_exactly(bd)
+            assert restored >= 1
+        finally:
+            fleet.close()
+
+    def test_quota_shed_resolves_trace_with_exact_breakdown(self):
+        """A quota shed is a terminal journey outcome: the raised
+        exception carries the trace id, the breakdown reaches the perf
+        probe with ``path="shed"``, zeroed batcher stages, and an
+        exact admission-anchored tiling."""
+        rec = _BreakdownRecorder()
+        prev = perf.install(rec)
+        fleet = TenantFleet([TenantSpec(name="t0", quota_rps=1.0)])
+        try:
+            fleet.register("t0", _fit(seed=0), warmup=False, version=1)
+            X = np.asarray(_problem(seed=3)[0][:4])
+            fleet.submit("t0", X, now=0.0)  # takes the burst token
+            with pytest.raises(QuotaExceeded) as ei:
+                fleet.submit("t0", X, now=0.01)
+            assert ei.value.trace_id is not None
+            sheds = [(bd, tid) for bd, tid in rec.breakdowns
+                     if bd.get("shed")]
+            assert len(sheds) == 1
+            bd, tid = sheds[0]
+            assert tid == ei.value.trace_id
+            assert bd["shed"] == "quota"
+            assert bd["path"] == "shed"
+            assert bd["tenant"] == "t0"
+            assert bd["queue_ms"] == 0.0
+            assert bd["batch_ms"] == 0.0
+            assert bd["batch_size"] == 0
+            _assert_tiles_exactly(bd)
+        finally:
+            fleet.close()
+            perf.install(prev)
+
+    def test_quarantine_shed_terminal_span_and_shed_log(self):
+        """Quarantine sheds resolve with a terminal ``tenancy_shed``
+        span, an exact breakdown, AND a trace id on the quarantine
+        machine's shed log (the bugfix satellite: sheds used to be
+        joinable only by tenant name)."""
+        rec = _BreakdownRecorder()
+        prev = perf.install(rec)
+        fleet = TenantFleet([TenantSpec(name="t0")],
+                            quarantine_threshold=1)
+        try:
+            fleet.register("t0", _fit(seed=0), warmup=False, version=1)
+            fleet.quarantine.record_failure("t0", 0.0, "dispatch")
+            X = np.asarray(_problem(seed=3)[0][:4])
+            with telemetry.capture() as run:
+                with pytest.raises(TenantQuarantined) as ei:
+                    fleet.submit("t0", X, now=0.1)
+            tid = ei.value.trace_id
+            assert tid is not None
+            sheds = [(bd, t) for bd, t in rec.breakdowns
+                     if bd.get("shed") == "quarantine"]
+            assert len(sheds) == 1
+            bd, bd_tid = sheds[0]
+            assert bd_tid == tid
+            assert bd["tenant"] == "t0"
+            _assert_tiles_exactly(bd)
+            spans = [s for s in run.spans("tenancy_shed")
+                     if s.get("trace_id") == tid]
+            assert len(spans) == 1
+            assert spans[0]["attrs"] == {"tenant": "t0",
+                                         "reason": "quarantine"}
+            shed_events = [e for e in run.events
+                           if e.get("kind") == "tenancy_shed"]
+            assert [e["trace_id"] for e in shed_events] == [tid]
+            state = fleet.quarantine.state()
+            assert any(s["trace_id"] == tid
+                       for s in state["recent_sheds"])
+        finally:
+            fleet.close()
+            perf.install(prev)
+
+
+# -- trace propagation --------------------------------------------------
+
+class TestTracePropagation:
+    def test_trace_survives_mid_traffic_swap(self):
+        """Satellite [ISSUE 20]: a ``registry.swap()`` between two
+        traffic windows must not lose spans or breakdowns — both
+        requests keep distinct traces, exact tilings, and exactly one
+        admission + one dispatch span each, with the served version
+        flipping at the swap boundary."""
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        fleet = TenantFleet([TenantSpec(name="t0")], registry=reg)
+        try:
+            fleet.register("t0", _fit(seed=0), warmup=True, version=1)
+            X = np.asarray(_problem(seed=3)[0][:8])
+            futs = []
+            with telemetry.capture() as run:
+                fleet.submit("t0", X, now=0.0)
+                futs += [r["future"]
+                         for r in fleet.dispatch(now=0.0)
+                         if r["future"] is not None]
+                reg.swap("t0", _fit(seed=1), version=2)
+                fleet.submit("t0", X, now=1.0)
+                futs += [r["future"]
+                         for r in fleet.dispatch(now=1.0)
+                         if r["future"] is not None]
+                for f in futs:
+                    f.result(30)
+            assert len(futs) == 2
+            tids = [f.trace.trace_id for f in futs]
+            assert len(set(tids)) == 2
+            for f in futs:
+                bd = f.trace.breakdown
+                assert bd["tenant"] == "t0"
+                _assert_tiles_exactly(bd)
+            assert [f.trace.breakdown["model_version"]
+                    for f in futs] == [1, 2]
+            # zero lost spans: every trace shows its admission and
+            # dispatch span exactly once, tenant-attributed
+            for tid in tids:
+                for name in ("tenancy_admission", "tenancy_dispatch"):
+                    spans = [s for s in run.spans(name)
+                             if s.get("trace_id") == tid]
+                    assert len(spans) == 1, (name, tid)
+                    assert spans[0]["attrs"]["tenant"] == "t0"
+        finally:
+            fleet.close()
+
+    def test_demote_restore_cycle_stamps_restore_exactly_once(
+            self, tmp_path):
+        """Satellite [ISSUE 20]: a demoted tenant's next request pays
+        the AOT restore (``restore_ms > 0``) exactly once; the
+        follow-up request (now resident) pays zero, both outputs are
+        bitwise-identical to a never-demoted control, and no spans are
+        lost across the cycle."""
+        specs = [TenantSpec(name="t0"), TenantSpec(name="t1")]
+        reg = ModelRegistry(min_bucket_rows=8, max_batch_rows=16)
+        fleet = TenantFleet(specs, registry=reg, residency_capacity=1,
+                            aot_root=str(tmp_path))
+        try:
+            models = [_fit(seed=0), _fit(seed=1)]
+            for i in range(2):
+                fleet.register(f"t{i}", models[i], warmup=True,
+                               version=1)
+            # capacity 1: registering t1 demoted t0
+            assert fleet.residency.residents() == ("t1",)
+            X = np.asarray(_problem(seed=9)[0][:8])
+            solo_reg = ModelRegistry(min_bucket_rows=8,
+                                     max_batch_rows=16)
+            solo_reg.register("solo", models[0], warmup=True)
+            with solo_reg.batcher("solo") as b:
+                want = np.asarray(b.submit(X).result(30))
+            with telemetry.capture() as run:
+                fleet.submit("t0", X, now=0.0)
+                f1 = fleet.dispatch(now=0.0)[0]["future"]
+                out1 = np.asarray(f1.result(30))
+                fleet.submit("t0", X, now=1.0)
+                f2 = fleet.dispatch(now=1.0)[0]["future"]
+                out2 = np.asarray(f2.result(30))
+            assert np.array_equal(out1, want)
+            assert np.array_equal(out2, want)
+            assert f1.trace.breakdown["restore_ms"] > 0
+            assert f2.trace.breakdown["restore_ms"] == 0.0
+            for f in (f1, f2):
+                _assert_tiles_exactly(f.trace.breakdown)
+            # the restore evidence event fired once, carrying f1's id
+            restores = [e for e in run.events
+                        if e.get("kind") == "tenancy_restore"
+                        and e.get("tenant") == "t0"]
+            assert len(restores) == 1
+            assert f1.trace.trace_id in restores[0]["trace_ids"]
+            assert restores[0]["restore_ms"] > 0
+            for f in (f1, f2):
+                for name in ("tenancy_admission", "tenancy_dispatch"):
+                    assert len([
+                        s for s in run.spans(name)
+                        if s.get("trace_id") == f.trace.trace_id
+                    ]) == 1
+        finally:
+            fleet.close()
+
+
+# -- probe cost ---------------------------------------------------------
+
+class TestUnarmedJourneyProbe:
+    def test_unarmed_probe_is_one_attribute_read(self):
+        """The journey feed's unarmed probe (exactly what
+        ``_resolve_shed`` and ``_finish_breakdown`` run when no perf
+        plane is installed) must stay far under a microsecond."""
+        perf.disable()
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ap = perf.ACTIVE
+            if ap is not None:  # pragma: no cover — disabled
+                raise AssertionError
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 2e-6, f"{per_call * 1e9:.0f}ns per probe"
+
+    def test_batcher_minted_traces_carry_no_journey(self):
+        """A single-model process never pays the journey fix-up: the
+        batcher-minted trace's ``journey`` slot is None, so the
+        breakdown path gates on one attribute read."""
+        assert tracing.request_context().journey is None
+
+
+# -- the replay journey section -----------------------------------------
+
+class TestReplayJourney:
+    def test_virtual_journey_verdicts_and_repeat_identity(self):
+        """The tenant-tail-attribution contract at unit scale: a
+        skewed-Zipf, tight-residency drive produces ``wfq-starved``
+        AND ``restore-absorbed`` verdicts on the virtual clock, and
+        the whole journey section (digest included) is byte-identical
+        across two independent runs."""
+        from benchmarks.replay import replay_tenants
+        from spark_bagging_tpu.telemetry import workload as wmod
+
+        w = wmod.synthetic_workload(
+            rate_rps=200.0, duration_s=0.3, seed=112, width=8,
+            bucket_bounds=(8, 32),
+        )
+        kwargs = dict(n_tenants=6, residency_capacity=2, zipf_s=1.8,
+                      seed=112, min_bucket_rows=8, bucket_max_rows=32)
+        j1 = replay_tenants(w, **kwargs)["tenants"]["journey"]
+        j2 = replay_tenants(w, **kwargs)["tenants"]["journey"]
+        assert j1 == j2
+        assert j1["verdicts"].get("restore-absorbed", 0) > 0
+        assert j1["verdicts"].get("wfq-starved", 0) > 0
+        assert j1["requests"] == sum(
+            acc["requests"]
+            for acc in j1["stage_ms_by_tenant"].values()
+        )
+        for entry in j1["tail"]:
+            assert entry["verdict"] in perf.VERDICTS
+
+
+def test_zz_journey_suite_under_budget(_module_clock):
+    """Tier-1 allowance for this module (the ratchet discipline): two
+    tiny in-process drills plus unit coverage."""
+    elapsed = time.perf_counter() - _module_clock
+    assert elapsed < 40.0, (
+        f"tests/test_journey.py took {elapsed:.1f}s; move the "
+        "offender to -m slow or shrink it"
+    )

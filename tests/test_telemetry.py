@@ -429,6 +429,7 @@ class TestFitReportCompatibility:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow  # [PR 20 budget offset] ~4.4s full-fit e2e soak; event-log/prometheus surfaces stay tier-1 via the recorder/render unit tests here plus the conformance smoke's live-registry asserts
     def test_cpu_fit_produces_event_log_and_prometheus(
         self, tmp_path, small_data
     ):

@@ -624,6 +624,7 @@ class TestLockOrder:
 # -- the replay drill gate ---------------------------------------------
 
 class TestTenantsDrill:
+    @pytest.mark.slow  # [PR 20 budget offset] ~4.1s in-process drill twin; the fleet drill gate stays tier-1 via the multi-tenant-zipf registered scenario in the conformance smoke
     def test_drill_gate_in_process(self):
         """The scenario gate's in-process twin: a tiny fleet through
         ``replay_median(tenants=True, repeats=2)`` — cross-repeat byte

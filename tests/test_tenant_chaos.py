@@ -451,6 +451,7 @@ class TestQuarantineSurfaces:
 # -- the tenant-chaos drill ---------------------------------------------
 
 class TestTenantChaosDrill:
+    @pytest.mark.slow  # [PR 20 budget offset] ~7.7s in-process drill twin; blast-radius containment stays tier-1 via the tenant-chaos registered scenario in the conformance smoke (committed digests include the fault + quarantine transcripts)
     def test_blast_radius_containment_in_process(self):
         """The tentpole's acceptance gate, in-process: the builtin
         ``tenant-chaos`` plan through ``replay_median(tenants=True,

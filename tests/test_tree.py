@@ -123,6 +123,7 @@ class TestClassifierTree:
         pred = np.asarray(tree.predict_scores(params, Xj).argmax(1))
         assert not np.any(pred == 2)
 
+    @pytest.mark.slow  # [PR 20 budget offset] ~6.1s iris fit soak; per-row probability normalization stays tier-1 via the predict_proba row-sum asserts in test_bagging.py and test_pipeline.py
     def test_scores_are_log_probabilities(self):
         Xj, yj, _, y = _iris()
         tree = DecisionTreeClassifier(max_depth=2)
@@ -619,6 +620,7 @@ def test_gbt_debug_string_binary_and_multiclass():
     assert "Tree 0 (class 0):" in s3 and "Tree 1 (class 2):" in s3
 
 
+@pytest.mark.slow  # [PR 20 budget offset] ~4.5s zero-smoothing edge soak; leaf finiteness stays tier-1 via the all-finite-leaves fuzz invariants (same pattern as the gbt all-zero-weight demotion above)
 def test_classifier_empty_leaves_no_nan_with_zero_smoothing():
     """leaf_smoothing=0 with unpopulated leaves (pure splits upstream)
     must fall back to uniform log-probs, not log(0/0)=NaN."""
